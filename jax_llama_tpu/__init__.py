@@ -1,0 +1,31 @@
+"""jax_llama_tpu — a TPU-native LLaMA framework built from scratch in JAX.
+
+Public API (capability parity with the reference's ``jax_llama/__init__.py``
+surface, re-expressed for the functional TPU-first design):
+
+  Model:      LLaMAConfig, get_config, init_params, forward, KVCache,
+              init_cache
+  Parallel:   make_mesh, auto_mesh, use_mesh, constrain
+"""
+
+from .config import LLaMAConfig, get_config, swiglu_hidden_size
+from .models import KVCache, forward, init_cache, init_params, param_count
+from .parallel import auto_mesh, constrain, make_mesh, use_mesh
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "LLaMAConfig",
+    "get_config",
+    "swiglu_hidden_size",
+    "KVCache",
+    "forward",
+    "init_cache",
+    "init_params",
+    "param_count",
+    "auto_mesh",
+    "constrain",
+    "make_mesh",
+    "use_mesh",
+    "__version__",
+]

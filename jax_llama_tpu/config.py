@@ -1,0 +1,181 @@
+"""Model configuration for the TPU-native LLaMA framework.
+
+Plain frozen dataclass — no HuggingFace ``PretrainedConfig`` baggage.  Covers
+the capability surface of the reference config (``/root/reference/jax_llama/
+config.py:26-116``: vocab/hidden/layers/heads/GQA/rope_theta/max-seq/eps/
+tying) plus the SwiGLU intermediate-size derivation rule the reference keeps
+in its converter (``/root/reference/jax_llama/convert_weights.py:36-39``),
+which belongs with the config.
+
+TPU-first additions: explicit ``dtype``/``param_dtype`` policy (bf16 compute,
+fp32 islands for norm/softmax/logits), ``scan_layers`` (lax.scan over a
+stacked layer pytree instead of a Python-unrolled stack, keeping 80-layer
+compile times flat), ``remat`` policy, and ``attn_impl`` selecting the XLA
+reference attention or the Pallas flash kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def swiglu_hidden_size(
+    dim: int,
+    multiple_of: int = 256,
+    ffn_dim_multiplier: Optional[float] = None,
+) -> int:
+    """Meta's SwiGLU FFN sizing rule.
+
+    Start from 4*dim, take 2/3 of it (SwiGLU has 3 matrices instead of 2),
+    optionally scale (Llama-3 uses 1.3), and round up to ``multiple_of``.
+    """
+    hidden = int(2 * (4 * dim) / 3)
+    if ffn_dim_multiplier is not None:
+        hidden = int(ffn_dim_multiplier * hidden)
+    return multiple_of * math.ceil(hidden / multiple_of)
+
+
+@dataclasses.dataclass(frozen=True)
+class LLaMAConfig:
+    """Architecture + numerics configuration for a LLaMA-family model."""
+
+    vocab_size: int = 32000
+    dim: int = 4096                       # hidden size
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: Optional[int] = None      # None -> n_heads (no GQA)
+    intermediate_size: Optional[int] = None  # None -> swiglu_hidden_size(...)
+    multiple_of: int = 256
+    ffn_dim_multiplier: Optional[float] = None
+    max_seq_len: int = 2048
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+
+    # --- numerics / execution policy (TPU-first) ---
+    dtype: str = "bfloat16"               # activation/compute dtype
+    param_dtype: str = "float32"          # parameter storage dtype
+    scan_layers: bool = True              # lax.scan over stacked layers
+    remat: bool = False                   # jax.checkpoint each block
+    attn_impl: str = "xla"                # "xla" | "flash" (Pallas)
+    attn_softmax_dtype: str = "float32"   # fp32 softmax island
+    logits_dtype: str = "float32"         # fp32 logits island
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        assert self.dim % self.n_heads == 0
+        return self.dim // self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.intermediate_size is not None:
+            return self.intermediate_size
+        return swiglu_hidden_size(self.dim, self.multiple_of, self.ffn_dim_multiplier)
+
+    @property
+    def activation_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def weight_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+    def replace(self, **kw) -> "LLaMAConfig":
+        return dataclasses.replace(self, **kw)
+
+    def validate(self) -> None:
+        assert self.dim % self.n_heads == 0, "n_heads must divide dim"
+        assert self.n_heads % self.kv_heads == 0, (
+            "n_heads must be a multiple of n_kv_heads (GQA group size)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Presets.  Sizes follow the published Meta architectures; these are
+# architecture constants, not tuned values.
+# ---------------------------------------------------------------------------
+
+def tiny(**kw) -> LLaMAConfig:
+    """Tiny config for unit tests (mirrors the reference's test config scale:
+    /root/reference/jax_test.py:28-41)."""
+    base = dict(
+        vocab_size=256, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64, rope_theta=10000.0,
+        rms_norm_eps=1e-5, dtype="float32", param_dtype="float32",
+    )
+    base.update(kw)
+    return LLaMAConfig(**base)
+
+
+def llama2_7b(**kw) -> LLaMAConfig:
+    base = dict(
+        vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=None,
+        multiple_of=256, max_seq_len=4096, rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+    )
+    base.update(kw)
+    return LLaMAConfig(**base)
+
+
+def llama2_13b(**kw) -> LLaMAConfig:
+    base = dict(
+        vocab_size=32000, dim=5120, n_layers=40, n_heads=40, n_kv_heads=None,
+        multiple_of=256, max_seq_len=4096, rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+    )
+    base.update(kw)
+    return LLaMAConfig(**base)
+
+
+def llama2_70b(**kw) -> LLaMAConfig:
+    base = dict(
+        vocab_size=32000, dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        multiple_of=4096, ffn_dim_multiplier=1.3, max_seq_len=4096,
+        rope_theta=10000.0, rms_norm_eps=1e-5,
+    )
+    base.update(kw)
+    return LLaMAConfig(**base)
+
+
+def llama3_8b(**kw) -> LLaMAConfig:
+    base = dict(
+        vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        multiple_of=1024, ffn_dim_multiplier=1.3, max_seq_len=8192,
+        rope_theta=500000.0, rms_norm_eps=1e-5,
+    )
+    base.update(kw)
+    return LLaMAConfig(**base)
+
+
+def llama3_70b(**kw) -> LLaMAConfig:
+    base = dict(
+        vocab_size=128256, dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        multiple_of=4096, ffn_dim_multiplier=1.3, max_seq_len=8192,
+        rope_theta=500000.0, rms_norm_eps=1e-5,
+    )
+    base.update(kw)
+    return LLaMAConfig(**base)
+
+
+PRESETS = {
+    "tiny": tiny,
+    "llama2-7b": llama2_7b,
+    "llama2-13b": llama2_13b,
+    "llama2-70b": llama2_70b,
+    "llama3-8b": llama3_8b,
+    "llama3-70b": llama3_70b,
+}
+
+
+def get_config(name: str, **kw) -> LLaMAConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown config preset {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name](**kw)

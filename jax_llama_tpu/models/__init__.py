@@ -1,0 +1,9 @@
+from .llama import (
+    KVCache,
+    forward,
+    init_cache,
+    init_params,
+    param_count,
+)
+
+__all__ = ["KVCache", "forward", "init_cache", "init_params", "param_count"]

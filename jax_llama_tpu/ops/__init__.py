@@ -1,0 +1,18 @@
+from .norm import rms_norm
+from .rope import rope_table, apply_rope
+from .attention import sdpa, repeat_kv, attention_bias, NEG_INF
+from .sampling import sample, greedy, top_p_filter, top_k_filter
+
+__all__ = [
+    "rms_norm",
+    "rope_table",
+    "apply_rope",
+    "sdpa",
+    "repeat_kv",
+    "attention_bias",
+    "NEG_INF",
+    "sample",
+    "greedy",
+    "top_p_filter",
+    "top_k_filter",
+]

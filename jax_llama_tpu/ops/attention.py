@@ -1,0 +1,94 @@
+"""Grouped-query scaled-dot-product attention — XLA reference path.
+
+Capability parity with the reference attention core (``/root/reference/
+jax_llama/model.py:94-300``): GQA with KV-head replication *after* the cache
+(the cache stays small, replication is per-step), causal + padding masking as
+an additive fp32 bias, fp32 softmax.
+
+TPU-first differences from the reference:
+  * No materialized [1,1,S,S] causal-mask buffer (reference model.py:154) —
+    masks are computed from position indices on the fly, so memory is
+    O(T·S) per block at most, and the Pallas flash path (ops/flash_attention)
+    never materializes scores at all.
+  * einsum contractions keep [B, T, H, D] layout with explicit
+    `preferred_element_type=float32` so the MXU accumulates in fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Broadcast KV heads to match query heads for GQA.
+
+    x: [B, S, KVH, D] -> [B, S, KVH * n_rep, D].
+    """
+    if n_rep == 1:
+        return x
+    b, s, kvh, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, kvh, n_rep, d))
+    return x.reshape(b, s, kvh * n_rep, d)
+
+
+def attention_bias(
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    kv_valid: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Additive fp32 attention bias combining causality and padding.
+
+    Args:
+      q_positions: [B, T] absolute positions of the query tokens.
+      kv_positions: [B, S] absolute positions of the key/value slots.
+      kv_valid: optional [B, S] bool — False for padding / unwritten cache
+        slots.
+    Returns:
+      [B, 1, T, S] bias, 0 where attendable, finfo.min where masked.
+    """
+    allowed = kv_positions[:, None, :] <= q_positions[:, :, None]  # [B, T, S]
+    if kv_valid is not None:
+        allowed = jnp.logical_and(allowed, kv_valid[:, None, :])
+    bias = jnp.where(allowed, 0.0, NEG_INF).astype(jnp.float32)
+    return bias[:, None, :, :]
+
+
+def sdpa(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,
+    softmax_dtype: jnp.dtype = jnp.float32,
+) -> jnp.ndarray:
+    """Scaled dot-product attention with GQA.
+
+    Args:
+      q: [B, T, H, D].
+      k, v: [B, S, KVH, D] with H % KVH == 0.
+      bias: optional [B, 1, T, S] additive bias (fp32).
+    Returns:
+      [B, T, H, D] in q.dtype.
+    """
+    b, t, h, d = q.shape
+    kvh = k.shape[2]
+    assert h % kvh == 0, (h, kvh)
+    k = repeat_kv(k, h // kvh)
+    v = repeat_kv(v, h // kvh)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if bias is not None:
+        scores = scores + bias
+    scores = scores.astype(softmax_dtype)
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum(
+        "bhts,bshd->bthd", weights, v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype)

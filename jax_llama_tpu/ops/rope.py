@@ -1,0 +1,73 @@
+"""Rotary position embeddings, Meta-interleaved pairing, real-valued math.
+
+The reference applies RoPE in complex arithmetic over interleaved pairs
+``(x[2i], x[2i+1])`` (``/root/reference/jax_llama/model.py:50-92``).  Complex
+dtypes are poison for the TPU vector unit, so we use the algebraically
+identical real-valued form:
+
+    out[2i]   = x[2i]*cos(t·w_i) - x[2i+1]*sin(t·w_i)
+    out[2i+1] = x[2i]*sin(t·w_i) + x[2i+1]*cos(t·w_i)
+
+NOTE this is the *interleaved* (Meta checkpoint) pairing, not the HF
+half-split ("rotate_half") pairing — weight conversion from Meta checkpoints
+needs no Q/K permutation with this convention.  Tables are precomputed in
+float32 and rotation runs in float32 regardless of activation dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_table(
+    head_dim: int,
+    max_positions: int,
+    theta: float = 10000.0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Precompute (cos, sin) tables, each [max_positions, head_dim // 2], fp32.
+
+    Computed and returned on host in **numpy** (like the reference's
+    host-side precompute, model.py:156-161): bit-stable across backends, and
+    safe to memoize — a cached jnp array created inside a jit trace would
+    leak a tracer into later traces; a numpy array is a fresh constant in
+    every trace.
+    """
+    assert head_dim % 2 == 0
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    t = np.arange(max_positions, dtype=np.float64)
+    angles = np.outer(t, inv_freq)  # [P, head_dim/2]
+    return (
+        np.cos(angles).astype(np.float32),
+        np.sin(angles).astype(np.float32),
+    )
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate q or k by position-dependent angles.
+
+    Args:
+      x: [batch, seq, heads, head_dim].
+      cos, sin: [max_positions, head_dim // 2] fp32 tables from `rope_table`.
+      positions: [batch, seq] int32 absolute position ids.
+    Returns:
+      Rotated tensor, same shape/dtype as x.
+    """
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    x_even = xf[..., 0::2]  # [B, S, H, D/2]
+    x_odd = xf[..., 1::2]
+    c = jnp.take(cos, positions, axis=0)[:, :, None, :]  # [B, S, 1, D/2]
+    s = jnp.take(sin, positions, axis=0)[:, :, None, :]
+    out_even = x_even * c - x_odd * s
+    out_odd = x_even * s + x_odd * c
+    # Re-interleave: stack on a trailing axis then flatten the last two.
+    out = jnp.stack([out_even, out_odd], axis=-1).reshape(x.shape)
+    return out.astype(orig_dtype)

@@ -1,0 +1,75 @@
+"""Token sampling: greedy, temperature, nucleus (top-p), top-k.
+
+The reference delegates sampling to HF ``FlaxGenerationMixin`` (its
+``generation.py:28-41`` passes ``GenerationConfig(do_sample=temperature!=0,
+temperature, top_p)``).  Here sampling is owned natively and fully jittable:
+all ops are shape-static so they live happily inside the decode
+``lax.while_loop``.
+
+Greedy-vs-sampled is decided at *trace* time (temperature is a Python float
+in the generation config, like the reference's ``do_sample`` derivation), so
+the greedy path compiles to a pure argmax with no RNG traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF
+
+
+def greedy(logits: jnp.ndarray) -> jnp.ndarray:
+    """Argmax over the vocab. logits: [..., V] -> int32 [...]."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
+    """Mask logits outside the nucleus: smallest set with cum-prob >= top_p.
+
+    Keeps at least one token.  logits: [..., V] fp32.
+    """
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # A sorted position is kept while the cumulative mass *before* it is < p.
+    keep_sorted = (cum - sorted_probs) < top_p
+    # Threshold logit = smallest kept logit; everything >= it is in the
+    # nucleus in original index space (ties conservatively included).  The
+    # minimum with the max logit guarantees the best token survives even at
+    # top_p == 0.0 (where keep_sorted is all-False and the min is +inf).
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    threshold = jnp.minimum(threshold, jnp.max(logits, axis=-1, keepdims=True))
+    return jnp.where(logits >= threshold, logits, NEG_INF)
+
+
+def top_k_filter(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """Mask all but the top_k logits. top_k is static."""
+    kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+    return jnp.where(logits >= kth, logits, NEG_INF)
+
+
+def sample(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    temperature: float = 1.0,
+    top_p: Optional[float] = None,
+    top_k: Optional[int] = None,
+) -> jnp.ndarray:
+    """Sample next tokens from [..., V] logits.
+
+    temperature/top_p/top_k are Python scalars (static): temperature == 0.0
+    selects the greedy path at trace time.
+    """
+    if temperature == 0.0:
+        return greedy(logits)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0:
+        logits = top_k_filter(logits, top_k)
+    if top_p is not None and top_p < 1.0:
+        logits = top_p_filter(logits, top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

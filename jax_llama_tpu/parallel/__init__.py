@@ -1,0 +1,19 @@
+from .mesh import (
+    AXES,
+    auto_mesh,
+    constrain,
+    current_mesh,
+    logical_to_spec,
+    make_mesh,
+    use_mesh,
+)
+
+__all__ = [
+    "AXES",
+    "auto_mesh",
+    "constrain",
+    "current_mesh",
+    "logical_to_spec",
+    "make_mesh",
+    "use_mesh",
+]

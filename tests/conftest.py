@@ -1,0 +1,29 @@
+"""Test environment: force CPU with 8 virtual devices BEFORE jax import.
+
+Mirrors the survey's test-plan recommendation (SURVEY.md §4): DP/TP/FSDP
+paths must be testable without TPU hardware via
+``--xla_force_host_platform_device_count``.
+"""
+
+import os
+
+# NOTE: this image's sitecustomize registers the axon TPU backend and forces
+# JAX_PLATFORMS=axon before conftest runs, so a plain env var is not enough —
+# jax.config.update after import is authoritative.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)

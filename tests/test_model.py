@@ -1,0 +1,99 @@
+"""Full-model parity vs the torch oracle at tiny config (tier-1 analogue of
+the reference's ``test_Transformer``, jax_test.py:316).  Also covers the
+scan-vs-unrolled stack equivalence and weight tying."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from jax_llama_tpu import config as cfg_lib
+from jax_llama_tpu.models import forward, init_params, param_count
+import torch_oracle as oracle
+
+CFG = cfg_lib.tiny()
+
+
+def _np_params(params):
+    return jax.tree.map(np.asarray, params)
+
+
+def test_forward_matches_torch_oracle():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    for trial in range(4):
+        rng = np.random.RandomState(trial)
+        tokens = rng.randint(0, CFG.vocab_size, size=(2, 12))
+        positions = np.tile(np.arange(12), (2, 1))
+        got, _ = forward(
+            params, jnp.asarray(tokens), jnp.asarray(positions), CFG
+        )
+        want = oracle.oracle_forward(_np_params(params), tokens, positions, CFG)
+        np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=1e-4)
+
+
+def test_forward_left_padding_matches_oracle():
+    params = init_params(jax.random.PRNGKey(1), CFG)
+    rng = np.random.RandomState(7)
+    tokens = rng.randint(0, CFG.vocab_size, size=(2, 10))
+    # Left-pad: first 3 (row 0) / 5 (row 1) tokens are padding.
+    positions = np.stack([
+        np.concatenate([-np.ones(3, int), np.arange(7)]),
+        np.concatenate([-np.ones(5, int), np.arange(5)]),
+    ])
+    got, _ = forward(params, jnp.asarray(tokens), jnp.asarray(positions), CFG)
+    want = oracle.oracle_forward(_np_params(params), tokens, positions, CFG)
+    # Compare only non-pad rows — pad-row outputs are don't-care.
+    mask = positions >= 0
+    np.testing.assert_allclose(
+        np.asarray(got)[mask], want[mask], atol=2e-4, rtol=1e-4
+    )
+    assert not np.isnan(np.asarray(got)).any(), "pad rows must not go NaN"
+
+
+def test_scan_and_unrolled_stacks_agree():
+    params = init_params(jax.random.PRNGKey(2), CFG)
+    tokens = jnp.asarray(np.random.randint(0, CFG.vocab_size, size=(1, 8)))
+    positions = jnp.arange(8)[None, :]
+    a, _ = forward(params, tokens, positions, CFG.replace(scan_layers=True))
+    b, _ = forward(params, tokens, positions, CFG.replace(scan_layers=False))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5)
+
+
+def test_tied_embeddings():
+    cfg = CFG.replace(tie_word_embeddings=True)
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    assert "lm_head" not in params
+    tokens = jnp.asarray([[1, 2, 3]])
+    positions = jnp.arange(3)[None, :]
+    logits, _ = forward(params, tokens, positions, cfg)
+    want = oracle.oracle_forward(_np_params(params), np.asarray(tokens), np.asarray(positions), cfg)
+    np.testing.assert_allclose(np.asarray(logits), want, atol=2e-4, rtol=1e-4)
+
+
+def test_remat_matches_baseline():
+    params = init_params(jax.random.PRNGKey(4), CFG)
+    tokens = jnp.asarray([[5, 6, 7, 8]])
+    positions = jnp.arange(4)[None, :]
+    a, _ = forward(params, tokens, positions, CFG)
+    b, _ = forward(params, tokens, positions, CFG.replace(remat=True))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_param_count_tiny():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    D, F, V, L = CFG.dim, CFG.ffn_dim, CFG.vocab_size, CFG.n_layers
+    H, KVH, hd = CFG.n_heads, CFG.kv_heads, CFG.head_dim
+    expect = (
+        V * D                                   # embed
+        + L * (2 * D)                           # norms
+        + L * (D * H * hd + 2 * D * KVH * hd + H * hd * D)  # attn
+        + L * (2 * D * F + F * D)               # mlp
+        + D                                     # final norm
+        + D * V                                 # lm head
+    )
+    assert param_count(params) == expect
+
+
+def test_gqa_group_validation():
+    with pytest.raises(AssertionError):
+        cfg_lib.tiny(n_heads=4, n_kv_heads=3).validate()

@@ -1,0 +1,134 @@
+"""Unit parity tests for core ops vs the independent torch oracle.
+
+Mirrors tier 1 of the reference test strategy (SURVEY.md §4 /
+``/root/reference/jax_test.py:528-592``): same random inputs into both
+implementations, tight fp32 tolerances.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+
+from jax_llama_tpu.ops import (
+    apply_rope,
+    attention_bias,
+    greedy,
+    repeat_kv,
+    rms_norm,
+    rope_table,
+    sdpa,
+    top_k_filter,
+    top_p_filter,
+)
+import torch_oracle as oracle
+
+TRIALS = 16
+
+
+def test_rms_norm_matches_oracle():
+    for _ in range(TRIALS):
+        x = np.random.randn(2, 5, 32).astype(np.float32)
+        scale = np.random.randn(32).astype(np.float32)
+        got = rms_norm(jnp.asarray(x), jnp.asarray(scale), 1e-5)
+        want = oracle.rms_norm(torch.from_numpy(x), torch.from_numpy(scale), 1e-5)
+        np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-5, rtol=1e-5)
+
+
+def test_rope_matches_complex_oracle():
+    hd, max_pos, theta = 16, 64, 10000.0
+    cos, sin = rope_table(hd, max_pos, theta)
+    freqs = oracle.rope_freqs_cis(hd, max_pos, theta)
+    for _ in range(TRIALS):
+        x = np.random.randn(2, 7, 4, hd).astype(np.float32)
+        pos = np.random.randint(0, max_pos, size=(2, 7))
+        got = apply_rope(jnp.asarray(x), cos, sin, jnp.asarray(pos))
+        want = oracle.apply_rope(
+            torch.from_numpy(x), freqs, torch.from_numpy(pos)
+        )
+        np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-5, rtol=1e-5)
+
+
+def test_rope_large_theta_llama3():
+    hd = 128
+    cos, sin = rope_table(hd, 256, 500000.0)
+    freqs = oracle.rope_freqs_cis(hd, 256, 500000.0)
+    x = np.random.randn(1, 9, 2, hd).astype(np.float32)
+    pos = np.arange(9)[None, :]
+    got = apply_rope(jnp.asarray(x), cos, sin, jnp.asarray(pos))
+    want = oracle.apply_rope(torch.from_numpy(x), freqs, torch.from_numpy(pos))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-5, rtol=1e-5)
+
+
+def test_repeat_kv():
+    x = np.random.randn(2, 3, 2, 4).astype(np.float32)
+    got = np.asarray(repeat_kv(jnp.asarray(x), 3))
+    want = torch.from_numpy(x).repeat_interleave(3, dim=2).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_sdpa_matches_manual_softmax_attention():
+    B, T, H, KVH, D = 2, 6, 4, 2, 8
+    q = np.random.randn(B, T, H, D).astype(np.float32)
+    k = np.random.randn(B, T, KVH, D).astype(np.float32)
+    v = np.random.randn(B, T, KVH, D).astype(np.float32)
+    pos = np.tile(np.arange(T), (B, 1))
+    bias = attention_bias(jnp.asarray(pos), jnp.asarray(pos))
+    got = np.asarray(sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), bias))
+
+    qt, kt, vt = map(torch.from_numpy, (q, k, v))
+    kt = kt.repeat_interleave(H // KVH, dim=2)
+    vt = vt.repeat_interleave(H // KVH, dim=2)
+    scores = torch.einsum("bthd,bshd->bhts", qt, kt) / np.sqrt(D)
+    causal = torch.tril(torch.ones(T, T, dtype=torch.bool))
+    scores = scores.masked_fill(~causal, float("-inf"))
+    want = torch.einsum("bhts,bshd->bthd", torch.softmax(scores, -1), vt).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_attention_bias_padding_slots_never_attended():
+    pos = jnp.asarray([[-1, -1, 0, 1]])
+    qpos = jnp.maximum(pos, 0)
+    slot_pos = jnp.where(pos >= 0, qpos, -1)
+    bias = attention_bias(qpos, slot_pos, slot_pos >= 0)
+    b = np.asarray(bias)[0, 0]  # [T, S]
+    assert (b[:, 0] < -1e30).all() and (b[:, 1] < -1e30).all()
+    # Every query row must still have at least one attendable slot (no NaN).
+    assert (b.max(axis=-1) == 0).all()
+
+
+def test_greedy():
+    logits = jnp.asarray([[0.1, 2.0, -1.0], [3.0, 0.0, 0.0]])
+    np.testing.assert_array_equal(np.asarray(greedy(logits)), [1, 0])
+
+
+def test_top_p_filter_keeps_nucleus():
+    # probs ~ [0.6, 0.3, 0.07, 0.03]; top_p=0.8 keeps the first two.
+    p = np.array([0.6, 0.3, 0.07, 0.03])
+    logits = jnp.asarray(np.log(p))[None, :]
+    out = np.asarray(top_p_filter(logits, 0.8))[0]
+    assert out[0] > -1e30 and out[1] > -1e30
+    assert out[2] < -1e30 and out[3] < -1e30
+
+
+def test_top_p_filter_always_keeps_best():
+    logits = jnp.asarray([[10.0, 0.0, -5.0]])
+    out = np.asarray(top_p_filter(logits, 0.01))[0]
+    assert out[0] > -1e30
+    assert out[1] < -1e30 and out[2] < -1e30
+
+
+def test_top_p_zero_keeps_best_token():
+    # Degenerate top_p=0.0 must still behave as greedy, not uniform-random.
+    logits = jnp.asarray([[1.0, 4.0, 2.0]])
+    out = np.asarray(top_p_filter(logits, 0.0))[0]
+    assert out[1] > -1e30
+    assert out[0] < -1e30 and out[2] < -1e30
+
+
+def test_top_k_filter():
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+    out = np.asarray(top_k_filter(logits, 2))[0]
+    assert out[1] > -1e30 and out[2] > -1e30
+    assert out[0] < -1e30 and out[3] < -1e30

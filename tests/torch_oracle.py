@@ -1,0 +1,88 @@
+"""Independent PyTorch oracle for numerical-parity tests.
+
+Plays the role Meta's ``llama`` repo plays for the reference test harness
+(``/root/reference/jax_test.py:9-18`` imports it as the parity oracle): a
+from-the-math torch implementation of the LLaMA architecture, written
+independently of both the reference and the JAX framework under test, fp32
+throughout.  It consumes the *same* param pytree layout as
+``jax_llama_tpu.models.llama`` (numpy arrays) so tests load identical weights
+into both sides.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import torch
+
+
+def rms_norm(x: torch.Tensor, scale: torch.Tensor, eps: float) -> torch.Tensor:
+    ms = x.pow(2).mean(-1, keepdim=True)
+    return x * torch.rsqrt(ms + eps) * scale
+
+
+def rope_freqs_cis(head_dim: int, max_pos: int, theta: float) -> torch.Tensor:
+    inv = 1.0 / (theta ** (torch.arange(0, head_dim, 2, dtype=torch.float64) / head_dim))
+    t = torch.arange(max_pos, dtype=torch.float64)
+    angles = torch.outer(t, inv)
+    return torch.polar(torch.ones_like(angles), angles).to(torch.complex64)
+
+
+def apply_rope(x: torch.Tensor, freqs_cis: torch.Tensor, positions: torch.Tensor) -> torch.Tensor:
+    """x: [B, S, H, D]; interleaved-pair complex rotation (Meta convention)."""
+    xc = torch.view_as_complex(x.float().reshape(*x.shape[:-1], -1, 2))
+    fc = freqs_cis[positions]  # [B, S, D/2]
+    out = torch.view_as_real(xc * fc[:, :, None, :]).flatten(-2)
+    return out.type_as(x)
+
+
+def oracle_forward(params, tokens: np.ndarray, positions: np.ndarray, cfg) -> np.ndarray:
+    """Full-model forward, no KV cache, fp32.  Returns [B, T, V] logits."""
+    t = lambda a: torch.from_numpy(np.asarray(a)).float()
+    tokens_t = torch.from_numpy(np.asarray(tokens)).long()
+    pos = torch.from_numpy(np.asarray(positions)).long()
+    mask_valid = pos >= 0
+    pos_c = pos.clamp(min=0)
+
+    B, T = tokens_t.shape
+    H, KVH, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    freqs = rope_freqs_cis(hd, 2 * cfg.max_seq_len, cfg.rope_theta)
+
+    x = t(params["embed"]["embedding"])[tokens_t]  # [B, T, D]
+
+    # Additive mask: slot j attendable by query i iff valid[j] and
+    # pos[j] <= pos[i] (matches the framework's position-based masking).
+    slot_pos = torch.where(mask_valid, pos_c, torch.full_like(pos, -1))
+    allowed = (slot_pos[:, None, :] >= 0) & (slot_pos[:, None, :] <= pos_c[:, :, None])
+    bias = torch.where(allowed, 0.0, torch.finfo(torch.float32).min)[:, None, :, :]
+
+    lp = params["layers"]
+    for i in range(cfg.n_layers):
+        h = rms_norm(x, t(lp["attn_norm"][i]), cfg.rms_norm_eps)
+        q = torch.einsum("btd,dhk->bthk", h, t(lp["q"][i]))
+        k = torch.einsum("btd,dhk->bthk", h, t(lp["k"][i]))
+        v = torch.einsum("btd,dhk->bthk", h, t(lp["v"][i]))
+        q = apply_rope(q, freqs, pos_c)
+        k = apply_rope(k, freqs, pos_c)
+        if KVH != H:
+            rep = H // KVH
+            k = k.repeat_interleave(rep, dim=2)
+            v = v.repeat_interleave(rep, dim=2)
+        scores = torch.einsum("bthk,bshk->bhts", q, k) / math.sqrt(hd)
+        scores = scores + bias
+        w = torch.softmax(scores, dim=-1)
+        attn = torch.einsum("bhts,bshk->bthk", w, v)
+        x = x + torch.einsum("bthk,hkd->btd", attn, t(lp["o"][i]))
+
+        h = rms_norm(x, t(lp["mlp_norm"][i]), cfg.rms_norm_eps)
+        gate = torch.nn.functional.silu(h @ t(lp["gate"][i]))
+        up = h @ t(lp["up"][i])
+        x = x + (gate * up) @ t(lp["down"][i])
+
+    x = rms_norm(x, t(params["final_norm"]), cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        kernel = t(params["embed"]["embedding"]).T
+    else:
+        kernel = t(params["lm_head"])
+    return (x @ kernel).numpy()
