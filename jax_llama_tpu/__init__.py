@@ -6,11 +6,17 @@ surface, re-expressed for the functional TPU-first design):
   Model:      LLaMAConfig, get_config, init_params, forward, KVCache,
               init_cache
   Parallel:   make_mesh, auto_mesh, use_mesh, constrain
+  Decode:     GenerationConfig, generate, LLaMA
+  Tokenizers: ByteTokenizer (vocab-file-free; LLaMA2/3 tokenizers in
+              jax_llama_tpu.tokenizers)
 """
 
 from .config import LLaMAConfig, get_config, swiglu_hidden_size
+from .engine import GenerationConfig, generate
+from .generation import LLaMA
 from .models import KVCache, forward, init_cache, init_params, param_count
 from .parallel import auto_mesh, constrain, make_mesh, use_mesh
+from .tokenizers import ByteTokenizer
 
 __version__ = "0.1.0"
 
@@ -18,6 +24,10 @@ __all__ = [
     "LLaMAConfig",
     "get_config",
     "swiglu_hidden_size",
+    "GenerationConfig",
+    "generate",
+    "LLaMA",
+    "ByteTokenizer",
     "KVCache",
     "forward",
     "init_cache",

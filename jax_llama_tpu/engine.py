@@ -1,0 +1,163 @@
+"""Autoregressive decode engine: jit-compiled prefill + lax.while_loop.
+
+This replaces the one piece the reference does NOT own — its decode loop
+lives in HF transformers' ``FlaxGenerationMixin`` (reference
+``generation.py:28`` delegates to ``model.generate``; SURVEY.md §1).  Here
+the whole pipeline — prefill, per-step sampling, stop-token handling, cache
+update — is a single jitted function built on ``lax.while_loop``, so the
+loop never leaves the device and XLA sees static shapes throughout.
+
+Shape discipline (the reference's recipe, kept):
+  * Prompts arrive **left-padded** to a common length P, so every row's last
+    prompt token sits in column P-1 and one gather serves the whole batch
+    (reference generation.py:55-57 left-pads with eos for the same reason).
+  * The token buffer is preallocated to P + max_new_tokens; the KV cache to
+    the same.  `cache.index + T <= max_len` is checked statically here —
+    `dynamic_update_slice` would clamp silently otherwise.
+  * Stop tokens are a static tuple (llama3 has two: end_of_text and eot_id,
+    reference llama3_tokenizer.py:91-94).  A stop token is written to the
+    buffer (so callers can see it), then the row emits pad_id forever.
+  * The while_loop exits early once every row has stopped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .config import LLaMAConfig
+from .models.llama import KVCache, forward, init_cache
+from .ops.sampling import sample
+from .parallel.mesh import use_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Static sampling/stopping policy (hashable — becomes part of the jit
+    cache key).  Surface parity with the reference's HF GenerationConfig use
+    (generation.py:28-41): num_beams=1, do_sample == (temperature != 0)."""
+
+    max_new_tokens: int = 256
+    temperature: float = 0.8
+    top_p: Optional[float] = 0.95
+    top_k: Optional[int] = None
+    stop_tokens: Tuple[int, ...] = ()
+    pad_id: int = 0
+
+
+def prompt_positions(prompt_mask: jnp.ndarray) -> jnp.ndarray:
+    """Left-padded prompt mask [B, P] (bool) -> absolute positions [B, P],
+    -1 on padding (parity: reference model.py:756-761 computes
+    cumsum(mask)-1; our -1 sentinel replaces its masked-out negatives)."""
+    pos = jnp.cumsum(prompt_mask.astype(jnp.int32), axis=-1) - 1
+    return jnp.where(prompt_mask, pos, -1)
+
+
+def _is_stop(tokens: jnp.ndarray, stop_tokens: Tuple[int, ...]) -> jnp.ndarray:
+    if not stop_tokens:
+        return jnp.zeros(tokens.shape, dtype=bool)
+    stops = jnp.asarray(stop_tokens, dtype=tokens.dtype)
+    return jnp.any(tokens[..., None] == stops, axis=-1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "gen_config", "mesh")
+)
+def generate(
+    params,
+    prompt_tokens: jnp.ndarray,
+    prompt_mask: jnp.ndarray,
+    rng: jax.Array,
+    *,
+    config: LLaMAConfig,
+    gen_config: GenerationConfig,
+    mesh=None,
+) -> jnp.ndarray:
+    """Generate up to ``max_new_tokens`` per row.
+
+    Args:
+      params: model params pytree.
+      prompt_tokens: [B, P] int32, left-padded.
+      prompt_mask: [B, P] bool, False on padding.
+      rng: PRNG key (unused when temperature == 0).
+      mesh: optional jax.sharding.Mesh for activation sharding constraints.
+        Passed explicitly (it is part of the jit cache key) — reading a
+        thread-local mesh during tracing would silently bake whatever mesh
+        happened to be active at first call into the compiled executable.
+    Returns:
+      [B, P + max_new_tokens] int32: the prompt (padding preserved) followed
+      by generated tokens; pad_id after a row's stop token.
+    """
+    with use_mesh(mesh):
+        return _generate_impl(
+            params, prompt_tokens, prompt_mask, rng, config, gen_config
+        )
+
+
+def _generate_impl(params, prompt_tokens, prompt_mask, rng, config, gc):
+    B, P = prompt_tokens.shape
+    total = P + gc.max_new_tokens
+    positions = prompt_positions(prompt_mask)
+    prompt_lens = jnp.sum(prompt_mask.astype(jnp.int32), axis=-1)  # [B]
+
+    cache = init_cache(config, B, max_len=total)
+    logits, cache = forward(
+        params, prompt_tokens, positions, config, cache=cache,
+        attn_mask=prompt_mask,
+    )
+    rng, sub = jax.random.split(rng)
+    next_tok = sample(
+        sub, logits[:, -1], gc.temperature, gc.top_p, gc.top_k
+    )  # [B]
+
+    buf = jnp.full((B, total), gc.pad_id, dtype=jnp.int32)
+    buf = lax.dynamic_update_slice(buf, prompt_tokens.astype(jnp.int32), (0, 0))
+
+    State = Tuple  # (step, buf, cache, rng, next_tok, done)
+    init_state = (
+        jnp.zeros((), jnp.int32), buf, cache, rng, next_tok,
+        jnp.zeros((B,), dtype=bool),
+    )
+
+    def cond(state: State):
+        step, _, _, _, _, done = state
+        return jnp.logical_and(step < gc.max_new_tokens, ~jnp.all(done))
+
+    def body(state: State):
+        step, buf, cache, rng, next_tok, done = state
+        tok = jnp.where(done, gc.pad_id, next_tok).astype(jnp.int32)
+        buf = lax.dynamic_update_slice(buf, tok[:, None], (0, P + step))
+        done = jnp.logical_or(done, _is_stop(next_tok, gc.stop_tokens))
+        rng, sub = jax.random.split(rng)
+
+        def step_fn(operand):
+            cache, sub = operand
+            pos = (prompt_lens + step)[:, None]  # [B, 1]
+            logits, cache = forward(
+                params, tok[:, None], pos, config, cache=cache,
+                attn_mask=jnp.ones((B, 1), dtype=bool),
+            )
+            nxt = sample(
+                sub, logits[:, -1], gc.temperature, gc.top_p, gc.top_k
+            )
+            return cache, nxt
+
+        def skip_fn(operand):
+            cache, _ = operand
+            return cache, next_tok
+
+        # Skip the model forward on the final iteration — its sampled token
+        # would be discarded (cond exits before it could be written).
+        will_continue = jnp.logical_and(
+            step + 1 < gc.max_new_tokens, ~jnp.all(done)
+        )
+        cache, nxt = lax.cond(will_continue, step_fn, skip_fn, (cache, sub))
+        return (step + 1, buf, cache, rng, nxt, done)
+
+    _, buf, _, _, _, _ = lax.while_loop(cond, body, init_state)
+    return buf

@@ -1,0 +1,3 @@
+from .bytes import ByteTokenizer
+
+__all__ = ["ByteTokenizer"]
