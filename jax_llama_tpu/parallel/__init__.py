@@ -7,6 +7,11 @@ from .mesh import (
     make_mesh,
     use_mesh,
 )
+from .partition import (
+    param_partition_specs,
+    shard_params,
+    validate_tp,
+)
 
 __all__ = [
     "AXES",
@@ -16,4 +21,7 @@ __all__ = [
     "logical_to_spec",
     "make_mesh",
     "use_mesh",
+    "param_partition_specs",
+    "shard_params",
+    "validate_tp",
 ]

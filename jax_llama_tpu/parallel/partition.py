@@ -1,0 +1,106 @@
+"""Parameter partition specs: tensor-parallel and FSDP sharding rules.
+
+Capability parity with the reference rule tables (``/root/reference/
+jax_llama/partition.py:43-78``): Megatron-style column-parallel shards on
+q/k/v/gate/up/lm_head, row-parallel on o/down, vocab-sharded embedding,
+replicated norms; the ``fsdp`` variant additionally shards the non-TP axis
+over the fsdp mesh axis (the reference defines the same table over ``dp``
+but never uses it — jax_example.py:25 hardcodes fsdp=False; here it is a
+first-class option).
+
+Because the param tree is structured (not a flat dict of dotted names),
+specs are written as a mirror-shaped pytree — no regex window-matching
+(reference partition.py:16-41) needed, and completeness is checked
+structurally rather than via runtime assert on a miss.
+
+Mesh axes are the canonical four from ``parallel.mesh``: data / fsdp / seq /
+tensor.  KV-head sharding requires ``tensor`` to divide ``n_kv_heads`` (GQA
+models: 8 for llama3) — checked in `validate_tp`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import LLaMAConfig
+
+
+def param_partition_specs(
+    config: LLaMAConfig, *, fsdp: bool = False
+) -> Dict[str, Any]:
+    """PartitionSpec pytree mirroring the `init_params` tree.
+
+    Layer params carry a leading stacked-L axis (never sharded — lax.scan
+    iterates it).  With ``fsdp=True`` the non-tensor-parallel dimension of
+    every projection is sharded over the ``fsdp`` axis (ZeRO-3-style).
+    """
+    f = "fsdp" if fsdp else None
+    specs: Dict[str, Any] = {
+        "embed": {"embedding": P("tensor", f)},      # vocab-sharded
+        "layers": {
+            "attn_norm": P(None, None),
+            "q": P(None, f, "tensor", None),         # column-parallel (heads)
+            "k": P(None, f, "tensor", None),
+            "v": P(None, f, "tensor", None),
+            "o": P(None, "tensor", None, f),         # row-parallel
+            "mlp_norm": P(None, None),
+            "gate": P(None, f, "tensor"),            # column-parallel
+            "up": P(None, f, "tensor"),
+            "down": P(None, "tensor", f),            # row-parallel
+        },
+        "final_norm": P(None),
+    }
+    if not config.tie_word_embeddings:
+        specs["lm_head"] = P(f, "tensor")            # column-parallel
+    return specs
+
+
+def validate_tp(config: LLaMAConfig, mesh: Mesh, *, fsdp: bool = False) -> None:
+    """Check mesh axes divide the dims they shard — a clear error here
+    beats the opaque one device_put raises mid-tree.
+
+    (The KV cache built inside the jitted decode needs no spec tree of its
+    own: its sharding propagates from the constrained k/v projections that
+    write it.)
+    """
+    tp = mesh.shape["tensor"]
+    if config.kv_heads % tp:
+        raise ValueError(
+            f"tensor={tp} must divide n_kv_heads={config.kv_heads} "
+            "(GQA KV cache is head-sharded)"
+        )
+    if config.n_heads % tp:
+        raise ValueError(f"tensor={tp} must divide n_heads={config.n_heads}")
+    if config.ffn_dim % tp:
+        raise ValueError(f"tensor={tp} must divide ffn_dim={config.ffn_dim}")
+    if config.vocab_size % tp:
+        raise ValueError(f"tensor={tp} must divide vocab={config.vocab_size}")
+    if fsdp:
+        fs = mesh.shape["fsdp"]
+        if config.dim % fs:
+            raise ValueError(f"fsdp={fs} must divide dim={config.dim}")
+        if config.ffn_dim % fs:
+            raise ValueError(f"fsdp={fs} must divide ffn_dim={config.ffn_dim}")
+
+
+def shard_params(
+    params: Any,
+    mesh: Mesh,
+    config: LLaMAConfig,
+    *,
+    fsdp: bool = False,
+) -> Any:
+    """Place a (host or device) param pytree onto the mesh.
+
+    The reference does the equivalent with per-leaf ``jax.device_put(leaf,
+    NamedSharding(mesh, spec))`` (jax_example.py:26); same mechanism here,
+    driven by the structured spec tree.
+    """
+    validate_tp(config, mesh, fsdp=fsdp)
+    specs = param_partition_specs(config, fsdp=fsdp)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )

@@ -1,0 +1,100 @@
+"""Sharding tests on the 8-virtual-device CPU mesh — the multi-device
+coverage the reference lacks entirely (SURVEY.md §4: JAX always runs
+single-process in the reference's harness)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from jax_llama_tpu import config as cfg_lib
+from jax_llama_tpu.engine import GenerationConfig, generate
+from jax_llama_tpu.models import forward, init_params
+from jax_llama_tpu.parallel import (
+    make_mesh,
+    param_partition_specs,
+    shard_params,
+    use_mesh,
+    validate_tp,
+)
+
+CFG = cfg_lib.tiny(max_seq_len=64)  # dim=32 H=4 KVH=2 vocab=256 ffn=96
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _forward_ref(params, tokens, positions):
+    logits, _ = forward(params, tokens, positions, CFG)
+    return np.asarray(logits)
+
+
+def test_spec_tree_mirrors_param_tree(params):
+    specs = param_partition_specs(CFG)
+    jax.tree.map(lambda p, s: None, params, specs)  # raises on mismatch
+
+
+def test_specs_cover_fsdp_variant(params):
+    specs = param_partition_specs(CFG, fsdp=True)
+    jax.tree.map(lambda p, s: None, params, specs)
+
+
+def test_tp_sharded_leaves(params):
+    mesh = make_mesh(tensor=2, data=4)
+    sharded = shard_params(params, mesh, CFG)
+    q = sharded["layers"]["q"]  # [L, D, H, hd] sharded on H over tensor=2
+    shard_shapes = {s.data.shape for s in q.addressable_shards}
+    assert shard_shapes == {(CFG.n_layers, CFG.dim, CFG.n_heads // 2, CFG.head_dim)}
+
+
+@pytest.mark.parametrize("axes", [dict(tensor=2, data=4),
+                                  dict(tensor=2, fsdp=2, data=2),
+                                  dict(fsdp=4, data=2)])
+def test_sharded_forward_matches_single_device(params, axes):
+    tokens = jnp.asarray(np.random.randint(0, CFG.vocab_size, (2, 10)))
+    positions = jnp.tile(jnp.arange(10)[None, :], (2, 1))
+    want = _forward_ref(params, tokens, positions)
+
+    mesh = make_mesh(**axes)
+    sharded = shard_params(params, mesh, CFG, fsdp="fsdp" in axes)
+    with use_mesh(mesh):
+        got = np.asarray(
+            jax.jit(lambda p, t, pos: forward(p, t, pos, CFG)[0])(
+                sharded, tokens, positions
+            )
+        )
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_generate_on_mesh_matches_single_device(params):
+    prompt = jnp.asarray([[5, 17, 200, 3]], dtype=jnp.int32)
+    mask = jnp.ones((1, 4), bool)
+    gc = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    want = np.asarray(generate(params, prompt, mask, jax.random.PRNGKey(0),
+                               config=CFG, gen_config=gc))
+    mesh = make_mesh(tensor=2, data=4)
+    sharded = shard_params(params, mesh, CFG)
+    got = np.asarray(generate(sharded, prompt, mask, jax.random.PRNGKey(0),
+                              config=CFG, gen_config=gc, mesh=mesh))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_validate_tp_rejects_bad_kv_split():
+    mesh = make_mesh(tensor=4, data=2)  # KVH=2 not divisible by 4
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        validate_tp(CFG, mesh)
+
+
+def test_batch_sharded_over_data_axis(params):
+    mesh = make_mesh(data=4, tensor=2)
+    sharded = shard_params(params, mesh, CFG)
+    tokens = jnp.asarray(np.random.randint(0, CFG.vocab_size, (8, 6)))
+    positions = jnp.tile(jnp.arange(6)[None, :], (8, 1))
+    with use_mesh(mesh):
+        logits = jax.jit(lambda p, t, pos: forward(p, t, pos, CFG)[0])(
+            sharded, tokens, positions
+        )
+    want = _forward_ref(params, tokens, positions)
+    np.testing.assert_allclose(np.asarray(logits), want, atol=1e-4, rtol=1e-4)
