@@ -1,3 +1,5 @@
 from .bytes import ByteTokenizer
+from .llama2 import Tokenizer as LLaMA2Tokenizer
+from .llama3 import ChatFormat, Tokenizer as LLaMA3Tokenizer
 
-__all__ = ["ByteTokenizer"]
+__all__ = ["ByteTokenizer", "LLaMA2Tokenizer", "LLaMA3Tokenizer", "ChatFormat"]
