@@ -9,6 +9,8 @@ surface, re-expressed for the functional TPU-first design):
   Decode:     GenerationConfig, generate, LLaMA
   Tokenizers: ByteTokenizer (vocab-file-free; LLaMA2/3 tokenizers in
               jax_llama_tpu.tokenizers)
+  Weights:    convert_meta_checkpoint, save_checkpoint, load_checkpoint
+              (jax_llama_tpu.convert; CLI: python -m jax_llama_tpu.convert)
 """
 
 from .config import LLaMAConfig, get_config, swiglu_hidden_size

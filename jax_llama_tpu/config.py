@@ -54,6 +54,7 @@ class LLaMAConfig:
     max_seq_len: int = 2048
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    use_scaled_rope: bool = False         # Llama-3.1 context-extension RoPE
     tie_word_embeddings: bool = False
 
     # --- numerics / execution policy (TPU-first) ---
@@ -165,6 +166,18 @@ def llama3_70b(**kw) -> LLaMAConfig:
     return LLaMAConfig(**base)
 
 
+def llama3_1_8b(**kw) -> LLaMAConfig:
+    base = dict(use_scaled_rope=True, max_seq_len=131072)
+    base.update(kw)
+    return llama3_8b(**base)
+
+
+def llama3_1_70b(**kw) -> LLaMAConfig:
+    base = dict(use_scaled_rope=True, max_seq_len=131072)
+    base.update(kw)
+    return llama3_70b(**base)
+
+
 PRESETS = {
     "tiny": tiny,
     "llama2-7b": llama2_7b,
@@ -172,6 +185,8 @@ PRESETS = {
     "llama2-70b": llama2_70b,
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
+    "llama3.1-8b": llama3_1_8b,
+    "llama3.1-70b": llama3_1_70b,
 }
 
 

@@ -1,0 +1,10 @@
+from .checkpoint import load_checkpoint, load_config, save_checkpoint
+from .meta import config_from_params_json, convert_meta_checkpoint
+
+__all__ = [
+    "convert_meta_checkpoint",
+    "config_from_params_json",
+    "save_checkpoint",
+    "load_checkpoint",
+    "load_config",
+]

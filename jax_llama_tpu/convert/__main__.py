@@ -1,0 +1,50 @@
+"""One-time offline conversion CLI: Meta torch checkpoint → Orbax.
+
+Fixes the reference's broken ``convert.sh`` workflow (its converter has no
+CLI and nothing ever serializes the converted weights — SURVEY.md §2.17):
+
+    python -m jax_llama_tpu.convert \
+        --ckpt-dir /path/to/Meta-Llama-3-8B \
+        --tokenizer /path/to/tokenizer.model \
+        --out-dir /path/to/llama3-8b-orbax \
+        [--max-seq-len 8192] [--dtype bfloat16]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ckpt-dir", required=True,
+                    help="directory with consolidated.*.pth + params.json")
+    ap.add_argument("--tokenizer", required=True,
+                    help="tokenizer.model path (tiktoken ranks for llama3, "
+                         "sentencepiece for llama2)")
+    ap.add_argument("--llama2", action="store_true",
+                    help="use the sentencepiece (llama2) tokenizer")
+    ap.add_argument("--out-dir", required=True)
+    ap.add_argument("--max-seq-len", type=int, default=2048)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32", "float16"])
+    args = ap.parse_args()
+
+    from . import convert_meta_checkpoint, save_checkpoint
+
+    if args.llama2:
+        from ..tokenizers import LLaMA2Tokenizer as Tok
+    else:
+        from ..tokenizers import LLaMA3Tokenizer as Tok
+    tokenizer = Tok(args.tokenizer)
+
+    params, config = convert_meta_checkpoint(
+        args.ckpt_dir, tokenizer,
+        max_seq_len=args.max_seq_len, dtype=args.dtype,
+    )
+    save_checkpoint(args.out_dir, params, config)
+    print(f"wrote {args.out_dir}: {config}")
+
+
+if __name__ == "__main__":
+    main()

@@ -157,8 +157,8 @@ def param_count(params: Params) -> int:
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=8)
-def _rope_tables(head_dim: int, max_positions: int, theta: float):
-    return rope_table(head_dim, max_positions, theta)
+def _rope_tables(head_dim: int, max_positions: int, theta: float, scaled: bool):
+    return rope_table(head_dim, max_positions, theta, use_scaled_rope=scaled)
 
 
 def _block(
@@ -271,7 +271,10 @@ def forward(
     max_positions = max(
         2 * config.max_seq_len, cache.max_len if cache is not None else 0
     )
-    cos, sin = _rope_tables(config.head_dim, max_positions, config.rope_theta)
+    cos, sin = _rope_tables(
+        config.head_dim, max_positions, config.rope_theta,
+        config.use_scaled_rope,
+    )
 
     x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(adt)
     x = constrain(x, "data", None, None)

@@ -22,10 +22,34 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def llama3_scale_inv_freq(
+    inv_freq: np.ndarray,
+    scale_factor: float = 8.0,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_len: int = 8192,
+) -> np.ndarray:
+    """Llama-3.1 frequency scaling for context extension (the published
+    ``use_scaled_rope`` rule): high-frequency components (short wavelengths)
+    are kept, low-frequency components are divided by ``scale_factor``, and
+    the band between is linearly interpolated in wavelength space."""
+    wavelen = 2.0 * np.pi / inv_freq
+    low_wl = original_max_len / low_freq_factor
+    high_wl = original_max_len / high_freq_factor
+    smooth = (original_max_len / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    mid = ((1.0 - smooth) / scale_factor + smooth) * inv_freq
+    out = np.where(wavelen > low_wl, inv_freq / scale_factor, inv_freq)
+    in_band = (wavelen <= low_wl) & (wavelen >= high_wl)
+    return np.where(in_band, mid, out)
+
+
 def rope_table(
     head_dim: int,
     max_positions: int,
     theta: float = 10000.0,
+    use_scaled_rope: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Precompute (cos, sin) tables, each [max_positions, head_dim // 2], fp32.
 
@@ -37,6 +61,8 @@ def rope_table(
     """
     assert head_dim % 2 == 0
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if use_scaled_rope:
+        inv_freq = llama3_scale_inv_freq(inv_freq)
     t = np.arange(max_positions, dtype=np.float64)
     angles = np.outer(t, inv_freq)  # [P, head_dim/2]
     return (

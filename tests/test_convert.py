@@ -1,0 +1,206 @@
+"""Converter + checkpoint tests: a synthetic 2-shard Meta-format checkpoint
+(torch .pth, Megatron column/row splits) is converted and must reproduce the
+oracle forward; Orbax roundtrip with and without mesh sharding."""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+
+from jax_llama_tpu import config as cfg_lib
+from jax_llama_tpu.convert import (
+    convert_meta_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from jax_llama_tpu.models import forward, init_params
+from jax_llama_tpu.parallel import make_mesh, use_mesh
+import torch_oracle as oracle
+
+# Synthetic model geometry (full, unsharded).
+DIM, LAYERS, HEADS, KVH, VOCAB, MULT = 16, 2, 4, 2, 64, 16
+HD = DIM // HEADS
+CFG_KW = dict(dim=DIM, n_layers=LAYERS, n_heads=HEADS)
+
+
+def _make_meta_ckpt(tmp_path, n_shards=2, with_output=True):
+    """Build full random Meta-layout tensors, split them Megatron-style
+    across shards, and torch.save each shard."""
+    rng = np.random.RandomState(0)
+    ffn = cfg_lib.swiglu_hidden_size(DIM, MULT)
+    full = {"tok_embeddings.weight": rng.randn(VOCAB, DIM).astype(np.float32),
+            "norm.weight": rng.randn(DIM).astype(np.float32)}
+    if with_output:
+        full["output.weight"] = rng.randn(VOCAB, DIM).astype(np.float32)
+    for l in range(LAYERS):
+        p = f"layers.{l}."
+        full[p + "attention.wq.weight"] = rng.randn(HEADS * HD, DIM).astype(np.float32)
+        full[p + "attention.wk.weight"] = rng.randn(KVH * HD, DIM).astype(np.float32)
+        full[p + "attention.wv.weight"] = rng.randn(KVH * HD, DIM).astype(np.float32)
+        full[p + "attention.wo.weight"] = rng.randn(DIM, HEADS * HD).astype(np.float32)
+        full[p + "feed_forward.w1.weight"] = rng.randn(ffn, DIM).astype(np.float32)
+        full[p + "feed_forward.w2.weight"] = rng.randn(DIM, ffn).astype(np.float32)
+        full[p + "feed_forward.w3.weight"] = rng.randn(ffn, DIM).astype(np.float32)
+        full[p + "attention_norm.weight"] = rng.randn(DIM).astype(np.float32)
+        full[p + "ffn_norm.weight"] = rng.randn(DIM).astype(np.float32)
+
+    col_keys = ("wq", "wk", "wv", "w1", "w3", "output")
+    row_keys = ("wo", "w2", "tok_embeddings")
+    for s in range(n_shards):
+        shard = {}
+        for key, arr in full.items():
+            if any(k in key for k in col_keys):
+                shard[key] = torch.from_numpy(
+                    np.split(arr, n_shards, axis=0)[s].copy())
+            elif any(k in key for k in row_keys):
+                shard[key] = torch.from_numpy(
+                    np.split(arr, n_shards, axis=1)[s].copy())
+            else:  # norms replicated
+                shard[key] = torch.from_numpy(arr.copy())
+        torch.save(shard, tmp_path / f"consolidated.{s:02d}.pth")
+
+    (tmp_path / "params.json").write_text(json.dumps({
+        "dim": DIM, "n_layers": LAYERS, "n_heads": HEADS, "n_kv_heads": KVH,
+        "multiple_of": MULT, "norm_eps": 1e-5, "rope_theta": 10000.0,
+        "vocab_size": -1,
+    }))
+    return full
+
+
+class _FakeTok:
+    def __len__(self):
+        return VOCAB
+
+
+def test_convert_matches_oracle_forward(tmp_path):
+    _make_meta_ckpt(tmp_path)
+    params, config = convert_meta_checkpoint(
+        tmp_path, _FakeTok(), max_seq_len=64, dtype="float32"
+    )
+    assert config.dim == DIM and config.n_layers == LAYERS
+    assert config.kv_heads == KVH and config.vocab_size == VOCAB
+    assert not config.tie_word_embeddings
+
+    cfg = config.replace(dtype="float32")
+    tokens = np.random.RandomState(1).randint(0, VOCAB, (2, 8))
+    positions = np.tile(np.arange(8), (2, 1))
+    got, _ = forward(params, jnp.asarray(tokens), jnp.asarray(positions), cfg)
+    want = oracle.oracle_forward(params, tokens, positions, cfg)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=1e-4)
+
+
+def test_convert_shard_reassembly_exact(tmp_path):
+    full = _make_meta_ckpt(tmp_path, n_shards=2)
+    params, config = convert_meta_checkpoint(
+        tmp_path, vocab_size=VOCAB, dtype="float32"
+    )
+    # wq of layer 0: concat shards on axis 0, transpose, reshape to heads.
+    want_q = full["layers.0.attention.wq.weight"].T.reshape(DIM, HEADS, HD)
+    np.testing.assert_array_equal(params["layers"]["q"][0], want_q)
+    want_o = full["layers.0.attention.wo.weight"].T.reshape(HEADS, HD, DIM)
+    np.testing.assert_array_equal(params["layers"]["o"][0], want_o)
+    np.testing.assert_array_equal(
+        params["embed"]["embedding"], full["tok_embeddings.weight"]
+    )
+    np.testing.assert_array_equal(
+        params["lm_head"], full["output.weight"].T
+    )
+
+
+def test_convert_vocab_parallel_embedding(tmp_path):
+    """Llama-3 layout: tok_embeddings split on the vocab axis."""
+    full = _make_meta_ckpt(tmp_path, n_shards=2)
+    # Rewrite shards with the embedding split on axis 0 instead of axis 1.
+    for s in range(2):
+        p = tmp_path / f"consolidated.{s:02d}.pth"
+        sd = torch.load(p, weights_only=True)
+        sd["tok_embeddings.weight"] = torch.from_numpy(
+            np.split(full["tok_embeddings.weight"], 2, axis=0)[s].copy()
+        )
+        torch.save(sd, p)
+    params, _ = convert_meta_checkpoint(
+        tmp_path, vocab_size=VOCAB, dtype="float32"
+    )
+    np.testing.assert_array_equal(
+        params["embed"]["embedding"], full["tok_embeddings.weight"]
+    )
+
+
+def test_convert_rejects_unknown_arch_keys(tmp_path):
+    _make_meta_ckpt(tmp_path)
+    pj = json.loads((tmp_path / "params.json").read_text())
+    pj["quantization_scheme"] = "fp8"
+    (tmp_path / "params.json").write_text(json.dumps(pj))
+    with pytest.raises(ValueError, match="quantization_scheme"):
+        convert_meta_checkpoint(tmp_path, vocab_size=VOCAB)
+
+
+def test_convert_consumes_use_scaled_rope(tmp_path):
+    _make_meta_ckpt(tmp_path)
+    pj = json.loads((tmp_path / "params.json").read_text())
+    pj["use_scaled_rope"] = True
+    (tmp_path / "params.json").write_text(json.dumps(pj))
+    _, config = convert_meta_checkpoint(tmp_path, vocab_size=VOCAB)
+    assert config.use_scaled_rope
+
+
+def test_convert_fp32_keeps_fp32_compute(tmp_path):
+    _make_meta_ckpt(tmp_path)
+    _, config = convert_meta_checkpoint(
+        tmp_path, vocab_size=VOCAB, dtype="float32"
+    )
+    assert config.dtype == "float32" and config.param_dtype == "float32"
+
+
+def test_convert_single_shard_and_tied(tmp_path):
+    _make_meta_ckpt(tmp_path, n_shards=1, with_output=False)
+    params, config = convert_meta_checkpoint(
+        tmp_path, vocab_size=VOCAB, dtype="float32"
+    )
+    assert config.tie_word_embeddings
+    assert "lm_head" not in params
+
+
+def test_convert_bf16_dtype(tmp_path):
+    _make_meta_ckpt(tmp_path)
+    params, _ = convert_meta_checkpoint(tmp_path, vocab_size=VOCAB)
+    assert params["layers"]["q"].dtype == jnp.bfloat16
+    assert params["embed"]["embedding"].dtype == jnp.bfloat16
+
+
+def test_orbax_roundtrip(tmp_path):
+    cfg = cfg_lib.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(ckpt, params, cfg)
+    restored, rcfg = load_checkpoint(ckpt)
+    assert rcfg == cfg
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, restored,
+    )
+
+
+def test_orbax_sharded_restore(tmp_path):
+    cfg = cfg_lib.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(ckpt, params, cfg)
+    mesh = make_mesh(tensor=2, data=4)
+    restored, rcfg = load_checkpoint(ckpt, mesh=mesh)
+    q = restored["layers"]["q"]
+    shard_shapes = {s.data.shape for s in q.addressable_shards}
+    assert shard_shapes == {
+        (cfg.n_layers, cfg.dim, cfg.n_heads // 2, cfg.head_dim)
+    }
+    # Restored-sharded forward == original.
+    tokens = jnp.asarray([[1, 2, 3, 4]])
+    pos = jnp.arange(4)[None, :]
+    with use_mesh(mesh):
+        got = np.asarray(jax.jit(
+            lambda p, t, q_: forward(p, t, q_, cfg)[0])(restored, tokens, pos))
+    want, _ = forward(params, tokens, pos, cfg)
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-5)

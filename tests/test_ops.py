@@ -61,6 +61,28 @@ def test_rope_large_theta_llama3():
     np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-5, rtol=1e-5)
 
 
+def test_llama31_scaled_rope():
+    from jax_llama_tpu.ops.rope import llama3_scale_inv_freq
+
+    hd, theta = 128, 500000.0
+    inv = 1.0 / (theta ** (np.arange(0, hd, 2) / hd))
+    scaled = llama3_scale_inv_freq(inv)
+    wavelen = 2 * np.pi / inv
+    # High-frequency (short wavelength) components unchanged.
+    hi = wavelen < 8192 / 4
+    np.testing.assert_array_equal(scaled[hi], inv[hi])
+    # Low-frequency components divided by the 8x scale factor.
+    lo = wavelen > 8192 / 1
+    np.testing.assert_allclose(scaled[lo], inv[lo] / 8.0)
+    # Band in between interpolates monotonically between the two regimes.
+    mid = ~(hi | lo)
+    assert ((scaled[mid] >= inv[mid] / 8.0) & (scaled[mid] <= inv[mid])).all()
+    # And the table plumbing: scaled table differs from unscaled.
+    c0, _ = rope_table(hd, 32, theta)
+    c1, _ = rope_table(hd, 32, theta, use_scaled_rope=True)
+    assert not np.allclose(c0, c1)
+
+
 def test_repeat_kv():
     x = np.random.randn(2, 3, 2, 4).astype(np.float32)
     got = np.asarray(repeat_kv(jnp.asarray(x), 3))
