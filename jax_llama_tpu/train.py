@@ -1,0 +1,114 @@
+"""Training: LM loss + optax train step, mesh-shardable.
+
+The reference is inference-only (SURVEY.md: no optimizer, no training loop;
+its ``gradient_checkpointing`` flag exists but nothing exercises it).  This
+framework makes training a first-class capability: a masked next-token
+cross-entropy loss and a jitted ``train_step`` that runs under any
+data/fsdp/tensor mesh — gradients and optimizer states inherit the param
+shardings, XLA inserts the DP/FSDP collectives.  ``config.remat=True``
+enables per-block rematerialization (jax.checkpoint) for memory-bound
+training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .config import LLaMAConfig
+from .models.llama import forward
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["params", "opt_state", "step"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def make_optimizer(
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 1.0,
+    warmup_steps: int = 0,
+    total_steps: Optional[int] = None,
+) -> optax.GradientTransformation:
+    """AdamW with the usual LLM hyperparameters: global-norm clipping and an
+    optional linear-warmup + cosine-decay schedule."""
+    if warmup_steps or total_steps:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=learning_rate,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=max(total_steps or warmup_steps * 10, 2),
+        )
+    else:
+        schedule = learning_rate
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def init_train_state(params: Any, optimizer: optax.GradientTransformation) -> TrainState:
+    return TrainState(
+        params=params,
+        opt_state=optimizer.init(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def lm_loss(
+    params: Any,
+    tokens: jnp.ndarray,
+    config: LLaMAConfig,
+    loss_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Masked next-token cross-entropy.
+
+    tokens: [B, T] int32; position t predicts token t+1.
+    loss_mask: optional [B, T] bool — True where the *target* token counts
+      (defaults to all positions).
+    """
+    B, T = tokens.shape
+    inputs = tokens[:, :-1]
+    targets = tokens[:, 1:]
+    positions = jnp.broadcast_to(jnp.arange(T - 1, dtype=jnp.int32), (B, T - 1))
+    logits, _ = forward(params, inputs, positions, config)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[:, :, None], axis=-1)[..., 0]
+    if loss_mask is not None:
+        m = loss_mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(nll)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "optimizer"), donate_argnames=("state",))
+def train_step(
+    state: TrainState,
+    tokens: jnp.ndarray,
+    config: LLaMAConfig,
+    optimizer: optax.GradientTransformation,
+    loss_mask: Optional[jnp.ndarray] = None,
+) -> Tuple[TrainState, jnp.ndarray]:
+    """One optimizer step.  `optimizer` must be a hashable static (module-
+    level) GradientTransformation; under a mesh the donated state keeps
+    params/opt-state sharded in place."""
+    loss, grads = jax.value_and_grad(lm_loss)(
+        state.params, tokens, config, loss_mask
+    )
+    updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss

@@ -1,0 +1,32 @@
+"""Driver-contract smoke tests for __graft_entry__ (CPU, 8 virtual devs)."""
+
+import sys
+from pathlib import Path
+
+import jax
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft
+
+
+def test_entry_returns_jittable_fn():
+    fn, args = graft.entry()
+    # Validate traceability/shapes without paying a full CPU execution.
+    out = jax.eval_shape(fn, *args)
+    params, tokens, positions = args
+    assert out.shape == (*tokens.shape, 32000)
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_mesh_factors():
+    assert graft._mesh_factors(8) == (2, 2, 2)
+    assert graft._mesh_factors(4) == (1, 2, 2)
+    assert graft._mesh_factors(2) == (1, 1, 2)
+    assert graft._mesh_factors(1) == (1, 1, 1)
+    for n in (1, 2, 4, 6, 8, 16):
+        d, f, t = graft._mesh_factors(n)
+        assert d * f * t == n
