@@ -28,10 +28,13 @@ def main() -> None:
     import jax_llama_tpu as jlt
     from jax_llama_tpu.engine import GenerationConfig, generate
 
+    # param_dtype bf16: decode is HBM-bandwidth-bound, so serving keeps
+    # weights in bf16 (2 bytes/param of traffic per step, not 4).
     config = jlt.get_config(
         "llama3-8b",
         dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
         multiple_of=256, vocab_size=32000, max_seq_len=1024,
+        param_dtype="bfloat16",
     )
     params = jlt.init_params(jax.random.PRNGKey(0), config)
     n_params = jlt.param_count(params)
@@ -48,7 +51,11 @@ def main() -> None:
         )
         t0 = time.time()
         out = generate(params, tokens, mask, key, config=config, gen_config=gc)
-        jax.block_until_ready(out)
+        # Sync via host transfer, NOT block_until_ready: under the axon
+        # tunnel backend block_until_ready/effects_barrier return while the
+        # computation is still in flight, and the [B, P+N] int32 fetch is
+        # a few KB — negligible vs the decode itself.
+        np.asarray(out)
         return time.time() - t0
 
     t0 = time.time()
@@ -64,12 +71,17 @@ def main() -> None:
     decode_s = max(full - short, 1e-9)
     toks_per_s = B * (N - 1) / decode_s
 
+    # BASELINE.json's 50 tok/s/chip target is stated for Llama-3-70B on
+    # v5p; decode is HBM-bandwidth-bound, so scale the per-chip target by
+    # the param ratio to get an honest denominator for this bench model
+    # rather than pretending a ~1B model beat a 70B target.
+    target = 50.0 * (70e9 / n_params)
     result = {
         "metric": "steady-state greedy decode throughput, ~1B Llama-3-arch "
                   f"bf16, batch {B}, prompt {P}, gen {N}, single chip",
         "value": round(toks_per_s, 2),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(toks_per_s / 50.0, 3),
+        "vs_baseline": round(toks_per_s / target, 3),
         "detail": {
             "params": n_params,
             "backend": jax.default_backend(),

@@ -62,7 +62,8 @@ class LLaMAConfig:
     param_dtype: str = "float32"          # parameter storage dtype
     scan_layers: bool = True              # lax.scan over stacked layers
     remat: bool = False                   # jax.checkpoint each block
-    attn_impl: str = "xla"                # "xla" | "flash" (Pallas)
+    attn_impl: str = "xla"                # "xla" | "flash" (Pallas) | "ring"
+                                          #   (seq-parallel ring attention)
     attn_softmax_dtype: str = "float32"   # fp32 softmax island
     logits_dtype: str = "float32"         # fp32 logits island
 

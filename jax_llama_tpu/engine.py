@@ -93,6 +93,14 @@ def generate(
       [B, P + max_new_tokens] int32: the prompt (padding preserved) followed
       by generated tokens; pad_id after a row's stop token.
     """
+    from .parallel.mesh import current_mesh
+
+    if mesh is None and current_mesh() is not None:
+        raise ValueError(
+            "generate: pass mesh= explicitly (it is part of the jit cache "
+            "key); an ambient use_mesh(...) context is not seen by the "
+            "compiled executable on later calls"
+        )
     with use_mesh(mesh):
         return _generate_impl(
             params, prompt_tokens, prompt_mask, rng, config, gen_config

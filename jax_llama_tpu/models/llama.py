@@ -47,6 +47,7 @@ from jax import lax
 
 from ..config import LLaMAConfig
 from ..ops.attention import attention_bias, sdpa
+from ..ops.flash_attention import flash_attention
 from ..ops.norm import rms_norm
 from ..ops.rope import apply_rope, rope_table
 from ..parallel.mesh import constrain
@@ -169,7 +170,8 @@ def _block(
     *,
     config: LLaMAConfig,
     positions: jnp.ndarray,
-    bias: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    slot_pos: jnp.ndarray,
     cache_index: Optional[jnp.ndarray],
     cos: jnp.ndarray,
     sin: jnp.ndarray,
@@ -183,50 +185,54 @@ def _block(
     q = jnp.einsum("btd,dhk->bthk", h, lp["q"].astype(adt))
     k = jnp.einsum("btd,dhk->bthk", h, lp["k"].astype(adt))
     v = jnp.einsum("btd,dhk->bthk", h, lp["v"].astype(adt))
-    q = constrain(q, "data", None, "tensor", None)
-    k = constrain(k, "data", None, "tensor", None)
-    v = constrain(v, "data", None, "tensor", None)
+    q = constrain(q, "data", "seq", "tensor", None)
+    k = constrain(k, "data", "seq", "tensor", None)
+    v = constrain(v, "data", "seq", "tensor", None)
 
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
 
     softmax_dtype = jnp.dtype(config.attn_softmax_dtype)
-    if config.attn_impl not in ("xla",):
-        raise NotImplementedError(
-            f"attn_impl={config.attn_impl!r} (flash kernel lands with "
-            "ops/flash_attention)"
-        )
+    if config.attn_impl not in ("xla", "flash", "ring"):
+        raise NotImplementedError(f"attn_impl={config.attn_impl!r}")
     if cache_k is not None:
         # Write the T new KV entries at [cache_index, cache_index+T), then
         # attend over the full fixed-size cache.  GQA replication happens
-        # inside sdpa, *after* the cache — the cache stores only KVH heads
-        # (parity with reference model.py:269-270).
+        # inside the attention op, *after* the cache — the cache stores only
+        # KVH heads (parity with reference model.py:269-270).
         cache_k = lax.dynamic_update_slice(
             cache_k, k.astype(cache_k.dtype), (0, cache_index, 0, 0)
         )
         cache_v = lax.dynamic_update_slice(
             cache_v, v.astype(cache_v.dtype), (0, cache_index, 0, 0)
         )
-        attn = sdpa(
-            q, cache_k.astype(adt), cache_v.astype(adt), bias,
-            softmax_dtype=softmax_dtype,
-        )
+        kk, vv = cache_k.astype(adt), cache_v.astype(adt)
     else:
-        attn = sdpa(q, k, v, bias, softmax_dtype=softmax_dtype)
+        kk, vv = k, v
+    if config.attn_impl == "ring" and cache_k is None:
+        # Sequence-parallel path (training / scoring / cache-free prefill):
+        # ring over the seq mesh axis.
+        from ..parallel.ring import ring_sdpa
+
+        attn = ring_sdpa(q, kk, vv, positions, slot_pos)
+    elif config.attn_impl in ("flash", "ring"):
+        attn = flash_attention(q, kk, vv, positions, slot_pos)
+    else:
+        attn = sdpa(q, kk, vv, bias, softmax_dtype=softmax_dtype)
 
     attn_out = jnp.einsum("bthk,hkd->btd", attn, lp["o"].astype(adt))
-    attn_out = constrain(attn_out, "data", None, None)
+    attn_out = constrain(attn_out, "data", "seq", None)
     x = x + attn_out
 
     # --- SwiGLU MLP ---
     h = rms_norm(x, lp["mlp_norm"], config.rms_norm_eps)
     gate = jnp.einsum("btd,df->btf", h, lp["gate"].astype(adt))
     up = jnp.einsum("btd,df->btf", h, lp["up"].astype(adt))
-    gate = constrain(gate, "data", None, "tensor")
-    up = constrain(up, "data", None, "tensor")
+    gate = constrain(gate, "data", "seq", "tensor")
+    up = constrain(up, "data", "seq", "tensor")
     hidden = jax.nn.silu(gate) * up
     down = jnp.einsum("btf,fd->btd", hidden, lp["down"].astype(adt))
-    down = constrain(down, "data", None, None)
+    down = constrain(down, "data", "seq", None)
     x = x + down
     return x, cache_k, cache_v
 
@@ -261,6 +267,20 @@ def forward(
     """
     B, T = tokens.shape
     adt = config.activation_dtype
+    if cache is not None and config.attn_impl == "ring":
+        # Decode-over-cache under a real seq axis would need a seq-sharded
+        # KV cache; refuse loudly rather than silently gathering the full
+        # cache per device (cache-free ring forward is the supported
+        # sequence-parallel path).
+        from ..parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+        if mesh is not None and mesh.shape.get("seq", 1) > 1:
+            raise NotImplementedError(
+                "attn_impl='ring' does not support KV-cache decode on a "
+                "mesh with seq > 1; use a seq=1 mesh for generation or "
+                "the cache-free forward for sequence-parallel scoring"
+            )
     if attn_mask is None:
         attn_mask = positions >= 0
     q_positions = jnp.maximum(positions, 0)
@@ -277,9 +297,12 @@ def forward(
     )
 
     x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(adt)
-    x = constrain(x, "data", None, None)
+    x = constrain(x, "data", "seq", None)
 
-    # Attention bias is layer-independent: compute once, close over it.
+    # Slot positions / masking state are layer-independent: compute once,
+    # close over them.  The dense [B,1,T,S] bias is only materialized on the
+    # XLA reference path — the flash kernel recomputes masks blockwise from
+    # the positions and never holds an S×S buffer.
     new_slot_pos = jnp.where(attn_mask, q_positions, -1).astype(jnp.int32)
     if cache is not None:
         slot_pos = lax.dynamic_update_slice(
@@ -287,13 +310,17 @@ def forward(
         )
     else:
         slot_pos = new_slot_pos
-    bias = attention_bias(q_positions, slot_pos, slot_pos >= 0)
+    if config.attn_impl in ("flash", "ring"):
+        bias = None
+    else:
+        bias = attention_bias(q_positions, slot_pos, slot_pos >= 0)
 
     block = functools.partial(
         _block,
         config=config,
         positions=q_positions,
         bias=bias,
+        slot_pos=slot_pos,
         cache_index=cache.index if cache is not None else None,
         cos=cos,
         sin=sin,
@@ -339,7 +366,7 @@ def forward(
         "btd,dv->btv", x, kernel.astype(adt),
         preferred_element_type=jnp.dtype(config.logits_dtype),
     ).astype(config.logits_dtype)
-    logits = constrain(logits, "data", None, "tensor")
+    logits = constrain(logits, "data", "seq", "tensor")
 
     if cache is not None:
         new_cache = KVCache(

@@ -1,9 +1,11 @@
 from .norm import rms_norm
 from .rope import rope_table, apply_rope
 from .attention import sdpa, repeat_kv, attention_bias, NEG_INF
+from .flash_attention import flash_attention
 from .sampling import sample, greedy, top_p_filter, top_k_filter
 
 __all__ = [
+    "flash_attention",
     "rms_norm",
     "rope_table",
     "apply_rope",

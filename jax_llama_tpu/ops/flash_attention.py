@@ -1,0 +1,247 @@
+"""Pallas TPU flash attention — blockwise online-softmax, GQA-aware.
+
+This is the long-context answer to the reference's O(S²) attention (the
+reference materializes a ``[1,1,S,S]`` causal mask at module setup,
+``/root/reference/jax_llama/model.py:154``, and full ``[B,H,S,S]`` attention
+weights, model.py:277-288).  Here scores only ever exist one
+``[block_q, block_k]`` tile at a time in VMEM; masking is recomputed from
+absolute positions inside the kernel, so memory is O(S·d) and sequence
+length is bounded by HBM, not by the S×S buffer.
+
+Algorithm: standard flash attention (online softmax).  Grid is
+``(batch, q_heads, q_blocks, k_blocks)`` with the k axis innermost — TPU
+executes the grid sequentially, so VMEM scratch (running max ``m``, running
+denominator ``l``, fp32 accumulator ``acc``) persists across the k-block
+sweep of each q block.  The output tile is written once, on the last
+k step.
+
+Masking is positional, matching ``ops.attention.attention_bias``:
+a kv slot is attendable iff ``kv_pos <= q_pos`` (causality) and
+``kv_pos >= 0`` (-1 marks padding / unwritten cache slots).  GQA is folded
+into the index map — query head ``h`` reads KV head ``h // group`` — so KV
+blocks are never replicated in memory (parity with the reference's
+repeat-after-cache semantics, model.py:269-270, with zero copies).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Finite stand-in for -inf: fully-masked tiles then accumulate a bogus-but-
+# finite (l, acc) that the online-softmax rescale zeroes out the moment a
+# real score arrives (exp(MASK - real) == 0), and rows that stay fully
+# masked divide by a nonzero l instead of producing NaN.
+MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+_LANES = 128  # TPU lane width
+_SUBLANES = 8  # TPU sublane width (fp32/int32)
+
+
+def _flash_kernel(
+    q_pos_ref,  # [1, bq, LANES] int32 (lane-replicated)
+    kv_pos_ref,  # [1, SUBLANES, bk] int32 (sublane-replicated)
+    q_ref,  # [1, 1, bq, d]
+    k_ref,  # [1, 1, bk, d]
+    v_ref,  # [1, 1, bk, d]
+    o_ref,  # [1, 1, bq, d]
+    m_ref,  # [bq, LANES] f32 scratch — running row max
+    l_ref,  # [bq, LANES] f32 scratch — running row denominator
+    acc_ref,  # [bq, d] f32 scratch — running weighted-V accumulator
+    *,
+    scale: float,
+):
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, MASK_VALUE)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0]  # [bq, d]
+    k = k_ref[0, 0]  # [bk, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [bq, bk]
+
+    # Positions arrive replicated across lanes/sublanes (Mosaic's last-two-
+    # dims tiling rules reject narrow int vectors); slice one copy each.
+    qp = q_pos_ref[0, :, :1]  # [bq, 1]
+    kp = kv_pos_ref[0, :1, :]  # [1, bk]
+    allowed = (kp <= qp) & (kp >= 0)
+    s = jnp.where(allowed, s, MASK_VALUE)
+
+    m_prev = m_ref[:, :1]  # [bq, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)  # [bq, 1] rescale of old state
+    p = jnp.exp(s - m_new)  # [bq, bk]
+
+    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    kv_pos: jnp.ndarray,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Blockwise attention; drop-in for ``ops.attention.sdpa`` + bias.
+
+    Differentiable: the forward runs the Pallas kernel; the backward
+    recomputes attention densely and differentiates that (O(T·S) scores in
+    the backward only — fine at training context lengths; sequence-parallel
+    ring attention is the long-context training path, and a Pallas backward
+    kernel can replace this without API change).
+
+    Args:
+      q: [B, T, H, d].
+      k, v: [B, S, KVH, d], H % KVH == 0 (GQA).
+      q_pos: [B, T] int32 absolute query positions (pre-clamped >= 0).
+      kv_pos: [B, S] int32 kv slot positions, -1 for padding/unwritten.
+      block_q, block_k: tile sizes (clamped to T / S).
+    Returns:
+      [B, T, H, d] in q.dtype.
+    """
+    return _flash(q, k, v, q_pos, kv_pos, block_q, block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, q_pos, kv_pos, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
+    out = _flash_forward(
+        q, k, v, q_pos, kv_pos, block_q, block_k, interpret
+    )
+    return out, (q, k, v, q_pos, kv_pos)
+
+
+def _flash_bwd(block_q, block_k, interpret, res, g):
+    from .attention import attention_bias, sdpa
+
+    q, k, v, q_pos, kv_pos = res
+
+    def dense(q, k, v):
+        return sdpa(q, k, v, attention_bias(q_pos, kv_pos, kv_pos >= 0))
+
+    _, vjp = jax.vjp(dense, q, k, v)
+    dq, dk, dv = vjp(g)
+    # Integer primals take float0 cotangents.
+    zq = np.zeros(q_pos.shape, jax.dtypes.float0)
+    zk = np.zeros(kv_pos.shape, jax.dtypes.float0)
+    return dq, dk, dv, zq, zk
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_forward(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
+    B, T, H, d = q.shape
+    S, KVH = k.shape[1], k.shape[2]
+    assert H % KVH == 0, (H, KVH)
+    group = H // KVH
+    scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        # Mosaic only targets TPU; everywhere else (CPU test meshes) run the
+        # kernel interpreted.  default_backend() is concrete at trace time.
+        interpret = jax.default_backend() != "tpu"
+
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    if not interpret:
+        # Mosaic tiling: a non-full block's last dim must be a multiple of
+        # 128 and its second-to-last a multiple of 8.  block_q only ever
+        # appears as a sublane dim (q/o/q_pos tiles) — 8-align it; block_k
+        # is the lane dim of the kv_pos tile — 128-align it.
+        if block_q < T:
+            block_q = -(-block_q // _SUBLANES) * _SUBLANES
+        if block_k < S:
+            block_k = -(-block_k // _LANES) * _LANES
+        block_q, block_k = min(block_q, T), min(block_k, S)
+
+    # Pad sequence axes up to tile multiples OUTSIDE the kernel: Pallas
+    # out-of-bounds tile reads are undefined, so padded kv slots must carry
+    # a real -1 position for the in-kernel mask to exclude them.
+    qt = _pad_to(jnp.swapaxes(q, 1, 2), 2, block_q)  # [B, H, Tp, d]
+    kt = _pad_to(jnp.swapaxes(k, 1, 2), 2, block_k)  # [B, KVH, Sp, d]
+    vt = _pad_to(jnp.swapaxes(v, 1, 2), 2, block_k)
+    q_pos_p = _pad_to(q_pos.astype(jnp.int32), 1, block_q)
+    kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), 1, block_k, value=-1)
+    Tp, Sp = qt.shape[2], kt.shape[2]
+    nq, nk = Tp // block_q, Sp // block_k
+    # Lane/sublane-replicated position planes (see kernel docstring).
+    q_pos_r = jnp.broadcast_to(q_pos_p[:, :, None], (B, Tp, _LANES))
+    kv_pos_r = jnp.broadcast_to(kv_pos_p[:, None, :], (B, _SUBLANES, Sp))
+
+    grid = (B, H, nq, nk)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, _LANES), lambda b, h, qi, ki: (b, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, _SUBLANES, block_k), lambda b, h, qi, ki: (b, 0, ki)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda b, h, qi, ki: (b, h // group, ki, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda b, h, qi, ki: (b, h // group, ki, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_pos_r, kv_pos_r, qt, kt, vt)
+    return jnp.swapaxes(out[:, :, :T, :], 1, 2)  # [B, T, H, d]

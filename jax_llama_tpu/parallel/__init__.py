@@ -12,8 +12,11 @@ from .partition import (
     shard_params,
     validate_tp,
 )
+from .ring import ring_attention, ring_sdpa
 
 __all__ = [
+    "ring_attention",
+    "ring_sdpa",
     "AXES",
     "auto_mesh",
     "constrain",

@@ -1,0 +1,146 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+The reference has no sequence parallelism at all (SURVEY.md §2.13b: full-
+sequence attention with a materialized S×S mask, ``/root/reference/
+jax_llama/model.py:154``) — its context length is capped by one device's
+memory.  Here the sequence axis is sharded over the ``seq`` mesh axis and
+attention runs as a ring: each device holds one KV shard, computes blockwise
+attention of its local queries against the shard it currently holds while
+accumulating online-softmax state (running max ``m``, denominator ``l``,
+fp32 accumulator), then rotates the KV shard to its ring neighbor with
+``lax.ppermute``.  After ``n`` steps every query has seen every key, no
+device ever held more than ``S/n`` keys, and the rotation rides ICI
+point-to-point links, overlapping with the local compute under XLA's
+latency-hiding scheduler.
+
+Masking is positional (same contract as ``ops.attention.attention_bias`` /
+the flash kernel): slot attendable iff ``kv_pos <= q_pos`` and
+``kv_pos >= 0``.  Because masks derive from absolute positions carried with
+the shards, causality is layout-independent — no zig-zag reordering games
+are needed for correctness (contiguous sharding does leave the usual causal
+load imbalance; acceptable at this stage).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention_bias, repeat_kv, sdpa
+from ..ops.flash_attention import MASK_VALUE
+from .mesh import current_mesh
+
+BATCH_AXES = ("data", "fsdp")
+
+
+def _accumulate(qt, q_pos, k, v, kv_pos, m, l, acc, *, scale):
+    """Fold one KV shard into the running online-softmax state.
+
+    qt: [B, H, T, d]; k, v: [B, S, KVH, d]; m, l: [B, H, T] f32;
+    acc: [B, H, T, d] f32.
+    """
+    group = qt.shape[1] // k.shape[2]
+    kr = repeat_kv(k, group)  # [B, S, H, d]
+    vr = repeat_kv(v, group)
+    s = jnp.einsum(
+        "bhtd,bshd->bhts", qt, kr, preferred_element_type=jnp.float32
+    ) * scale
+    allowed = (kv_pos[:, None, None, :] <= q_pos[:, None, :, None]) & (
+        kv_pos >= 0
+    )[:, None, None, :]
+    s = jnp.where(allowed, s, MASK_VALUE)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)  # [B, H, T]
+    p = jnp.exp(s - m_new[..., None])  # [B, H, T, S] f32
+    l = alpha * l + jnp.sum(p, axis=-1)
+    acc = alpha[..., None] * acc + jnp.einsum(
+        "bhts,bshd->bhtd", p.astype(vr.dtype), vr,
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l, acc
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    kv_pos: jnp.ndarray,
+    *,
+    axis_name: str = "seq",
+    axis_size: int,
+) -> jnp.ndarray:
+    """Per-device body (call under shard_map): local q attends to all KV
+    shards as they rotate around the ring.
+
+    q: [B, T_local, H, d]; k, v: [B, S_local, KVH, d];
+    q_pos: [B, T_local]; kv_pos: [B, S_local].  Returns [B, T_local, H, d].
+    """
+    B, T, H, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2)  # [B, H, T, d]
+    m = jnp.full((B, H, T), MASK_VALUE, dtype=jnp.float32)
+    l = jnp.zeros((B, H, T), dtype=jnp.float32)
+    acc = jnp.zeros((B, H, T, d), dtype=jnp.float32)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(_, carry):
+        k, v, kv_pos, m, l, acc = carry
+        m, l, acc = _accumulate(
+            qt, q_pos, k, v, kv_pos, m, l, acc, scale=scale
+        )
+        k, v, kv_pos = (
+            lax.ppermute(x, axis_name, perm) for x in (k, v, kv_pos)
+        )
+        return k, v, kv_pos, m, l, acc
+
+    # n-1 rotations; the last shard is folded in without a trailing permute.
+    k, v, kv_pos, m, l, acc = lax.fori_loop(
+        0, axis_size - 1, body, (k, v, kv_pos, m, l, acc)
+    )
+    m, l, acc = _accumulate(qt, q_pos, k, v, kv_pos, m, l, acc, scale=scale)
+
+    out = acc / l[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, T, H, d]
+
+
+def ring_sdpa(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    kv_pos: jnp.ndarray,
+    *,
+    axis_name: str = "seq",
+) -> jnp.ndarray:
+    """Mesh-aware entry point: shard_map over the active mesh's ``seq`` axis
+    (batch over data/fsdp, heads over tensor stay local per device).  Falls
+    back to dense sdpa when no mesh is active or seq == 1.
+    """
+    mesh = current_mesh()
+    n = mesh.shape.get(axis_name, 1) if mesh is not None else 1
+    if n == 1:
+        bias = attention_bias(q_pos, kv_pos, kv_pos >= 0)
+        return sdpa(q, k, v, bias)
+
+    spec4 = P(BATCH_AXES, axis_name, "tensor", None)
+    spec2 = P(BATCH_AXES, axis_name)
+    # check_vma=False: the fori_loop carry starts from freshly-created
+    # (device-invariant) accumulators and becomes device-varying after the
+    # first ppermute, which the varying-manual-axes checker rejects even
+    # though the program is correct.
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, axis_size=n),
+        mesh=mesh,
+        in_specs=(spec4, spec4, spec4, spec2, spec2),
+        out_specs=spec4,
+        check_vma=False,
+    )
+    return fn(q, k, v, q_pos, kv_pos)

@@ -83,11 +83,13 @@ def lm_loss(
       (defaults to all positions).
     """
     B, T = tokens.shape
-    inputs = tokens[:, :-1]
     targets = tokens[:, 1:]
-    positions = jnp.broadcast_to(jnp.arange(T - 1, dtype=jnp.int32), (B, T - 1))
-    logits, _ = forward(params, inputs, positions, config)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    # Forward over the full T (not T-1): sequence-parallel meshes need the
+    # model-visible length to stay divisible by the seq axis; the final
+    # position's logits are simply dropped from the loss.
+    logits, _ = forward(params, tokens, positions, config)
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[:, :, None], axis=-1)[..., 0]
     if loss_mask is not None:
         m = loss_mask[:, 1:].astype(jnp.float32)
@@ -95,20 +97,45 @@ def lm_loss(
     return jnp.mean(nll)
 
 
-@functools.partial(jax.jit, static_argnames=("config", "optimizer"), donate_argnames=("state",))
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "optimizer", "mesh"),
+    donate_argnames=("state",),
+)
 def train_step(
     state: TrainState,
     tokens: jnp.ndarray,
     config: LLaMAConfig,
     optimizer: optax.GradientTransformation,
     loss_mask: Optional[jnp.ndarray] = None,
+    mesh=None,
 ) -> Tuple[TrainState, jnp.ndarray]:
     """One optimizer step.  `optimizer` must be a hashable static (module-
     level) GradientTransformation; under a mesh the donated state keeps
-    params/opt-state sharded in place."""
-    loss, grads = jax.value_and_grad(lm_loss)(
-        state.params, tokens, config, loss_mask
-    )
-    updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-    params = optax.apply_updates(state.params, updates)
-    return TrainState(params, opt_state, state.step + 1), loss
+    params/opt-state sharded in place.
+
+    `mesh` must be passed explicitly (it is part of the jit cache key):
+    sharding constraints and ring attention read the active mesh at trace
+    time, so relying on the caller's thread-local ``use_mesh`` would bake
+    whatever mesh was active at first call into the cached executable.
+    """
+    from .parallel.mesh import current_mesh, use_mesh
+
+    if mesh is None and current_mesh() is not None:
+        # Entering use_mesh(None) here would silently disable every
+        # sharding constraint the ambient mesh was meant to drive; fail
+        # loudly instead of training unsharded.
+        raise ValueError(
+            "train_step: pass mesh= explicitly (it is part of the jit "
+            "cache key); an ambient use_mesh(...) context is not seen by "
+            "the compiled executable on later calls"
+        )
+    with use_mesh(mesh):
+        loss, grads = jax.value_and_grad(lm_loss)(
+            state.params, tokens, config, loss_mask
+        )
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss

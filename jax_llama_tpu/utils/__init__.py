@@ -1,0 +1,3 @@
+from .profiling import DecodeStats, Timer, trace
+
+__all__ = ["DecodeStats", "Timer", "trace"]

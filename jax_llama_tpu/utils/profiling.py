@@ -1,0 +1,99 @@
+"""Tracing / profiling / throughput observability.
+
+The reference has none of this (SURVEY.md §5: "Tracing / profiling: Absent
+— only leftover debug prints", ``/root/reference/jax_llama/model.py:636``);
+this module provides the TPU-native equivalents the survey prescribes:
+``jax.profiler`` xplane traces viewable in TensorBoard/XProf, wall-clock
+timers that block on device work, and tokens/sec/chip decode counters (the
+BASELINE.json metric).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace (xplane format) into ``log_dir``.
+
+    View with TensorBoard's profile plugin or xprof.  Wrap the steady-state
+    region only — include one warm-up call outside the context so compile
+    time does not dominate the trace.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclasses.dataclass
+class Timer:
+    """Wall-clock timer that waits for in-flight device work on both edges,
+    so the measured window covers exactly the enclosed computation."""
+
+    elapsed_s: float = 0.0
+
+    def __enter__(self) -> "Timer":
+        _block_on_pending()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _block_on_pending()
+        self.elapsed_s = time.perf_counter() - self._t0
+
+
+def _block_on_pending() -> None:
+    # effects_barrier waits for all dispatched-but-unfinished computations.
+    jax.effects_barrier()
+
+
+@dataclasses.dataclass
+class DecodeStats:
+    """Throughput accounting for one generation call.
+
+    tokens/sec figures are per chip: divide by ``n_devices`` so multi-chip
+    meshes report the BASELINE.json metric (tokens/sec/chip) directly.
+    """
+
+    batch: int
+    prompt_len: int
+    new_tokens: int
+    prefill_s: float
+    decode_s: float
+    n_devices: int = 1
+
+    @property
+    def decode_tokens_per_s(self) -> float:
+        return self.batch * self.new_tokens / max(self.decode_s, 1e-9)
+
+    @property
+    def decode_tokens_per_s_per_chip(self) -> float:
+        return self.decode_tokens_per_s / self.n_devices
+
+    @property
+    def prefill_tokens_per_s(self) -> float:
+        return self.batch * self.prompt_len / max(self.prefill_s, 1e-9)
+
+    @property
+    def per_token_latency_ms(self) -> float:
+        return 1e3 * self.decode_s / max(self.new_tokens, 1)
+
+    def summary(self) -> str:
+        prefill = (
+            f"prefill {self.prefill_tokens_per_s:,.0f} tok/s | "
+            if self.prefill_s > 0
+            else ""
+        )
+        return (
+            f"{prefill}decode "
+            f"{self.decode_tokens_per_s_per_chip:,.1f} tok/s/chip "
+            f"({self.per_token_latency_ms:.2f} ms/tok, batch {self.batch})"
+        )

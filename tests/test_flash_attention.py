@@ -1,0 +1,163 @@
+"""Flash-attention kernel parity vs the XLA reference path.
+
+The Pallas kernel runs in interpret mode on the CPU test mesh; parity vs
+``ops.attention.sdpa`` (itself oracle-checked in test_ops/test_model) at
+fp32 tolerances covers the online-softmax math, GQA index mapping,
+positional masking, and tile-padding logic.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.models import forward
+from jax_llama_tpu.ops import attention_bias, flash_attention, sdpa
+
+
+def _ref(q, k, v, q_pos, kv_pos):
+    bias = attention_bias(
+        jnp.asarray(q_pos), jnp.asarray(kv_pos), jnp.asarray(kv_pos) >= 0
+    )
+    return np.asarray(
+        sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), bias)
+    )
+
+
+def _rand(B, T, S, H, KVH, D):
+    q = np.random.randn(B, T, H, D).astype(np.float32)
+    k = np.random.randn(B, S, KVH, D).astype(np.float32)
+    v = np.random.randn(B, S, KVH, D).astype(np.float32)
+    return q, k, v
+
+
+def test_flash_matches_sdpa_causal():
+    B, T, H, KVH, D = 2, 24, 4, 2, 16
+    q, k, v = _rand(B, T, T, H, KVH, D)
+    pos = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+    got = np.asarray(
+        flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(pos), jnp.asarray(pos), block_q=8, block_k=8,
+        )
+    )
+    want = _ref(q, k, v, pos, pos)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_non_multiple_block_sizes():
+    # T=13, S=21 not multiples of the 8/16 tiles: exercises the padding path.
+    B, T, S, H, KVH, D = 1, 13, 21, 4, 4, 8
+    q, k, v = _rand(B, T, S, H, KVH, D)
+    q_pos = np.tile(np.arange(S - T, S, dtype=np.int32), (B, 1))
+    kv_pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    got = np.asarray(
+        flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(q_pos), jnp.asarray(kv_pos), block_q=8, block_k=16,
+        )
+    )
+    want = _ref(q, k, v, q_pos, kv_pos)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_padding_and_cache_slots_masked():
+    # Left-padded prompt (slots -1) plus unwritten cache tail (slots -1):
+    # the decode-over-cache geometry.
+    B, T, S, H, KVH, D = 2, 4, 32, 4, 2, 8
+    q, k, v = _rand(B, T, S, H, KVH, D)
+    kv_pos = np.full((B, S), -1, dtype=np.int32)
+    kv_pos[:, 2:10] = np.arange(8)  # 8 valid slots mid-cache
+    q_pos = np.tile(np.arange(4, 8, dtype=np.int32), (B, 1))
+    got = np.asarray(
+        flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(q_pos), jnp.asarray(kv_pos), block_q=8, block_k=8,
+        )
+    )
+    want = _ref(q, k, v, q_pos, kv_pos)
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_single_query_decode_shape():
+    # T=1 (decode step): the kernel must handle a 1-row q block.
+    B, S, H, KVH, D = 2, 40, 8, 2, 16
+    q, k, v = _rand(B, 1, S, H, KVH, D)
+    kv_pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    kv_pos[:, 30:] = -1
+    q_pos = np.full((B, 1), 29, dtype=np.int32)
+    got = np.asarray(
+        flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(q_pos), jnp.asarray(kv_pos), block_q=8, block_k=8,
+        )
+    )
+    want = _ref(q, k, v, q_pos, kv_pos)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_model_forward_flash_matches_xla():
+    import jax
+
+    config = get_config("tiny")
+    params = init_params(jax.random.PRNGKey(0), config)
+    B, T = 2, 18
+    tokens = jnp.asarray(
+        np.random.randint(0, config.vocab_size, (B, T)), jnp.int32
+    )
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    ref_logits, _ = forward(params, tokens, positions, config)
+    flash_logits, _ = forward(
+        params, tokens, positions, config.replace(attn_impl="flash")
+    )
+    np.testing.assert_allclose(
+        np.asarray(flash_logits), np.asarray(ref_logits), atol=2e-4, rtol=1e-4
+    )
+
+
+def test_model_decode_with_cache_flash_matches_xla():
+    import jax
+    from jax_llama_tpu.engine import GenerationConfig, generate
+
+    config = get_config("tiny")
+    params = init_params(jax.random.PRNGKey(0), config)
+    B, P = 2, 9
+    prompt = np.random.randint(1, config.vocab_size, (B, P)).astype(np.int32)
+    mask = np.ones((B, P), dtype=bool)
+    mask[0, :3] = False  # left padding on row 0
+    prompt[0, :3] = 0
+    gc = GenerationConfig(max_new_tokens=8, temperature=0.0, stop_tokens=())
+    key = jax.random.PRNGKey(1)
+    out_ref = generate(
+        params, jnp.asarray(prompt), jnp.asarray(mask), key,
+        config=config, gen_config=gc,
+    )
+    out_flash = generate(
+        params, jnp.asarray(prompt), jnp.asarray(mask), key,
+        config=config.replace(attn_impl="flash"), gen_config=gc,
+    )
+    np.testing.assert_array_equal(np.asarray(out_ref), np.asarray(out_flash))
+
+
+def test_flash_gradients_match_xla():
+    import jax
+
+    config = get_config("tiny")
+    params = init_params(jax.random.PRNGKey(0), config)
+    from jax_llama_tpu.train import lm_loss
+
+    tokens = jnp.asarray(
+        np.random.randint(0, config.vocab_size, (2, 16)), jnp.int32
+    )
+    l0, g0 = jax.value_and_grad(lm_loss)(params, tokens, config)
+    l1, g1 = jax.value_and_grad(lm_loss)(
+        params, tokens, config.replace(attn_impl="flash")
+    )
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        ),
+        g1, g0,
+    )

@@ -1,0 +1,40 @@
+"""Profiling/observability utilities."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax_llama_tpu.utils import DecodeStats, Timer, trace
+
+
+def test_timer_measures_device_work():
+    x = jnp.asarray(np.random.randn(256, 256), jnp.float32)
+    with Timer() as t:
+        y = x
+        for _ in range(4):
+            y = y @ x
+        jax.block_until_ready(y)
+    assert t.elapsed_s > 0
+
+
+def test_decode_stats_math():
+    s = DecodeStats(
+        batch=8, prompt_len=128, new_tokens=100, prefill_s=0.5,
+        decode_s=2.0, n_devices=4,
+    )
+    assert s.decode_tokens_per_s == 8 * 100 / 2.0
+    assert s.decode_tokens_per_s_per_chip == 8 * 100 / 2.0 / 4
+    assert s.per_token_latency_ms == 20.0
+    assert "tok/s/chip" in s.summary()
+
+
+def test_trace_writes_profile(tmp_path):
+    d = str(tmp_path / "trace")
+    with trace(d):
+        jax.block_until_ready(jnp.ones((8, 8)) * 2)
+    found = []
+    for root, _, files in os.walk(d):
+        found += [f for f in files if f.endswith(".xplane.pb")]
+    assert found, f"no xplane files under {d}"

@@ -1,0 +1,145 @@
+"""Ring attention (sequence/context parallelism) on the 8-device CPU mesh.
+
+SURVEY.md §4's implication: multi-device paths must be testable without
+hardware.  Parity target is the dense sdpa path, which is itself
+oracle-checked.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from jax_llama_tpu import get_config, init_params, make_mesh
+from jax_llama_tpu.models import forward
+from jax_llama_tpu.ops import attention_bias, sdpa
+from jax_llama_tpu.parallel import ring_sdpa, shard_params, use_mesh
+from jax_llama_tpu.parallel.ring import ring_attention
+
+
+def _dense(q, k, v, q_pos, kv_pos):
+    bias = attention_bias(
+        jnp.asarray(q_pos), jnp.asarray(kv_pos), jnp.asarray(kv_pos) >= 0
+    )
+    return np.asarray(
+        sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), bias)
+    )
+
+
+def test_ring_sdpa_matches_dense_seq4():
+    B, T, H, KVH, D = 2, 32, 4, 2, 8
+    q = np.random.randn(B, T, H, D).astype(np.float32)
+    k = np.random.randn(B, T, KVH, D).astype(np.float32)
+    v = np.random.randn(B, T, KVH, D).astype(np.float32)
+    pos = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+
+    mesh = make_mesh(data=2, seq=4, devices=jax.devices()[:8])
+    with use_mesh(mesh):
+        got = np.asarray(
+            ring_sdpa(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(pos), jnp.asarray(pos),
+            )
+        )
+    want = _dense(q, k, v, pos, pos)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_ring_sdpa_with_padding_positions():
+    # Left-padded rows: pad slots carry kv_pos=-1 and must never be attended,
+    # no matter which device's shard they land on.
+    B, T, H, KVH, D = 2, 16, 2, 2, 8
+    q = np.random.randn(B, T, H, D).astype(np.float32)
+    k = np.random.randn(B, T, KVH, D).astype(np.float32)
+    v = np.random.randn(B, T, KVH, D).astype(np.float32)
+    npad = 5
+    q_pos = np.tile(
+        np.concatenate([np.zeros(npad), np.arange(T - npad)]).astype(np.int32),
+        (B, 1),
+    )
+    kv_pos = q_pos.copy()
+    kv_pos[:, :npad] = -1
+
+    mesh = make_mesh(seq=8, devices=jax.devices()[:8])
+    with use_mesh(mesh):
+        got = np.asarray(
+            ring_sdpa(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(q_pos), jnp.asarray(kv_pos),
+            )
+        )
+    want = _dense(q, k, v, q_pos, kv_pos)
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_ring_sdpa_no_mesh_fallback():
+    B, T, H, D = 1, 8, 2, 4
+    q = np.random.randn(B, T, H, D).astype(np.float32)
+    k = np.random.randn(B, T, H, D).astype(np.float32)
+    v = np.random.randn(B, T, H, D).astype(np.float32)
+    pos = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+    got = np.asarray(
+        ring_sdpa(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(pos), jnp.asarray(pos),
+        )
+    )
+    np.testing.assert_allclose(got, _dense(q, k, v, pos, pos), atol=1e-5)
+
+
+def test_model_forward_ring_matches_single_device():
+    # Full model under a data×seq×tensor mesh with ring attention vs the
+    # unsharded XLA path.
+    config = get_config("tiny", dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), config)
+    B, T = 2, 32
+    tokens = jnp.asarray(
+        np.random.randint(0, config.vocab_size, (B, T)), jnp.int32
+    )
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    ref_logits, _ = forward(params, tokens, positions, config)
+
+    mesh = make_mesh(data=2, seq=2, tensor=2, devices=jax.devices()[:8])
+    ring_config = config.replace(attn_impl="ring")
+    sharded = shard_params(params, mesh, ring_config)
+    with use_mesh(mesh):
+        got, _ = jax.jit(
+            lambda p, t, pos: forward(p, t, pos, ring_config)
+        )(sharded, tokens, positions)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref_logits), atol=2e-4, rtol=1e-4
+    )
+
+
+def test_ring_train_step_matches_single_device():
+    from jax_llama_tpu.train import init_train_state, make_optimizer, train_step
+
+    opt = make_optimizer(learning_rate=1e-3)
+    config = get_config("tiny", dtype="float32")
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(0, config.vocab_size, (4, 16))
+    )
+    state = init_train_state(init_params(jax.random.PRNGKey(0), config), opt)
+    _, loss_single = train_step(state, tokens, config, opt)
+
+    mesh = make_mesh(data=2, seq=2, tensor=2, devices=jax.devices()[:8])
+    ring_config = config.replace(attn_impl="ring")
+    sharded = shard_params(init_params(jax.random.PRNGKey(0), config), mesh, ring_config)
+    sstate = init_train_state(sharded, opt)
+    sstate, loss_ring = train_step(sstate, tokens, ring_config, opt, mesh=mesh)
+    np.testing.assert_allclose(float(loss_ring), float(loss_single), rtol=1e-5)
+
+
+def test_ring_decode_over_cache_refuses_seq_mesh():
+    from jax_llama_tpu.models import init_cache
+
+    config = get_config("tiny", attn_impl="ring")
+    params = init_params(jax.random.PRNGKey(0), config)
+    tokens = jnp.zeros((2, 4), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32), (2, 4))
+    cache = init_cache(config, 2, max_len=8)
+    mesh = make_mesh(seq=8, devices=jax.devices()[:8])
+    with use_mesh(mesh):
+        with pytest.raises(NotImplementedError, match="seq > 1"):
+            forward(params, tokens, positions, config, cache=cache)

@@ -72,9 +72,8 @@ def test_sharded_train_step_matches_single_device():
     sharded = shard_params(
         init_params(jax.random.PRNGKey(0), CFG), mesh, CFG, fsdp=True
     )
-    with use_mesh(mesh):
-        sstate = init_train_state(sharded, OPT)
-        sstate, loss_sharded = train_step(sstate, tokens, CFG, OPT)
+    sstate = init_train_state(sharded, OPT)
+    sstate, loss_sharded = train_step(sstate, tokens, CFG, OPT, mesh=mesh)
     np.testing.assert_allclose(
         float(loss_sharded), float(loss_single), rtol=1e-5
     )
