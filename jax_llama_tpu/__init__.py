@@ -17,6 +17,7 @@ from .config import LLaMAConfig, get_config, swiglu_hidden_size
 from .engine import GenerationConfig, generate
 from .generation import LLaMA
 from .models import KVCache, forward, init_cache, init_params, param_count
+from .ops.quant import QuantizedTensor, quantize_params
 from .parallel import auto_mesh, constrain, make_mesh, use_mesh
 from .tokenizers import ByteTokenizer
 
@@ -39,5 +40,7 @@ __all__ = [
     "constrain",
     "make_mesh",
     "use_mesh",
+    "QuantizedTensor",
+    "quantize_params",
     "__version__",
 ]

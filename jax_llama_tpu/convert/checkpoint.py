@@ -26,23 +26,32 @@ from jax.sharding import Mesh, NamedSharding
 
 from ..config import LLaMAConfig
 from ..models.llama import init_params
+from ..ops.quant import is_quantized, quantize_params
 from ..parallel.partition import param_partition_specs
 
 
 def save_checkpoint(path: str, params: Any, config: LLaMAConfig) -> None:
-    """Write params + config to `path` (created if needed)."""
+    """Write params + config to `path` (created if needed).
+
+    Quantized trees (``quantize_params`` output) round-trip: a marker in
+    config.json tells ``load_checkpoint`` to build the matching abstract
+    tree on restore.
+    """
     path = Path(path).absolute()
     path.mkdir(parents=True, exist_ok=True)
+    meta = dict(dataclasses.asdict(config), _quantized=is_quantized(params))
     with open(path / "config.json", "w") as f:
-        json.dump(dataclasses.asdict(config), f, indent=2)
+        json.dump(meta, f, indent=2)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path / "params", params, force=True)
     ckptr.wait_until_finished()
 
 
-def load_config(path: str) -> LLaMAConfig:
+def load_config(path: str) -> Tuple[LLaMAConfig, bool]:
     with open(Path(path) / "config.json") as f:
-        return LLaMAConfig(**json.load(f))
+        meta = json.load(f)
+    quantized = meta.pop("_quantized", False)
+    return LLaMAConfig(**meta), quantized
 
 
 def load_checkpoint(
@@ -59,17 +68,17 @@ def load_checkpoint(
     21-26).  Without: plain host restore.
     """
     path = Path(path).absolute()
-    config = load_config(path)
-    shapes = jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), config))
+    config, quantized = load_config(path)
+
+    def build():
+        params = init_params(jax.random.PRNGKey(0), config)
+        return quantize_params(params) if quantized else params
+
+    shapes = jax.eval_shape(build)
     if mesh is not None:
-        specs = param_partition_specs(config, fsdp=fsdp)
-        abstract = jax.tree.map(
-            lambda s, spec: jax.ShapeDtypeStruct(
-                s.shape, s.dtype, sharding=NamedSharding(mesh, spec)
-            ),
-            shapes,
-            specs,
-        )
+        from ..parallel.partition import shard_abstract
+
+        abstract = shard_abstract(shapes, mesh, config, fsdp=fsdp)
     else:
         abstract = shapes
     ckptr = ocp.StandardCheckpointer()

@@ -49,6 +49,7 @@ from ..config import LLaMAConfig
 from ..ops.attention import attention_bias, sdpa
 from ..ops.flash_attention import flash_attention
 from ..ops.norm import rms_norm
+from ..ops.quant import matmul as qeinsum
 from ..ops.rope import apply_rope, rope_table
 from ..parallel.mesh import constrain
 
@@ -182,9 +183,9 @@ def _block(
 
     # --- attention ---
     h = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
-    q = jnp.einsum("btd,dhk->bthk", h, lp["q"].astype(adt))
-    k = jnp.einsum("btd,dhk->bthk", h, lp["k"].astype(adt))
-    v = jnp.einsum("btd,dhk->bthk", h, lp["v"].astype(adt))
+    q = qeinsum(h, lp["q"], "btd,dhk->bthk", adt)
+    k = qeinsum(h, lp["k"], "btd,dhk->bthk", adt)
+    v = qeinsum(h, lp["v"], "btd,dhk->bthk", adt)
     q = constrain(q, "data", "seq", "tensor", None)
     k = constrain(k, "data", "seq", "tensor", None)
     v = constrain(v, "data", "seq", "tensor", None)
@@ -220,18 +221,18 @@ def _block(
     else:
         attn = sdpa(q, kk, vv, bias, softmax_dtype=softmax_dtype)
 
-    attn_out = jnp.einsum("bthk,hkd->btd", attn, lp["o"].astype(adt))
+    attn_out = qeinsum(attn, lp["o"], "bthk,hkd->btd", adt)
     attn_out = constrain(attn_out, "data", "seq", None)
     x = x + attn_out
 
     # --- SwiGLU MLP ---
     h = rms_norm(x, lp["mlp_norm"], config.rms_norm_eps)
-    gate = jnp.einsum("btd,df->btf", h, lp["gate"].astype(adt))
-    up = jnp.einsum("btd,df->btf", h, lp["up"].astype(adt))
+    gate = qeinsum(h, lp["gate"], "btd,df->btf", adt)
+    up = qeinsum(h, lp["up"], "btd,df->btf", adt)
     gate = constrain(gate, "data", "seq", "tensor")
     up = constrain(up, "data", "seq", "tensor")
     hidden = jax.nn.silu(gate) * up
-    down = jnp.einsum("btf,fd->btd", hidden, lp["down"].astype(adt))
+    down = qeinsum(hidden, lp["down"], "btf,fd->btd", adt)
     down = constrain(down, "data", "seq", None)
     x = x + down
     return x, cache_k, cache_v
@@ -362,8 +363,8 @@ def forward(
         kernel = params["embed"]["embedding"].T
     else:
         kernel = params["lm_head"]
-    logits = jnp.einsum(
-        "btd,dv->btv", x, kernel.astype(adt),
+    logits = qeinsum(
+        x, kernel, "btd,dv->btv", adt,
         preferred_element_type=jnp.dtype(config.logits_dtype),
     ).astype(config.logits_dtype)
     logits = constrain(logits, "data", "seq", "tensor")

@@ -3,9 +3,14 @@ from .rope import rope_table, apply_rope
 from .attention import sdpa, repeat_kv, attention_bias, NEG_INF
 from .flash_attention import flash_attention
 from .sampling import sample, greedy, top_p_filter, top_k_filter
+from .quant import QuantizedTensor, quantize, quantize_params, is_quantized
 
 __all__ = [
     "flash_attention",
+    "QuantizedTensor",
+    "quantize",
+    "quantize_params",
+    "is_quantized",
     "rms_norm",
     "rope_table",
     "apply_rope",

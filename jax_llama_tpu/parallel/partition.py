@@ -26,6 +26,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import LLaMAConfig
+from ..ops.quant import QuantizedTensor
 
 
 def param_partition_specs(
@@ -101,6 +102,59 @@ def shard_params(
     """
     validate_tp(config, mesh, fsdp=fsdp)
     specs = param_partition_specs(config, fsdp=fsdp)
+
+    def put(x, sharding):
+        return jax.device_put(x, sharding)
+
+    return _map_with_shardings(put, params, specs, mesh)
+
+
+def shard_abstract(
+    shapes: Any,
+    mesh: Mesh,
+    config: LLaMAConfig,
+    *,
+    fsdp: bool = False,
+) -> Any:
+    """Attach NamedShardings to an abstract (eval_shape) param tree — the
+    form Orbax needs to restore each shard straight to its owning host."""
+    validate_tp(config, mesh, fsdp=fsdp)
+    specs = param_partition_specs(config, fsdp=fsdp)
+
+    def abstract(x, sharding):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    return _map_with_shardings(abstract, shapes, specs, mesh)
+
+
+def _scale_spec(spec: P, q_ndim: int, scale_shape) -> P:
+    """Spec for a QuantizedTensor's per-channel scale: the weight's spec,
+    minus axes on contracted dims (size 1 in the scale — must not shard)."""
+    full = tuple(spec) + (None,) * (q_ndim - len(tuple(spec)))
+    return P(*(
+        ax if dim != 1 else None for ax, dim in zip(full, scale_shape)
+    ))
+
+
+def _map_with_shardings(fn, tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """Apply ``fn(leaf, NamedSharding)`` over a (possibly quantized) param
+    tree zipped with its PartitionSpec tree."""
+
+    def apply(x, s):
+        if isinstance(x, QuantizedTensor):
+            # The int8 payload takes the weight's spec; the scale keeps the
+            # spec only on dims it actually has.
+            q = x.q
+            return QuantizedTensor(
+                q=fn(q, NamedSharding(mesh, s)),
+                scale=fn(
+                    x.scale,
+                    NamedSharding(mesh, _scale_spec(s, q.ndim, x.scale.shape)),
+                ),
+            )
+        return fn(x, NamedSharding(mesh, s))
+
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+        apply, tree, specs,
+        is_leaf=lambda x: isinstance(x, QuantizedTensor),
     )
