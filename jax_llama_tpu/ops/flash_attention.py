@@ -138,6 +138,26 @@ def flash_attention(
     Returns:
       [B, T, H, d] in q.dtype.
     """
+    H, KVH = q.shape[2], k.shape[2]
+    assert H % KVH == 0, (H, KVH)
+    group = H // KVH
+    if group > 1:
+        # GQA query packing: fold the `group` query heads of each KV head
+        # into the query-row axis, so the kernel grid runs over KV heads
+        # and each KV block streams from HBM *once* per KV head instead of
+        # once per query head (group x less KV-cache traffic — dominant in
+        # long-context decode).  Masking is purely positional, so packing
+        # is just a relayout: row r = g*T + t keeps position q_pos[t].
+        B, T = q.shape[:2]
+        qp = jnp.moveaxis(
+            q.reshape(B, T, KVH, group, -1), 3, 1
+        ).reshape(B, group * T, KVH, -1)
+        pos_p = jnp.tile(q_pos, (1, group))
+        out = _flash(qp, k, v, pos_p, kv_pos, block_q, block_k, interpret)
+        out = jnp.moveaxis(
+            out.reshape(B, group, T, KVH, -1), 1, 3
+        ).reshape(B, T, H, -1)
+        return out
     return _flash(q, k, v, q_pos, kv_pos, block_q, block_k, interpret)
 
 

@@ -9,12 +9,16 @@ from .mesh import (
 )
 from .partition import (
     param_partition_specs,
+    shard_abstract,
     shard_params,
     validate_tp,
 )
 from .ring import ring_attention, ring_sdpa
+from . import distributed
 
 __all__ = [
+    "distributed",
+    "shard_abstract",
     "ring_attention",
     "ring_sdpa",
     "AXES",

@@ -1,0 +1,82 @@
+"""Multi-host initialization: the TPU-native communication backend.
+
+The reference has no distributed backend of its own — XLA:GPU inserts NCCL
+collectives from sharding specs, and only its *torch test oracle* ever
+calls ``init_process_group`` (SURVEY.md §2.13b).  The TPU-native
+equivalent is the GSPMD model over ICI (intra-slice) and DCN (inter-slice):
+``jax.distributed.initialize()`` brings up the coordination service, every
+host then sees the global device set, and a ``Mesh`` spanning
+``jax.devices()`` makes XLA emit collectives that ride ICI for inner mesh
+axes (tensor/seq) and DCN for outer ones (data) — no hand-written
+communication anywhere.
+
+Typical multi-host entry (same code on every host, e.g. under
+``gcloud compute tpus tpu-vm ssh --worker=all``):
+
+    from jax_llama_tpu.parallel import distributed, make_mesh
+    distributed.initialize()          # no-op on single host / single proc
+    mesh = make_mesh(data=jax.process_count(), tensor=jax.local_device_count())
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up ``jax.distributed`` when running multi-process.
+
+    On Cloud TPU all three arguments are auto-detected from the metadata
+    server, so a bare ``initialize()`` works on every host of a pod slice.
+    Single-process runs (one chip, CPU meshes, unit tests) skip
+    initialization entirely — calling this is always safe.
+
+    Explicit args (or ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES``
+    / ``JAX_PROCESS_ID`` env vars) cover non-TPU-metadata environments.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    explicit = coordinator_address is not None
+    on_tpu_pod = (
+        jax.default_backend() == "tpu" and not explicit
+        and os.environ.get("TPU_WORKER_HOSTNAMES")  # pod slice: >1 worker
+    )
+    if not explicit and not on_tpu_pod:
+        return  # single-process: nothing to initialize
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()

@@ -1,0 +1,42 @@
+"""Downloader checksum logic (parity: reference download.sh's md5sum -c
+loop — the network fetch itself is not unit-testable, the verification
+is)."""
+
+from pathlib import Path
+
+from jax_llama_tpu.download import (
+    N_SHARDS,
+    md5_file,
+    parse_checklist,
+    verify_checklist,
+)
+
+
+def test_parse_checklist_md5sum_format():
+    text = "0123abc  consolidated.00.pth\ndeadbeef *params.json\n\n"
+    assert parse_checklist(text) == [
+        ("0123abc", "consolidated.00.pth"),
+        ("deadbeef", "params.json"),
+    ]
+
+
+def test_verify_checklist_roundtrip(tmp_path: Path):
+    f = tmp_path / "params.json"
+    f.write_bytes(b'{"dim": 4096}')
+    (tmp_path / "checklist.chk").write_text(f"{md5_file(f)}  params.json\n")
+    assert verify_checklist(tmp_path)
+    f.write_bytes(b"corrupted")
+    assert not verify_checklist(tmp_path)
+
+
+def test_verify_checklist_missing_file(tmp_path: Path):
+    (tmp_path / "checklist.chk").write_text("00ff  missing.pth\n")
+    assert not verify_checklist(tmp_path)
+    assert not verify_checklist(tmp_path / "nonexistent")
+
+
+def test_shard_counts_cover_published_sizes():
+    # README.md:44-53 of the reference: MP degrees per size; shard count
+    # equals the fairscale MP degree of the published checkpoints.
+    assert N_SHARDS["7B"] == 1 and N_SHARDS["13B"] == 2
+    assert N_SHARDS["65B"] == 8 and N_SHARDS["70B"] == 8

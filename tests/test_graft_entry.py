@@ -23,10 +23,11 @@ def test_dryrun_multichip_8():
 
 
 def test_mesh_factors():
-    assert graft._mesh_factors(8) == (2, 2, 2)
-    assert graft._mesh_factors(4) == (1, 2, 2)
-    assert graft._mesh_factors(2) == (1, 1, 2)
-    assert graft._mesh_factors(1) == (1, 1, 1)
+    assert graft._mesh_factors(8) == (1, 2, 2, 2)
+    assert graft._mesh_factors(16) == (2, 2, 2, 2)
+    assert graft._mesh_factors(4) == (1, 1, 2, 2)
+    assert graft._mesh_factors(2) == (1, 1, 1, 2)
+    assert graft._mesh_factors(1) == (1, 1, 1, 1)
     for n in (1, 2, 4, 6, 8, 16):
-        d, f, t = graft._mesh_factors(n)
-        assert d * f * t == n
+        d, f, s, t = graft._mesh_factors(n)
+        assert d * f * s * t == n
