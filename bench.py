@@ -11,7 +11,9 @@ measured against that target.
 The bench model is a ~1B-param Llama-3-architecture config (GQA 2:1, SwiGLU,
 bf16) — the largest that comfortably fits a single v5e-lite chip with its KV
 cache.  Decode throughput is measured over full-length generations with no
-stop tokens, steady-state (after one compile warmup), batch 8.
+stop tokens, steady-state (after one compile warmup), batch 8.  The headline
+value is the bf16-weight path (parity-honest vs the reference's fp32/bf16
+serving); the int8 weight-only serving path is reported in `detail`.
 """
 
 from __future__ import annotations
@@ -27,6 +29,7 @@ def main() -> None:
     import jax.numpy as jnp
     import jax_llama_tpu as jlt
     from jax_llama_tpu.engine import GenerationConfig, generate
+    from jax_llama_tpu.ops.quant import quantize_params
 
     # param_dtype bf16: decode is HBM-bandwidth-bound, so serving keeps
     # weights in bf16 (2 bytes/param of traffic per step, not 4).
@@ -45,12 +48,12 @@ def main() -> None:
     mask = jnp.ones((B, P), dtype=bool)
     key = jax.random.PRNGKey(0)
 
-    def run(max_new: int) -> float:
+    def run(p, max_new: int) -> float:
         gc = GenerationConfig(
             max_new_tokens=max_new, temperature=0.0, stop_tokens=()
         )
         t0 = time.time()
-        out = generate(params, tokens, mask, key, config=config, gen_config=gc)
+        out = generate(p, tokens, mask, key, config=config, gen_config=gc)
         # Sync via host transfer, NOT block_until_ready: under the axon
         # tunnel backend block_until_ready/effects_barrier return while the
         # computation is still in flight, and the [B, P+N] int32 fetch is
@@ -58,18 +61,25 @@ def main() -> None:
         np.asarray(out)
         return time.time() - t0
 
+    def measure(p):
+        """Steady-state decode rate: the (prefill + N) vs (prefill + 1)
+        difference cancels prefill time out of the metric."""
+        full = min(run(p, N) for _ in range(3))
+        short = min(run(p, 1) for _ in range(3))
+        decode_s = max(full - short, 1e-9)
+        return B * (N - 1) / decode_s, decode_s, full, short
+
     t0 = time.time()
-    run(N)
-    run(1)
+    run(params, N)
+    run(params, 1)
     compile_s = time.time() - t0
 
-    # Decode rate from the difference of (prefill + N) and (prefill + 1)
-    # runs, so prefill time cancels and the metric is pure steady-state
-    # decode tokens/sec.
-    full = min(run(N) for _ in range(3))
-    short = min(run(1) for _ in range(3))
-    decode_s = max(full - short, 1e-9)
-    toks_per_s = B * (N - 1) / decode_s
+    toks_per_s, decode_s, full, short = measure(params)
+
+    qparams = quantize_params(params)
+    run(qparams, N)
+    run(qparams, 1)
+    int8_toks_per_s, _, _, _ = measure(qparams)
 
     # BASELINE.json's 50 tok/s/chip target is stated for Llama-3-70B on
     # v5p; decode is HBM-bandwidth-bound, so scale the per-chip target by
@@ -90,6 +100,7 @@ def main() -> None:
             "prefill+decode_s": round(full, 3),
             "prefill_s": round(short, 3),
             "per_token_ms": round(1e3 * decode_s / (N - 1), 2),
+            "int8_tokens_per_s": round(int8_toks_per_s, 2),
         },
     }
     print(json.dumps(result))

@@ -82,11 +82,14 @@ def _fetch(url: str, dest: Path) -> None:
 def download(presigned_url: str, model_sizes: List[str], target: Path) -> None:
     sub = lambda rel: presigned_url.replace("*", rel)
 
-    print("Downloading tokenizer")
-    for name in ("tokenizer.model", "tokenizer_checklist.chk"):
-        _fetch(sub(name), target / name)
-    if not verify_checklist(target, "tokenizer_checklist.chk"):
-        raise SystemExit("tokenizer checksum verification failed")
+    if verify_checklist(target, "tokenizer_checklist.chk"):
+        print("Tokenizer already downloaded and verified, skipping")
+    else:
+        print("Downloading tokenizer")
+        for name in ("tokenizer.model", "tokenizer_checklist.chk"):
+            _fetch(sub(name), target / name)
+        if not verify_checklist(target, "tokenizer_checklist.chk"):
+            raise SystemExit("tokenizer checksum verification failed")
 
     for size in model_sizes:
         if size not in N_SHARDS:
@@ -96,11 +99,23 @@ def download(presigned_url: str, model_sizes: List[str], target: Path) -> None:
             print(f"{size}: already downloaded and verified, skipping")
             continue
         print(f"Downloading {size}")
+        # Checklist first, so per-shard resume can verify against it: an
+        # interrupted 8-shard (~130GB) download then re-fetches only the
+        # shards that are missing or fail their checksum.
+        for name in ("checklist.chk", "params.json"):
+            if not (d / name).exists():
+                _fetch(sub(f"{size}/{name}"), d / name)
+        digests = {
+            name: digest
+            for digest, name in parse_checklist((d / "checklist.chk").read_text())
+        }
         for s in range(N_SHARDS[size]):
-            _fetch(sub(f"{size}/consolidated.{s:02d}.pth"),
-                   d / f"consolidated.{s:02d}.pth")
-        for name in ("params.json", "checklist.chk"):
-            _fetch(sub(f"{size}/{name}"), d / name)
+            name = f"consolidated.{s:02d}.pth"
+            dest = d / name
+            if dest.exists() and digests.get(name) == md5_file(dest):
+                print(f"  {name}: verified, skipping")
+                continue
+            _fetch(sub(f"{size}/{name}"), dest)
         print("Checking checksums")
         if not verify_checklist(d):
             raise SystemExit(f"{size}: checksum verification failed")

@@ -77,18 +77,23 @@ def sdpa(
     b, t, h, d = q.shape
     kvh = k.shape[2]
     assert h % kvh == 0, (h, kvh)
-    k = repeat_kv(k, h // kvh)
-    v = repeat_kv(v, h // kvh)
+    g = h // kvh
 
+    # Grouped einsum instead of repeat_kv(k/v): a materialized KV broadcast
+    # would cost g× the cache's HBM traffic per step (and XLA:TPU was
+    # observed to materialize it in fp32 — ~4× again).  Folding the group
+    # dim into the contraction keeps K/V at their stored size and dtype;
+    # only the (tiny) scores/weights carry the replication.
+    qg = q.reshape(b, t, kvh, g, d)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
     scores = jnp.einsum(
-        "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+        "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
     ) * scale
     if bias is not None:
-        scores = scores + bias
+        scores = scores + bias[:, :, None]  # [B,1,T,S] -> [B,1,1,T,S]
     scores = scores.astype(softmax_dtype)
     weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum(
-        "bhts,bshd->bthd", weights, v, preferred_element_type=jnp.float32
+        "bkgts,bskd->btkgd", weights, v, preferred_element_type=jnp.float32
     )
-    return out.astype(q.dtype)
+    return out.reshape(b, t, h, d).astype(q.dtype)

@@ -55,10 +55,17 @@ def initialize(
         process_id = int(os.environ["JAX_PROCESS_ID"])
 
     explicit = coordinator_address is not None
-    on_tpu_pod = (
-        jax.default_backend() == "tpu" and not explicit
-        and os.environ.get("TPU_WORKER_HOSTNAMES")  # pod slice: >1 worker
-    )
+    # Pod detection must NOT touch the jax backend (e.g. via
+    # jax.default_backend()): jax.distributed.initialize() raises if any
+    # XLA backend is already initialized.  The TPU runtime env is enough:
+    # TPU_WORKER_HOSTNAMES lists every worker of a slice, so >1 entry
+    # means multi-host (a single-host TPU VM lists only itself and needs
+    # no coordination service).
+    workers = [
+        h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+        if h.strip()
+    ]
+    on_tpu_pod = not explicit and len(workers) > 1
     if not explicit and not on_tpu_pod:
         return  # single-process: nothing to initialize
 

@@ -40,3 +40,61 @@ def test_shard_counts_cover_published_sizes():
     # equals the fairscale MP degree of the published checkpoints.
     assert N_SHARDS["7B"] == 1 and N_SHARDS["13B"] == 2
     assert N_SHARDS["65B"] == 8 and N_SHARDS["70B"] == 8
+
+
+def test_download_resumes_verified_shards(tmp_path: Path, monkeypatch):
+    """Interrupted model download re-fetches only missing/corrupt shards."""
+    import jax_llama_tpu.download as dl
+
+    d = tmp_path / "13B"
+    d.mkdir()
+    good = d / "consolidated.00.pth"
+    good.write_bytes(b"shard zero")
+    params = d / "params.json"
+    params.write_bytes(b"{}")
+    # checklist covers both shards + params; shard 1 is missing (interrupt)
+    (d / "checklist.chk").write_text(
+        f"{md5_file(good)}  consolidated.00.pth\n"
+        f"{md5_file(params)}  params.json\n"
+        "0123456789abcdef0123456789abcdef  consolidated.01.pth\n"
+    )
+    (tmp_path / "tokenizer.model").write_bytes(b"tok")
+    (tmp_path / "tokenizer_checklist.chk").write_text(
+        f"{md5_file(tmp_path / 'tokenizer.model')}  tokenizer.model\n"
+    )
+
+    fetched = []
+
+    def fake_fetch(url, dest):
+        fetched.append(dest.name)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_bytes(b"shard one")
+
+    monkeypatch.setattr(dl, "_fetch", fake_fetch)
+    # final verify fails (fake shard 1 has wrong digest) -> SystemExit; the
+    # point of the test is which files were fetched before that.
+    try:
+        dl.download("https://host/*?sig", ["13B"], tmp_path)
+    except SystemExit:
+        pass
+    assert fetched == ["consolidated.01.pth"]
+
+
+def test_initialize_single_host_is_noop(monkeypatch):
+    """One worker hostname (single-host TPU VM) must not bring up the
+    coordination service; >1 workers must."""
+    import jax_llama_tpu.parallel.distributed as dist
+
+    calls = []
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setattr(
+        dist.jax.distributed, "initialize",
+        lambda **kw: calls.append(kw),
+    )
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0")
+    dist.initialize()
+    assert calls == []
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+    dist.initialize()
+    assert len(calls) == 1
