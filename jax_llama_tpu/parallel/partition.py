@@ -40,7 +40,12 @@ def param_partition_specs(
     """
     f = "fsdp" if fsdp else None
     specs: Dict[str, Any] = {
-        "embed": {"embedding": P("tensor", f)},      # vocab-sharded
+        # Vocab-sharded over BOTH model axes, hidden dim unsharded: a
+        # vocab-sharded table lowers the token gather to masked-gather +
+        # all-reduce, while sharding D (e.g. over fsdp) was observed to
+        # trigger SPMD's involuntary-full-rematerialization fallback when
+        # resharding the gather output to batch-sharded activations.
+        "embed": {"embedding": P(("tensor", f) if f else "tensor", None)},
         "layers": {
             "attn_norm": P(None, None),
             "q": P(None, f, "tensor", None),         # column-parallel (heads)
@@ -85,6 +90,11 @@ def validate_tp(config: LLaMAConfig, mesh: Mesh, *, fsdp: bool = False) -> None:
             raise ValueError(f"fsdp={fs} must divide dim={config.dim}")
         if config.ffn_dim % fs:
             raise ValueError(f"fsdp={fs} must divide ffn_dim={config.ffn_dim}")
+        if config.vocab_size % (tp * fs):
+            raise ValueError(
+                f"tensor*fsdp={tp * fs} must divide vocab="
+                f"{config.vocab_size} (vocab-sharded embedding)"
+            )
 
 
 def shard_params(
