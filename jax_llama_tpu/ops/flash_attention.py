@@ -66,36 +66,52 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0]  # [bq, d]
-    k = k_ref[0, 0]  # [bk, d]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [bq, bk]
-
     # Positions arrive replicated across lanes/sublanes (Mosaic's last-two-
     # dims tiling rules reject narrow int vectors); slice one copy each.
     qp = q_pos_ref[0, :, :1]  # [bq, 1]
     kp = kv_pos_ref[0, :1, :]  # [1, bk]
-    allowed = (kp <= qp) & (kp >= 0)
-    s = jnp.where(allowed, s, MASK_VALUE)
 
-    m_prev = m_ref[:, :1]  # [bq, 1]
-    m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)  # [bq, 1] rescale of old state
-    p = jnp.exp(s - m_new)  # [bq, bk]
+    # Block-level causal skip: if the smallest *live* kv position in this
+    # block exceeds every query position, no (q, kv) pair is attendable and
+    # both dots + the softmax update can be skipped — for standard causal
+    # prefill that halves the MXU work (every block above the diagonal).
+    # Padding slots (-1) don't count as live; an all-padding block is
+    # skipped too (the finalize guards l == 0 for rows that never attend).
+    live_kp = jnp.where(kp >= 0, kp, jnp.iinfo(jnp.int32).max)
+    block_live = jnp.min(live_kp) <= jnp.max(qp)
 
-    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+    @pl.when(block_live)
+    def _compute():
+        q = q_ref[0, 0]  # [bq, d]
+        k = k_ref[0, 0]  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        allowed = (kp <= qp) & (kp >= 0)
+        s = jnp.where(allowed, s, MASK_VALUE)
+
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 1] rescale of old state
+        p = jnp.exp(s - m_new)  # [bq, bk]
+
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        l = l_ref[:, :1]
+        # l == 0 iff the row never saw a live kv slot (every block skipped);
+        # emit 0 instead of 0/0 NaN.
+        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype
+        )
 
 
 def _pad_to(x: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
@@ -262,6 +278,13 @@ def _flash_forward(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        # batch/head/q-block are independent ("parallel"); only the k sweep
+        # carries state through scratch ("arbitrary").  Without this hint
+        # Mosaic treats the whole grid as sequential and cannot pipeline
+        # block DMA against compute — measured ~4x slower at 16k context.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(q_pos_r, kv_pos_r, qt, kt, vt)
     return jnp.swapaxes(out[:, :, :T, :], 1, 2)  # [B, T, H, d]
