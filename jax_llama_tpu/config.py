@@ -64,6 +64,8 @@ class LLaMAConfig:
     remat: bool = False                   # jax.checkpoint each block
     attn_impl: str = "xla"                # "xla" | "flash" (Pallas) | "ring"
                                           #   (seq-parallel ring attention)
+    pp_microbatches: Optional[int] = None # GPipe microbatch count when the
+                                          #   mesh has stage > 1 (None -> S)
     attn_softmax_dtype: str = "float32"   # fp32 softmax island
     logits_dtype: str = "float32"         # fp32 logits island
 

@@ -330,7 +330,59 @@ def forward(
         block = jax.checkpoint(block)
 
     lp = params["layers"]
-    if config.scan_layers:
+    from ..parallel.mesh import current_mesh
+
+    _mesh = current_mesh()
+    pp_stages = _mesh.shape.get("stage", 1) if _mesh is not None else 1
+    if pp_stages > 1 and cache is not None:
+        # shard_params on a stage>1 mesh stores each layer's weights only on
+        # its stage group; running the plain scan over that layout would
+        # silently all-gather every layer's weights per decode step.
+        raise NotImplementedError(
+            "decode with a KV cache is not supported on a stage > 1 mesh; "
+            "generation meshes keep stage == 1 (use data/tensor axes)"
+        )
+    if pp_stages > 1:
+        # Pipeline-parallel block stack (training / scoring).  Embed, final
+        # norm, and the LM head stay outside — auto-sharded, replicated
+        # over the stage axis.  Decode-over-cache under pipeline
+        # parallelism is not supported (the cache would need to live
+        # per-stage); generation meshes keep stage == 1.
+        from ..parallel.pipeline import pipeline_blocks
+
+        if _mesh.shape.get("seq", 1) > 1:
+            raise NotImplementedError(
+                "stage > 1 does not compose with seq > 1 (ring attention "
+                "nests a second shard_map); use stage*tensor*data/fsdp "
+                "meshes for pipeline training"
+            )
+
+        def stage_fn(stage_layers, xx, pos, spos):
+            sbias = (
+                None
+                if config.attn_impl in ("flash", "ring")
+                else attention_bias(pos, spos, spos >= 0)
+            )
+
+            def one(carry, lp_i):
+                y, _, _ = _block(
+                    carry, lp_i, None, None,
+                    config=config, positions=pos, bias=sbias,
+                    slot_pos=spos, cache_index=None, cos=cos, sin=sin,
+                )
+                return y, None
+
+            if config.remat:
+                one = jax.checkpoint(one)
+            y, _ = lax.scan(one, xx, stage_layers)
+            return y
+
+        x = pipeline_blocks(
+            stage_fn, lp, x, q_positions, slot_pos,
+            mesh=_mesh,
+            n_microbatches=config.pp_microbatches or pp_stages,
+        )
+    elif config.scan_layers:
         if cache is not None:
             def scan_fn(carry, xs):
                 layer_params, ck, cv = xs

@@ -13,11 +13,13 @@ from .partition import (
     shard_params,
     validate_tp,
 )
+from .pipeline import pipeline_blocks
 from .ring import ring_attention, ring_sdpa
 from . import distributed
 
 __all__ = [
     "distributed",
+    "pipeline_blocks",
     "shard_abstract",
     "ring_attention",
     "ring_sdpa",

@@ -6,6 +6,9 @@ helper on a deprecated global-mesh API (``/root/reference/jax_llama/
 partition.py:83-98``).  Here the mesh is an explicit context with four axes:
 
     data    — data parallel (batch), rides DCN between slices
+    stage   — pipeline parallel (GPipe microbatches, parallel.pipeline);
+              stage→stage+1 ppermute traffic is point-to-point, so outer
+              ICI / DCN links suffice
     fsdp    — ZeRO-style param sharding (batch-combined with `data` for
               activations), inner ICI
     seq     — sequence/context parallel (ring attention), ICI
@@ -26,7 +29,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "fsdp", "seq", "tensor")
+AXES = ("data", "stage", "fsdp", "seq", "tensor")
 
 # Logical-name -> mesh-axis translation for activation constraints.  The
 # batch dimension is sharded over both data-parallel axes (pure-DP inference
@@ -44,24 +47,27 @@ _local = threading.local()
 
 def make_mesh(
     data: int = 1,
+    stage: int = 1,
     fsdp: int = 1,
     seq: int = 1,
     tensor: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a 4-axis mesh.  Total axis product must equal device count.
+    """Build a 5-axis mesh.  Total axis product must equal device count.
 
     Axis order places `tensor` innermost so TP collectives ride the
     highest-bandwidth ICI links, `data` outermost so DP gradients/batches
-    cross DCN (cf. the scaling-book mesh recipe).
+    cross DCN, `stage` next-outermost (pipeline hops are point-to-point)
+    (cf. the scaling-book mesh recipe).
     """
     devices = list(devices if devices is not None else jax.devices())
-    want = data * fsdp * seq * tensor
+    want = data * stage * fsdp * seq * tensor
     if want != len(devices):
         raise ValueError(
-            f"mesh {data}x{fsdp}x{seq}x{tensor}={want} != {len(devices)} devices"
+            f"mesh {data}x{stage}x{fsdp}x{seq}x{tensor}={want} "
+            f"!= {len(devices)} devices"
         )
-    arr = np.asarray(devices).reshape(data, fsdp, seq, tensor)
+    arr = np.asarray(devices).reshape(data, stage, fsdp, seq, tensor)
     return Mesh(arr, AXES)
 
 

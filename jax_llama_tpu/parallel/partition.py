@@ -13,9 +13,10 @@ specs are written as a mirror-shaped pytree — no regex window-matching
 (reference partition.py:16-41) needed, and completeness is checked
 structurally rather than via runtime assert on a miss.
 
-Mesh axes are the canonical four from ``parallel.mesh``: data / fsdp / seq /
-tensor.  KV-head sharding requires ``tensor`` to divide ``n_kv_heads`` (GQA
-models: 8 for llama3) — checked in `validate_tp`.
+Mesh axes are the canonical five from ``parallel.mesh``: data / stage /
+fsdp / seq / tensor.  KV-head sharding requires ``tensor`` to divide
+``n_kv_heads`` (GQA models: 8 for llama3); pipeline sharding requires
+``stage`` to divide ``n_layers`` — checked in `validate_tp`.
 """
 
 from __future__ import annotations
@@ -30,15 +31,18 @@ from ..ops.quant import QuantizedTensor
 
 
 def param_partition_specs(
-    config: LLaMAConfig, *, fsdp: bool = False
+    config: LLaMAConfig, *, fsdp: bool = False, pp: bool = False
 ) -> Dict[str, Any]:
     """PartitionSpec pytree mirroring the `init_params` tree.
 
-    Layer params carry a leading stacked-L axis (never sharded — lax.scan
-    iterates it).  With ``fsdp=True`` the non-tensor-parallel dimension of
-    every projection is sharded over the ``fsdp`` axis (ZeRO-3-style).
+    Layer params carry a leading stacked-L axis: with ``pp=True`` it is
+    sharded over the ``stage`` mesh axis (each pipeline stage stores only
+    its own L/S layers); otherwise it is unsharded (lax.scan iterates it).
+    With ``fsdp=True`` the non-tensor-parallel dimension of every
+    projection is sharded over the ``fsdp`` axis (ZeRO-3-style).
     """
     f = "fsdp" if fsdp else None
+    s = "stage" if pp else None
     specs: Dict[str, Any] = {
         # Vocab-sharded over BOTH model axes, hidden dim unsharded: a
         # vocab-sharded table lowers the token gather to masked-gather +
@@ -47,15 +51,15 @@ def param_partition_specs(
         # resharding the gather output to batch-sharded activations.
         "embed": {"embedding": P(("tensor", f) if f else "tensor", None)},
         "layers": {
-            "attn_norm": P(None, None),
-            "q": P(None, f, "tensor", None),         # column-parallel (heads)
-            "k": P(None, f, "tensor", None),
-            "v": P(None, f, "tensor", None),
-            "o": P(None, "tensor", None, f),         # row-parallel
-            "mlp_norm": P(None, None),
-            "gate": P(None, f, "tensor"),            # column-parallel
-            "up": P(None, f, "tensor"),
-            "down": P(None, "tensor", f),            # row-parallel
+            "attn_norm": P(s, None),
+            "q": P(s, f, "tensor", None),            # column-parallel (heads)
+            "k": P(s, f, "tensor", None),
+            "v": P(s, f, "tensor", None),
+            "o": P(s, "tensor", None, f),            # row-parallel
+            "mlp_norm": P(s, None),
+            "gate": P(s, f, "tensor"),               # column-parallel
+            "up": P(s, f, "tensor"),
+            "down": P(s, "tensor", f),               # row-parallel
         },
         "final_norm": P(None),
     }
@@ -72,6 +76,12 @@ def validate_tp(config: LLaMAConfig, mesh: Mesh, *, fsdp: bool = False) -> None:
     own: its sharding propagates from the constrained k/v projections that
     write it.)
     """
+    st = mesh.shape.get("stage", 1)
+    if config.n_layers % st:
+        raise ValueError(
+            f"stage={st} must divide n_layers={config.n_layers} "
+            "(pipeline stages hold equal layer counts)"
+        )
     tp = mesh.shape["tensor"]
     if config.kv_heads % tp:
         raise ValueError(
@@ -111,7 +121,9 @@ def shard_params(
     driven by the structured spec tree.
     """
     validate_tp(config, mesh, fsdp=fsdp)
-    specs = param_partition_specs(config, fsdp=fsdp)
+    specs = param_partition_specs(
+        config, fsdp=fsdp, pp=mesh.shape.get("stage", 1) > 1
+    )
 
     def put(x, sharding):
         return jax.device_put(x, sharding)
@@ -129,7 +141,9 @@ def shard_abstract(
     """Attach NamedShardings to an abstract (eval_shape) param tree — the
     form Orbax needs to restore each shard straight to its owning host."""
     validate_tp(config, mesh, fsdp=fsdp)
-    specs = param_partition_specs(config, fsdp=fsdp)
+    specs = param_partition_specs(
+        config, fsdp=fsdp, pp=mesh.shape.get("stage", 1) > 1
+    )
 
     def abstract(x, sharding):
         return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
