@@ -46,7 +46,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..config import LLaMAConfig
-from ..ops.attention import attention_bias, sdpa
+from ..ops.attention import attention_bias, sdpa, sdpa_cached
 from ..ops.flash_attention import flash_attention
 from ..ops.norm import rms_norm
 from ..ops.quant import matmul as qeinsum
@@ -176,6 +176,7 @@ def _block(
     cache_index: Optional[jnp.ndarray],
     cos: jnp.ndarray,
     sin: jnp.ndarray,
+    bias_new: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
     """One pre-norm transformer block. x: [B, T, D]."""
     B, T, D = x.shape
@@ -196,30 +197,46 @@ def _block(
     softmax_dtype = jnp.dtype(config.attn_softmax_dtype)
     if config.attn_impl not in ("xla", "flash", "ring"):
         raise NotImplementedError(f"attn_impl={config.attn_impl!r}")
-    if cache_k is not None:
-        # Write the T new KV entries at [cache_index, cache_index+T), then
-        # attend over the full fixed-size cache.  GQA replication happens
-        # inside the attention op, *after* the cache — the cache stores only
-        # KVH heads (parity with reference model.py:269-270).
-        cache_k = lax.dynamic_update_slice(
-            cache_k, k.astype(cache_k.dtype), (0, cache_index, 0, 0)
+    if cache_k is not None and config.attn_impl == "xla":
+        # Append-free decode: the cache stays immutable through the layer
+        # scan; sdpa_cached softmaxes jointly over (cache slots, new
+        # tokens) at the scores level, and the caller applies ONE in-place
+        # dynamic-update-slice per step after the scan.  Mutating the
+        # cache per layer inside scan/while forced XLA into a full-cache
+        # double-buffer copy every decode step.  GQA replication stays
+        # inside the attention op, after the cache (parity with reference
+        # model.py:269-270).  ``bias`` masks the cache (unwritten slots
+        # carry pos -1), ``bias_new`` masks/causes the new tokens.
+        attn = sdpa_cached(
+            q, cache_k.astype(adt), cache_v.astype(adt), k, v,
+            bias, bias_new, softmax_dtype=softmax_dtype,
         )
-        cache_v = lax.dynamic_update_slice(
-            cache_v, v.astype(cache_v.dtype), (0, cache_index, 0, 0)
-        )
-        kk, vv = cache_k.astype(adt), cache_v.astype(adt)
+        # ys: just this step's projections; forward writes them into the
+        # cache once, outside the scan.
+        cache_k, cache_v = k, v
     else:
-        kk, vv = k, v
-    if config.attn_impl == "ring" and cache_k is None:
-        # Sequence-parallel path (training / scoring / cache-free prefill):
-        # ring over the seq mesh axis.
-        from ..parallel.ring import ring_sdpa
+        if cache_k is not None:
+            # Flash path: write the T new KV entries at
+            # [cache_index, cache_index+T), then attend the full cache.
+            cache_k = lax.dynamic_update_slice(
+                cache_k, k.astype(cache_k.dtype), (0, cache_index, 0, 0)
+            )
+            cache_v = lax.dynamic_update_slice(
+                cache_v, v.astype(cache_v.dtype), (0, cache_index, 0, 0)
+            )
+            kk, vv = cache_k.astype(adt), cache_v.astype(adt)
+        else:
+            kk, vv = k, v
+        if config.attn_impl == "ring" and cache_k is None:
+            # Sequence-parallel path (training / scoring / cache-free
+            # prefill): ring over the seq mesh axis.
+            from ..parallel.ring import ring_sdpa
 
-        attn = ring_sdpa(q, kk, vv, positions, slot_pos)
-    elif config.attn_impl in ("flash", "ring"):
-        attn = flash_attention(q, kk, vv, positions, slot_pos)
-    else:
-        attn = sdpa(q, kk, vv, bias, softmax_dtype=softmax_dtype)
+            attn = ring_sdpa(q, kk, vv, positions, slot_pos)
+        elif config.attn_impl in ("flash", "ring"):
+            attn = flash_attention(q, kk, vv, positions, slot_pos)
+        else:
+            attn = sdpa(q, kk, vv, bias, softmax_dtype=softmax_dtype)
 
     attn_out = qeinsum(attn, lp["o"], "bthk,hkd->btd", adt)
     attn_out = constrain(attn_out, "data", "seq", None)
@@ -311,8 +328,16 @@ def forward(
         )
     else:
         slot_pos = new_slot_pos
+    bias_new = None
+    xla_cached = cache is not None and config.attn_impl == "xla"
     if config.attn_impl in ("flash", "ring"):
         bias = None
+    elif xla_cached:
+        # Append-free decode (see _block): the cache bias masks the OLD
+        # cache contents (unwritten slots hold pos -1), the new tokens get
+        # their own within-step causal/padding bias.
+        bias = attention_bias(q_positions, cache.pos, cache.pos >= 0)
+        bias_new = attention_bias(q_positions, new_slot_pos, attn_mask)
     else:
         bias = attention_bias(q_positions, slot_pos, slot_pos >= 0)
 
@@ -325,6 +350,7 @@ def forward(
         cache_index=cache.index if cache is not None else None,
         cos=cos,
         sin=sin,
+        bias_new=bias_new,
     )
     if config.remat:
         block = jax.checkpoint(block)
@@ -384,6 +410,10 @@ def forward(
         )
     elif config.scan_layers:
         if cache is not None:
+            # On the xla_cached path the cache rides xs READ-ONLY and the
+            # ys are just each layer's new [B,T,KVH,hd] projections —
+            # rebuilding the full cache as ys would force a whole-cache
+            # double-buffer copy per decode step inside scan/while.
             def scan_fn(carry, xs):
                 layer_params, ck, cv = xs
                 y, ck, cv = block(carry, layer_params, ck, cv)
@@ -408,6 +438,15 @@ def forward(
         if cache is not None:
             new_k = jnp.stack(new_ks)
             new_v = jnp.stack(new_vs)
+    if cache is not None and xla_cached:
+        # new_k/new_v hold the per-layer NEW projections [L, B, T, KVH, hd];
+        # one in-place dynamic-update-slice writes them all into the cache.
+        new_k = lax.dynamic_update_slice(
+            cache.k, new_k.astype(cache.k.dtype), (0, 0, cache.index, 0, 0)
+        )
+        new_v = lax.dynamic_update_slice(
+            cache.v, new_v.astype(cache.v.dtype), (0, 0, cache.index, 0, 0)
+        )
 
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
 

@@ -58,6 +58,58 @@ def attention_bias(
     return bias[:, None, :, :]
 
 
+def sdpa_cached(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    bias_cache: jnp.ndarray,
+    bias_new: jnp.ndarray,
+    softmax_dtype: jnp.dtype = jnp.float32,
+) -> jnp.ndarray:
+    """Append-free cached attention: softmax over the (immutable) cache and
+    the step's new KV jointly, concatenated at the *scores* level.
+
+    Equivalent to writing the new KV into the cache first and attending the
+    whole buffer, but the cache is never mutated inside the layer stack —
+    so the decode engine can apply ONE in-place dynamic-update-slice per
+    step after the scan instead of rewriting the cache per layer, which
+    costs a full-cache double-buffer copy every step inside lax.scan/while.
+
+    Args:
+      q: [B, T, H, D].
+      k_cache, v_cache: [B, S, KVH, D] — previously written slots only
+        (unwritten slots must be masked by ``bias_cache``).
+      k_new, v_new: [B, T, KVH, D] — this step's projections.
+      bias_cache: [B, 1, T, S] additive bias over the cache slots.
+      bias_new: [B, 1, T, T] additive bias over the new tokens
+        (within-step causality + padding).
+    Returns:
+      [B, T, H, D] in q.dtype.
+    """
+    b, t, h, d = q.shape
+    kvh = k_cache.shape[2]
+    g = h // kvh
+    qg = q.reshape(b, t, kvh, g, d)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    s1 = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k_cache, preferred_element_type=jnp.float32
+    ) * scale + bias_cache[:, :, None]
+    s2 = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k_new, preferred_element_type=jnp.float32
+    ) * scale + bias_new[:, :, None]
+    s = jnp.concatenate([s1, s2], axis=-1).astype(softmax_dtype)
+    w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    w1, w2 = w[..., : s1.shape[-1]], w[..., s1.shape[-1]:]
+    out = jnp.einsum(
+        "bkgts,bskd->btkgd", w1, v_cache, preferred_element_type=jnp.float32
+    ) + jnp.einsum(
+        "bkgts,bskd->btkgd", w2, v_new, preferred_element_type=jnp.float32
+    )
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
 def sdpa(
     q: jnp.ndarray,
     k: jnp.ndarray,
