@@ -1,0 +1,140 @@
+"""Training input pipeline: document packing + sharded host→device feed.
+
+The reference has no training and therefore no data loader (SURVEY.md: the
+repo is inference-only).  Training here is first-class, so the input side
+is too — the TPU-idiomatic shape: fixed-size [B, T] batches (static shapes
+keep one compiled train_step), greedy document packing with EOS separators
+(no padding waste), a loss mask that excludes the separator targets, and
+`jax.device_put` with the batch sharded over the mesh's data axes so each
+host/device group receives only its slice.
+
+    tok = LLaMA3Tokenizer("tokenizer.model")
+    docs = (tok.encode(line, bos=True, eos=True) for line in corpus)
+    for batch in batches(docs, batch_size=8, seq_len=2048, pad_id=tok.pad_id):
+        state, loss = train_step(state, shard_batch(batch, mesh).tokens, ...)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Batch:
+    """One packed training batch.
+
+    tokens:    [B, T] int32.
+    loss_mask: [B, T] bool — True where the position's *target* (the next
+               token) is a real document token; False on padding and at
+               document boundaries crossing into a new document's BOS.
+    """
+
+    tokens: np.ndarray
+    loss_mask: np.ndarray
+
+
+def pack_documents(
+    docs: Iterable[Sequence[int]],
+    seq_len: int,
+    pad_id: int = 0,
+) -> Iterator[Batch]:
+    """Greedily pack token sequences into fixed [seq_len] rows.
+
+    Documents are concatenated back-to-back; a document longer than
+    ``seq_len`` spans multiple rows (its continuation keeps contributing
+    loss).  The final partial row is padded with ``pad_id`` and those
+    positions are masked out of the loss.  Yields one row at a time;
+    callers batch them (see ``batches``).
+    """
+    if seq_len < 2:
+        raise ValueError("seq_len must be >= 2 (need a target per position)")
+    buf: List[int] = []
+    for doc in docs:
+        buf.extend(int(t) for t in doc)
+        while len(buf) >= seq_len:
+            row = np.asarray(buf[:seq_len], dtype=np.int32)
+            del buf[:seq_len]
+            yield Batch(
+                tokens=row,
+                loss_mask=np.ones((seq_len,), dtype=bool),
+            )
+    if buf:
+        row = np.full((seq_len,), pad_id, dtype=np.int32)
+        row[: len(buf)] = buf
+        mask = np.zeros((seq_len,), dtype=bool)
+        # Positions 0..len(buf)-1 are real; the loss target of position i
+        # is token i+1, so the last real position's target is padding —
+        # mask it too.
+        mask[: max(len(buf) - 1, 0)] = True
+        del buf[:]
+        yield Batch(tokens=row, loss_mask=mask)
+
+
+def batches(
+    docs: Iterable[Sequence[int]],
+    batch_size: int,
+    seq_len: int,
+    pad_id: int = 0,
+    drop_remainder: bool = True,
+    seed: Optional[int] = None,
+    shuffle_buffer: int = 0,
+) -> Iterator[Batch]:
+    """Assemble packed rows into [batch_size, seq_len] batches.
+
+    ``shuffle_buffer > 0`` enables buffered shuffling of packed rows with a
+    deterministic RNG (``seed``) — streaming-friendly (bounded memory),
+    reproducible across runs.
+    """
+    rows = pack_documents(docs, seq_len, pad_id)
+    if shuffle_buffer > 0:
+        rows = _buffered_shuffle(rows, shuffle_buffer, seed or 0)
+
+    toks: List[np.ndarray] = []
+    masks: List[np.ndarray] = []
+    for row in rows:
+        toks.append(row.tokens)
+        masks.append(row.loss_mask)
+        if len(toks) == batch_size:
+            yield Batch(tokens=np.stack(toks), loss_mask=np.stack(masks))
+            toks, masks = [], []
+    if toks and not drop_remainder:
+        # Static shapes: pad the last batch up to batch_size with fully
+        # masked rows rather than emitting a ragged batch.
+        pad_rows = batch_size - len(toks)
+        toks.extend(
+            np.full((seq_len,), pad_id, dtype=np.int32) for _ in range(pad_rows)
+        )
+        masks.extend(np.zeros((seq_len,), dtype=bool) for _ in range(pad_rows))
+        yield Batch(tokens=np.stack(toks), loss_mask=np.stack(masks))
+
+
+def _buffered_shuffle(rows: Iterator[Batch], buffer: int, seed: int) -> Iterator[Batch]:
+    rng = np.random.RandomState(seed)
+    pool: List[Batch] = []
+    for row in rows:
+        pool.append(row)
+        if len(pool) >= buffer:
+            i = rng.randint(len(pool))
+            pool[i], pool[-1] = pool[-1], pool[i]
+            yield pool.pop()
+    rng.shuffle(pool)
+    yield from pool
+
+
+def shard_batch(batch: Batch, mesh: Any) -> Batch:
+    """Place a host batch onto the mesh, batch dim over the data axes.
+
+    Under multi-host JAX each process passes its *global* batch here;
+    device_put with a NamedSharding hands every device only its shard.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
+    return Batch(
+        tokens=jax.device_put(batch.tokens, sharding),
+        loss_mask=jax.device_put(batch.loss_mask, sharding),
+    )

@@ -28,9 +28,11 @@ DEFAULT_PROMPTS = [
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ckpt-dir", required=True, help="Orbax checkpoint dir")
-    ap.add_argument("--tokenizer", required=True)
+    ap.add_argument("--tokenizer", default=None)
     ap.add_argument("--llama2", action="store_true",
                     help="sentencepiece (llama2) tokenizer")
+    ap.add_argument("--byte-tokenizer", action="store_true",
+                    help="vocab-file-free byte tokenizer (smoke tests)")
     ap.add_argument("--tensor", type=int, default=0,
                     help="tensor-parallel degree (0 = all local devices)")
     ap.add_argument("--data", type=int, default=1)
@@ -56,13 +58,27 @@ def main() -> None:
 
     n = len(jax.devices())
     tensor = args.tensor or n // (args.data * args.fsdp)
-    mesh = make_mesh(data=args.data, fsdp=args.fsdp, tensor=tensor)
+    # Use exactly the devices the mesh needs — a smaller-than-host mesh
+    # (e.g. --tensor 2 on an 8-device host) is valid for smoke runs.
+    mesh = make_mesh(
+        data=args.data, fsdp=args.fsdp, tensor=tensor,
+        devices=jax.devices()[: args.data * args.fsdp * tensor],
+    )
 
-    if args.llama2:
-        from .tokenizers import LLaMA2Tokenizer as Tok
+    if args.byte_tokenizer:
+        from .tokenizers import ByteTokenizer
+
+        tokenizer = ByteTokenizer()
+    elif args.tokenizer is None:
+        raise SystemExit("--tokenizer is required (or pass --byte-tokenizer)")
+    elif args.llama2:
+        from .tokenizers import LLaMA2Tokenizer
+
+        tokenizer = LLaMA2Tokenizer(args.tokenizer)
     else:
-        from .tokenizers import LLaMA3Tokenizer as Tok
-    tokenizer = Tok(args.tokenizer)
+        from .tokenizers import LLaMA3Tokenizer
+
+        tokenizer = LLaMA3Tokenizer(args.tokenizer)
 
     with Timer() as load_t:
         params, config = load_checkpoint(

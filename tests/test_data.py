@@ -1,0 +1,92 @@
+"""Data pipeline: packing, masking, determinism, mesh sharding, and an
+end-to-end train step fed from the loader."""
+
+import jax
+import numpy as np
+import pytest
+
+from jax_llama_tpu import get_config, init_params, make_mesh
+from jax_llama_tpu.data import Batch, batches, pack_documents, shard_batch
+from jax_llama_tpu.parallel import shard_params
+from jax_llama_tpu.train import init_train_state, make_optimizer, train_step
+
+
+def test_pack_concatenates_and_pads():
+    docs = [[1, 2, 3], [4, 5], [6]]
+    rows = list(pack_documents(docs, seq_len=4, pad_id=0))
+    assert [r.tokens.tolist() for r in rows] == [[1, 2, 3, 4], [5, 6, 0, 0]]
+    assert rows[0].loss_mask.all()
+    # last real position's target is padding -> masked; padding masked.
+    assert rows[1].loss_mask.tolist() == [True, False, False, False]
+
+
+def test_pack_long_document_spans_rows():
+    rows = list(pack_documents([list(range(10))], seq_len=4, pad_id=99))
+    assert [r.tokens.tolist() for r in rows] == [
+        [0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 99, 99]
+    ]
+
+
+def test_pack_rejects_tiny_seq():
+    with pytest.raises(ValueError):
+        list(pack_documents([[1]], seq_len=1))
+
+
+def test_batches_shapes_and_remainder():
+    docs = [[i] * 5 for i in range(7)]  # 35 tokens -> 8 rows of 4 + rem
+    got = list(batches(docs, batch_size=4, seq_len=4, drop_remainder=True))
+    assert all(b.tokens.shape == (4, 4) for b in got)
+    got_pad = list(batches(docs, batch_size=4, seq_len=4, drop_remainder=False))
+    assert len(got_pad) > len(got)
+    last = got_pad[-1]
+    assert last.tokens.shape == (4, 4)
+    assert not last.loss_mask[-1].any()  # padded filler rows carry no loss
+
+
+def test_shuffle_deterministic():
+    docs = [[i] * 4 for i in range(32)]
+    a = [b.tokens.tolist() for b in batches(docs, 2, 4, seed=7, shuffle_buffer=8)]
+    b_ = [b.tokens.tolist() for b in batches(docs, 2, 4, seed=7, shuffle_buffer=8)]
+    c = [b.tokens.tolist() for b in batches(docs, 2, 4, seed=8, shuffle_buffer=8)]
+    assert a == b_
+    assert a != c  # different seed reorders (overwhelmingly likely)
+
+
+def test_shard_batch_places_on_mesh():
+    mesh = make_mesh(data=2, tensor=2, devices=jax.devices()[:4])
+    batch = Batch(
+        tokens=np.zeros((4, 8), np.int32),
+        loss_mask=np.ones((4, 8), bool),
+    )
+    sharded = shard_batch(batch, mesh)
+    assert sharded.tokens.sharding.is_equivalent_to(
+        jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(("data", "fsdp"), None)
+        ),
+        2,
+    )
+
+
+def test_loader_feeds_train_step():
+    config = get_config(
+        "tiny", vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=16,
+    )
+    mesh = make_mesh(data=2, devices=jax.devices()[:2])
+    params = shard_params(
+        init_params(jax.random.PRNGKey(0), config), mesh, config
+    )
+    opt = make_optimizer(1e-3)
+    state = init_train_state(params, opt)
+    rng = np.random.RandomState(0)
+    docs = [rng.randint(1, 64, size=rng.randint(4, 30)).tolist() for _ in range(20)]
+    n = 0
+    for batch in batches(docs, batch_size=2, seq_len=16):
+        batch = shard_batch(batch, mesh)
+        state, loss = train_step(
+            state, batch.tokens, config, opt,
+            loss_mask=batch.loss_mask, mesh=mesh,
+        )
+        assert np.isfinite(float(loss))
+        n += 1
+    assert n >= 1

@@ -1,0 +1,89 @@
+"""Full-scale (70B-class) shape/lowering checks — no weights materialized.
+
+The reference can only express 70B through its MP table (README.md:44-53);
+nothing in its repo validates the shapes.  Here the real llama3-70b config
+is traced abstractly through train and decode paths on an 8-device mesh:
+eval_shape catches dimension/sharding-rule bugs at scale in seconds, and
+jit lowering exercises the scan-over-layers claim (80 layers trace as fast
+as 4 — no Python-unrolled stack, reference model.py:579-592).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax_llama_tpu import get_config, make_mesh
+from jax_llama_tpu.engine import GenerationConfig, generate
+from jax_llama_tpu.models import forward
+from jax_llama_tpu.models.llama import init_params
+from jax_llama_tpu.parallel import param_partition_specs, use_mesh, validate_tp
+
+
+def _abstract_params(config):
+    return jax.eval_shape(lambda k: init_params(k, config), jax.random.PRNGKey(0))
+
+
+def test_llama3_70b_param_count():
+    config = get_config("llama3-70b")
+    shapes = _abstract_params(config)
+    n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+    assert 69e9 < n < 72e9, n  # published: 70.6B
+
+
+def test_llama3_70b_partition_specs_cover_tree():
+    config = get_config("llama3-70b")
+    shapes = _abstract_params(config)
+    specs = param_partition_specs(config, fsdp=True, pp=True)
+    # mirror-shaped: zipping must succeed and cover every leaf
+    zipped = jax.tree.map(lambda a, b: (a, b), shapes, specs)
+    assert len(jax.tree.leaves(zipped, is_leaf=lambda x: isinstance(x, tuple)))
+
+
+def test_llama3_70b_tp8_divisibility():
+    config = get_config("llama3-70b")
+    mesh = make_mesh(tensor=8, devices=np.tile(jax.devices(), 1)[:8])
+    validate_tp(config, mesh, fsdp=False)  # v5p-64-style TP8 must divide
+
+
+def test_llama3_70b_forward_eval_shape():
+    config = get_config("llama3-70b", max_seq_len=8192)
+    shapes = _abstract_params(config)
+    B, T = 4, 8192
+    tokens = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    pos = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    out, _ = jax.eval_shape(
+        lambda p, t, q: forward(p, t, q, config), shapes, tokens, pos
+    )
+    assert out.shape == (B, T, config.vocab_size)
+
+
+def test_llama3_70b_decode_lowering_80_layers():
+    """jit-lower (not compile) the full decode engine for the 80-layer
+    model on a TP8 mesh — completes in seconds because the layer stack is
+    a scan, and catches sharding/shape errors in the whole pipeline."""
+    config = get_config("llama3-70b", max_seq_len=512)
+    mesh = make_mesh(tensor=8, devices=jax.devices()[:8])
+    shapes = _abstract_params(config)
+    B, P = 2, 128
+    tokens = jax.ShapeDtypeStruct((B, P), jnp.int32)
+    mask = jax.ShapeDtypeStruct((B, P), jnp.bool_)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    gc = GenerationConfig(max_new_tokens=64, temperature=0.0)
+    lowered = generate.lower(
+        shapes, tokens, mask, key, config=config, gen_config=gc, mesh=mesh
+    )
+    assert "while" in lowered.as_text()  # the decode loop lowered
+
+
+def test_llama3_70b_train_eval_shape_pp_fsdp():
+    """Abstract train-shapes on a stage*fsdp*tensor mesh at 70B scale."""
+    from jax_llama_tpu.train import lm_loss
+
+    config = get_config("llama3-70b", max_seq_len=4096, remat=True)
+    mesh = make_mesh(stage=2, fsdp=2, tensor=2, devices=jax.devices()[:8])
+    shapes = _abstract_params(config)
+    tokens = jax.ShapeDtypeStruct((8, 4096), jnp.int32)
+    with use_mesh(mesh):
+        loss = jax.eval_shape(lambda p, t: lm_loss(p, t, config), shapes, tokens)
+    assert loss.shape == ()
