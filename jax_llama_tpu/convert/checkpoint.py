@@ -84,3 +84,106 @@ def load_checkpoint(
     ckptr = ocp.StandardCheckpointer()
     params = ckptr.restore(path / "params", abstract)
     return params, config
+
+
+# ---------------------------------------------------------------------------
+# Training checkpoint / resume
+# ---------------------------------------------------------------------------
+
+def save_train_state(path: str, state: Any, config: LLaMAConfig) -> None:
+    """Write a full TrainState (params + optimizer state + step) + config.
+
+    The reference cannot resume anything (SURVEY.md §5: checkpointing is
+    load-only and its convert CLI is broken); this is the training half of
+    the checkpoint story: crash-safe resume with optimizer moments intact.
+    """
+    path = Path(path).absolute()
+    path.mkdir(parents=True, exist_ok=True)
+    with open(path / "config.json", "w") as f:
+        json.dump(dict(dataclasses.asdict(config), _train_state=True), f,
+                  indent=2)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path / "state", state, force=True)
+    ckptr.wait_until_finished()
+
+
+def _suffix_sharding_tree(abstract: Any, abstract_params: Any, mesh: Mesh) -> Any:
+    """Assign shardings to an arbitrary state tree by param-path suffix.
+
+    Optimizer moments (Adam mu/nu) are param-shaped subtrees nested inside
+    optax's state tuples; their leaf paths END with the corresponding param
+    path (e.g. ``(..., 'mu', 'layers', 'q')``).  Each state leaf whose path
+    suffix + shape matches a param leaf inherits that param's sharding;
+    everything else (counts, scalars) is replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    param_leaves = [
+        (tuple(_key_str(k) for k in kp), leaf.sharding, leaf.shape)
+        for kp, leaf in jax.tree_util.tree_leaves_with_path(abstract_params)
+    ]
+    replicated = NamedSharding(mesh, P())
+
+    def assign(kp, leaf):
+        path = tuple(_key_str(k) for k in kp)
+        for ppath, sharding, shape in param_leaves:
+            if len(path) >= len(ppath) and path[-len(ppath):] == ppath \
+                    and leaf.shape == shape:
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=sharding)
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                    sharding=replicated)
+
+    return jax.tree_util.tree_map_with_path(assign, abstract)
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "name"):
+        return str(k.name)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def load_train_state(
+    path: str,
+    optimizer: Any,
+    mesh: Optional[Mesh] = None,
+    *,
+    fsdp: bool = False,
+) -> Tuple[Any, LLaMAConfig]:
+    """Restore (TrainState, config) for training resume.
+
+    With ``mesh``: params and the param-shaped optimizer moments restore
+    straight into their NamedShardings (per-host partial reads); scalar
+    state (step, Adam count) is replicated.
+    """
+    from ..train import init_train_state
+
+    path = Path(path).absolute()
+    with open(path / "config.json") as f:
+        meta = json.load(f)
+    meta.pop("_train_state", None)
+    meta.pop("_quantized", None)
+    config = LLaMAConfig(**meta)
+
+    shapes = jax.eval_shape(
+        lambda: init_train_state(
+            init_params(jax.random.PRNGKey(0), config), optimizer
+        )
+    )
+    if mesh is not None:
+        from ..parallel.partition import shard_abstract
+
+        param_shapes = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), config)
+        )
+        abstract_params = shard_abstract(param_shapes, mesh, config, fsdp=fsdp)
+        abstract = _suffix_sharding_tree(shapes, abstract_params, mesh)
+    else:
+        abstract = shapes
+    ckptr = ocp.StandardCheckpointer()
+    state = ckptr.restore(path / "state", abstract)
+    return state, config
