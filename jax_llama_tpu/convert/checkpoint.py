@@ -48,10 +48,16 @@ def save_checkpoint(path: str, params: Any, config: LLaMAConfig) -> None:
 
 
 def load_config(path: str) -> Tuple[LLaMAConfig, bool]:
+    config, quantized, is_train = _load_meta(path)
+    return config, quantized
+
+
+def _load_meta(path: str) -> Tuple[LLaMAConfig, bool, bool]:
     with open(Path(path) / "config.json") as f:
         meta = json.load(f)
     quantized = meta.pop("_quantized", False)
-    return LLaMAConfig(**meta), quantized
+    is_train = meta.pop("_train_state", False)
+    return LLaMAConfig(**meta), quantized, is_train
 
 
 def load_checkpoint(
@@ -68,7 +74,13 @@ def load_checkpoint(
     21-26).  Without: plain host restore.
     """
     path = Path(path).absolute()
-    config, quantized = load_config(path)
+    config, quantized, is_train = _load_meta(path)
+    if is_train:
+        raise ValueError(
+            f"{path} is a training checkpoint (params + optimizer state); "
+            "restore it with load_train_state, or save serving weights "
+            "with save_checkpoint(state.params, ...)"
+        )
 
     def build():
         params = init_params(jax.random.PRNGKey(0), config)
@@ -163,11 +175,12 @@ def load_train_state(
     from ..train import init_train_state
 
     path = Path(path).absolute()
-    with open(path / "config.json") as f:
-        meta = json.load(f)
-    meta.pop("_train_state", None)
-    meta.pop("_quantized", None)
-    config = LLaMAConfig(**meta)
+    config, _, is_train = _load_meta(path)
+    if not is_train:
+        raise ValueError(
+            f"{path} is a serving checkpoint (params only); restore it "
+            "with load_checkpoint"
+        )
 
     shapes = jax.eval_shape(
         lambda: init_train_state(

@@ -1,0 +1,250 @@
+"""Speculative decoding: draft-model proposal + single-pass greedy verify.
+
+Beyond the reference's capability surface (its decode is strictly
+one-token-at-a-time through HF's mixin, SURVEY.md §1) — speculative decoding
+trades cheap draft-model FLOPs for target-model HBM bandwidth, the binding
+resource of TPU decode: the target runs ONE forward over ``n_draft + 1``
+positions per round (weights stream once) instead of one forward per token.
+
+Greedy verification (temperature 0) is exact: the emitted sequence equals
+plain greedy decode of the target model token-for-token, regardless of the
+draft model's quality — the draft only controls speed (acceptance rate),
+never content.  This invariant is what the tests assert.
+
+TPU-native mechanics worth noting:
+  * **No cache rollback.**  Attention masking in this framework is purely
+    positional (``KVCache.pos``; -1 = invalid), so rejected draft entries
+    are simply re-marked ``pos=-1`` after verification — the slots are
+    wasted, never rolled back, and the whole round stays inside one jitted
+    ``lax.while_loop`` with static shapes.
+  * **Per-row acceptance with a shared cache index.**  Rows accept
+    different prefix lengths; each row's surviving slots keep their own
+    absolute positions, everything else is masked.  Batch rows never
+    synchronize on acceptance.
+  * Memory trade-off: caches are sized for the worst case (every round
+    accepts 0 drafts): ``P + max_new * (n_draft + 1)`` target slots.  Use
+    for latency-bound serving (small batch, good draft), not max-batch
+    throughput.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .config import LLaMAConfig
+from .engine import GenerationConfig, _is_stop, prompt_positions
+from .models.llama import KVCache, forward, init_cache
+from .parallel.mesh import use_mesh
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("target_config", "draft_config", "gen_config",
+                     "n_draft", "mesh"),
+)
+def generate_speculative(
+    target_params,
+    draft_params,
+    prompt_tokens: jnp.ndarray,
+    prompt_mask: jnp.ndarray,
+    *,
+    target_config: LLaMAConfig,
+    draft_config: LLaMAConfig,
+    gen_config: GenerationConfig,
+    n_draft: int = 4,
+    mesh=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy speculative decode.
+
+    Args:
+      target_params / draft_params: param trees; models must share the
+        vocabulary (draft proposes token ids the target verifies).
+      prompt_tokens: [B, P] int32, left-padded.
+      prompt_mask: [B, P] bool.
+      gen_config: sampling policy — temperature must be 0.0 (greedy); the
+        stop-token / pad semantics match ``engine.generate``.
+      n_draft: draft tokens proposed per round (>= 1).
+    Returns:
+      (tokens [B, P + max_new_tokens] int32 — prompt then generated, pad
+       after stop; accept_counts [B] int32 — total accepted draft tokens
+       per row, for observability/acceptance-rate monitoring).
+    """
+    gc = gen_config
+    if gc.temperature != 0.0:
+        raise NotImplementedError(
+            "speculative decoding is greedy-only (temperature 0.0); "
+            "distribution-preserving sampled verification is future work"
+        )
+    if n_draft < 1:
+        raise ValueError("n_draft must be >= 1")
+    if target_config.vocab_size != draft_config.vocab_size:
+        raise ValueError("target and draft must share a vocabulary")
+    from .parallel.mesh import current_mesh
+
+    if mesh is None and current_mesh() is not None:
+        # Same trap engine.generate guards: an ambient use_mesh(...) is not
+        # part of the jit cache key, so silently tracing under use_mesh(None)
+        # here would disable every sharding constraint.
+        raise ValueError(
+            "generate_speculative: pass mesh= explicitly (it is part of "
+            "the jit cache key); an ambient use_mesh(...) context is not "
+            "seen by the compiled executable on later calls"
+        )
+    with use_mesh(mesh):
+        return _spec_impl(
+            target_params, draft_params, prompt_tokens, prompt_mask,
+            target_config, draft_config, gc, n_draft,
+        )
+
+
+def _greedy(logits: jnp.ndarray) -> jnp.ndarray:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _spec_impl(tp, dp, prompt_tokens, prompt_mask, tc, dc, gc, G):
+    B, P = prompt_tokens.shape
+    N = gc.max_new_tokens
+    total = P + N
+    positions = prompt_positions(prompt_mask)
+    prompt_lens = jnp.sum(prompt_mask.astype(jnp.int32), axis=-1)  # [B]
+
+    # Worst case: every round accepts 0 drafts -> N rounds, G+1 (target) /
+    # G (draft) slots burned per round.
+    t_cache = init_cache(tc, B, max_len=P + N * (G + 1))
+    d_cache = init_cache(dc, B, max_len=P + N * (G + 1))
+
+    t_logits, t_cache = forward(
+        tp, prompt_tokens, positions, tc, cache=t_cache, attn_mask=prompt_mask
+    )
+    _, d_cache = forward(
+        dp, prompt_tokens, positions, dc, cache=d_cache, attn_mask=prompt_mask
+    )
+    tau = _greedy(t_logits[:, -1])  # [B] first generated token
+
+    buf = jnp.full((B, total), gc.pad_id, dtype=jnp.int32)
+    buf = lax.dynamic_update_slice(buf, prompt_tokens.astype(jnp.int32), (0, 0))
+    buf = buf.at[jnp.arange(B), P].set(
+        jnp.where(prompt_lens > 0, tau, gc.pad_id)
+    )
+    done = _is_stop(tau, gc.stop_tokens)  # [B]
+    count = jnp.ones((B,), jnp.int32)     # generated tokens so far (tau)
+    accepted_total = jnp.zeros((B,), jnp.int32)
+
+    # (round, buf, t_cache, d_cache, tau, count, done, accepted_total)
+    init = (jnp.zeros((), jnp.int32), buf, t_cache, d_cache, tau, count,
+            done, accepted_total)
+
+    def cond(state):
+        rnd, _, _, _, _, count, done, _ = state
+        return jnp.logical_and(
+            rnd < N, ~jnp.all(jnp.logical_or(done, count >= N))
+        )
+
+    def body(state):
+        rnd, buf, t_cache, d_cache, tau, count, done, accepted_total = state
+        # tau sits at per-row position p = prompt_len + count - 1.
+        p = prompt_lens + count - 1  # [B]
+
+        # --- 1. draft G tokens autoregressively ---
+        def draft_one(carry, j):
+            d_cache, tok = carry
+            pos = (p + j)[:, None]
+            lg, d_cache = forward(
+                dp, tok[:, None], pos, dc, cache=d_cache,
+                attn_mask=jnp.ones((B, 1), bool),
+            )
+            nxt = _greedy(lg[:, -1])
+            return (d_cache, nxt), nxt
+
+        (d_cache, d_last), drafts = lax.scan(
+            draft_one, (d_cache, tau), jnp.arange(G, dtype=jnp.int32)
+        )
+        drafts = jnp.swapaxes(drafts, 0, 1)  # [B, G]
+        # Feed d_G once more (logits discarded) so its KV lands in the
+        # draft cache: the scan only cached inputs [tau, d_1..d_{G-1}], and
+        # on a fully-accepted round the next tau is the *bonus* token at
+        # p+G+1 — without this, position p+G stays a permanent hole that
+        # corrupts every later draft forward and collapses acceptance in
+        # exactly the high-acceptance regime.
+        _, d_cache = forward(
+            dp, d_last[:, None], (p + G)[:, None], dc, cache=d_cache,
+            attn_mask=jnp.ones((B, 1), bool),
+        )
+
+        # --- 2. one target pass over [tau, d_1 .. d_G] ---
+        block = jnp.concatenate([tau[:, None], drafts], axis=1)  # [B, G+1]
+        block_pos = p[:, None] + jnp.arange(G + 1, dtype=jnp.int32)[None, :]
+        t_idx = t_cache.index
+        t_logits, t_cache = forward(
+            tp, block, block_pos, tc, cache=t_cache,
+            attn_mask=jnp.ones((B, G + 1), bool),
+        )
+        outs = _greedy(t_logits)  # [B, G+1]; outs[:, j] follows block[:, j]
+
+        # --- 3. accept the matching draft prefix (+1 correction/bonus) ---
+        match = (drafts == outs[:, :G])                       # [B, G]
+        acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        # Emitted candidates this round: outs[:, 0..acc] (acc+1 tokens).
+        j = jnp.arange(G + 1, dtype=jnp.int32)[None, :]       # [1, G+1]
+        in_prefix = j <= acc[:, None]
+        stopped_before = jnp.cumsum(
+            _is_stop(outs, gc.stop_tokens).astype(jnp.int32), axis=1
+        ) - _is_stop(outs, gc.stop_tokens).astype(jnp.int32) > 0
+        emit = (
+            in_prefix
+            & ~stopped_before
+            & ~done[:, None]
+            & ((count[:, None] + j) < N)
+        )
+
+        # --- 4. write emitted tokens at per-row columns ---
+        cols = jnp.where(emit, P + count[:, None] + j, total)  # OOB -> drop
+        buf = buf.at[jnp.arange(B)[:, None], cols].set(outs, mode="drop")
+
+        n_emit = jnp.sum(emit.astype(jnp.int32), axis=1)       # [B]
+        # Last emitted token per row becomes the next tau.
+        last_j = jnp.maximum(n_emit - 1, 0)
+        new_tau = jnp.take_along_axis(outs, last_j[:, None], axis=1)[:, 0]
+        tau = jnp.where(n_emit > 0, new_tau, tau)
+
+        stopped = jnp.any(_is_stop(outs, gc.stop_tokens) & emit, axis=1)
+        count = count + n_emit
+        done = done | stopped | (count >= N)
+        accepted_total = accepted_total + jnp.minimum(acc, jnp.maximum(n_emit - 1, 0))
+
+        # --- 5. invalidate rejected slots (positional masking: no rollback)
+        # Target wrote G+1 slots at t_idx: tau (always valid) + G drafts,
+        # valid iff accepted.  (Validity beyond emission is harmless for
+        # done rows — their buf writes are suppressed.)
+        t_valid = j <= acc[:, None]                            # [B, G+1]
+        t_patch = jnp.where(t_valid, block_pos, -1).astype(jnp.int32)
+        t_cache = KVCache(
+            k=t_cache.k, v=t_cache.v,
+            pos=lax.dynamic_update_slice(t_cache.pos, t_patch, (0, t_idx)),
+            index=t_cache.index,
+        )
+        # Draft wrote G+1 slots: [tau, d_1 .. d_G] — slot j holds the token
+        # at position p+j, valid iff j <= acc (d_G survives exactly on a
+        # fully-accepted round, when the next round needs it).
+        d_idx = d_cache.index - (G + 1)
+        jd = jnp.arange(G + 1, dtype=jnp.int32)[None, :]
+        d_valid = jd <= acc[:, None]
+        d_patch = jnp.where(
+            d_valid, p[:, None] + jd, -1
+        ).astype(jnp.int32)
+        d_cache = KVCache(
+            k=d_cache.k, v=d_cache.v,
+            pos=lax.dynamic_update_slice(d_cache.pos, d_patch, (0, d_idx)),
+            index=d_cache.index,
+        )
+
+        return (rnd + 1, buf, t_cache, d_cache, tau, count, done,
+                accepted_total)
+
+    _, buf, _, _, _, _, _, accepted_total = lax.while_loop(cond, body, init)
+    return buf, accepted_total

@@ -248,3 +248,32 @@ def test_train_state_resume_roundtrip(tmp_path):
     assert float(loss_a) == float(loss_b)
     for a, b in zip(jax.tree.leaves(cont_a.params), jax.tree.leaves(cont_b.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_kind_mismatch_errors():
+    """Pointing the wrong loader at a checkpoint gives a clear error, not a
+    TypeError from config parsing."""
+    import pytest
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.convert.checkpoint import (
+        load_checkpoint,
+        load_train_state,
+        save_checkpoint,
+        save_train_state,
+    )
+    from jax_llama_tpu.train import init_train_state, make_optimizer
+
+    config = get_config(
+        "tiny", vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=16,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    opt = make_optimizer(1e-3)
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        save_train_state(td + "/t", init_train_state(params, opt), config)
+        with pytest.raises(ValueError, match="training checkpoint"):
+            load_checkpoint(td + "/t")
+        save_checkpoint(td + "/s", params, config)
+        with pytest.raises(ValueError, match="serving checkpoint"):
+            load_train_state(td + "/s", opt)
