@@ -53,6 +53,28 @@ def top_k_filter(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
     return jnp.where(logits >= kth, logits, NEG_INF)
 
 
+def warped_probs(
+    logits: jnp.ndarray,
+    temperature: float,
+    top_p: Optional[float] = None,
+    top_k: Optional[int] = None,
+) -> jnp.ndarray:
+    """The exact distribution ``sample`` draws from, as probabilities.
+
+    Speculative decoding's accept/resample math needs p (target) and q
+    (draft) as full distributions under the SAME warping the sampler uses —
+    acceptance ``min(1, p/q)`` and the residual ``norm(relu(p - q))`` are
+    only distribution-preserving if both sides are post-warp.
+    """
+    assert temperature != 0.0, "greedy has no sampling distribution"
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0:
+        logits = top_k_filter(logits, top_k)
+    if top_p is not None and top_p < 1.0:
+        logits = top_p_filter(logits, top_p)
+    return jax.nn.softmax(logits, axis=-1)
+
+
 def sample(
     rng: jax.Array,
     logits: jnp.ndarray,

@@ -9,7 +9,10 @@ positions per round (weights stream once) instead of one forward per token.
 Greedy verification (temperature 0) is exact: the emitted sequence equals
 plain greedy decode of the target model token-for-token, regardless of the
 draft model's quality — the draft only controls speed (acceptance rate),
-never content.  This invariant is what the tests assert.
+never content.  Sampled verification (temperature > 0) is Leviathan-style
+rejection sampling and is distribution-preserving: the emitted tokens are
+drawn from exactly the target's (warped) sampling distribution.  Both
+invariants are what the tests assert.
 
 TPU-native mechanics worth noting:
   * **No cache rollback.**  Attention masking in this framework is purely
@@ -39,6 +42,7 @@ from jax import lax
 from .config import LLaMAConfig
 from .engine import GenerationConfig, _is_stop, prompt_positions
 from .models.llama import KVCache, forward, init_cache
+from .ops.sampling import sample, warped_probs
 from .parallel.mesh import use_mesh
 
 
@@ -52,6 +56,7 @@ def generate_speculative(
     draft_params,
     prompt_tokens: jnp.ndarray,
     prompt_mask: jnp.ndarray,
+    rng: Optional[jax.Array] = None,
     *,
     target_config: LLaMAConfig,
     draft_config: LLaMAConfig,
@@ -59,15 +64,24 @@ def generate_speculative(
     n_draft: int = 4,
     mesh=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Greedy speculative decode.
+    """Speculative decode — greedy or sampled verification.
+
+    temperature == 0.0: exact greedy verification; output is token-for-token
+    identical to plain greedy decode of the target.  temperature > 0:
+    Leviathan-style rejection sampling — draft token ``d ~ q`` is accepted
+    with probability ``min(1, p(d)/q(d))``; on rejection the replacement is
+    drawn from ``norm(relu(p - q))``; a fully-accepted round draws a bonus
+    token from ``p``.  Both p and q carry the SAME temperature/top-p/top-k
+    warping as ``ops.sampling.sample``, so the emitted distribution equals
+    plain sampled decode of the target (the draft only changes speed).
 
     Args:
       target_params / draft_params: param trees; models must share the
         vocabulary (draft proposes token ids the target verifies).
       prompt_tokens: [B, P] int32, left-padded.
       prompt_mask: [B, P] bool.
-      gen_config: sampling policy — temperature must be 0.0 (greedy); the
-        stop-token / pad semantics match ``engine.generate``.
+      rng: PRNG key — required when temperature > 0.
+      gen_config: sampling/stopping policy (matches ``engine.generate``).
       n_draft: draft tokens proposed per round (>= 1).
     Returns:
       (tokens [B, P + max_new_tokens] int32 — prompt then generated, pad
@@ -75,10 +89,9 @@ def generate_speculative(
        per row, for observability/acceptance-rate monitoring).
     """
     gc = gen_config
-    if gc.temperature != 0.0:
-        raise NotImplementedError(
-            "speculative decoding is greedy-only (temperature 0.0); "
-            "distribution-preserving sampled verification is future work"
+    if gc.temperature != 0.0 and rng is None:
+        raise ValueError(
+            "generate_speculative: rng is required when temperature > 0"
         )
     if n_draft < 1:
         raise ValueError("n_draft must be >= 1")
@@ -95,9 +108,11 @@ def generate_speculative(
             "the jit cache key); an ambient use_mesh(...) context is not "
             "seen by the compiled executable on later calls"
         )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # unused on the greedy path
     with use_mesh(mesh):
         return _spec_impl(
-            target_params, draft_params, prompt_tokens, prompt_mask,
+            target_params, draft_params, prompt_tokens, prompt_mask, rng,
             target_config, draft_config, gc, n_draft,
         )
 
@@ -106,7 +121,7 @@ def _greedy(logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-def _spec_impl(tp, dp, prompt_tokens, prompt_mask, tc, dc, gc, G):
+def _spec_impl(tp, dp, prompt_tokens, prompt_mask, rng, tc, dc, gc, G):
     B, P = prompt_tokens.shape
     N = gc.max_new_tokens
     total = P + N
@@ -118,13 +133,18 @@ def _spec_impl(tp, dp, prompt_tokens, prompt_mask, tc, dc, gc, G):
     t_cache = init_cache(tc, B, max_len=P + N * (G + 1))
     d_cache = init_cache(dc, B, max_len=P + N * (G + 1))
 
+    sampled = gc.temperature != 0.0  # static: picked at trace time
     t_logits, t_cache = forward(
         tp, prompt_tokens, positions, tc, cache=t_cache, attn_mask=prompt_mask
     )
     _, d_cache = forward(
         dp, prompt_tokens, positions, dc, cache=d_cache, attn_mask=prompt_mask
     )
-    tau = _greedy(t_logits[:, -1])  # [B] first generated token
+    if sampled:
+        rng, sub = jax.random.split(rng)
+        tau = sample(sub, t_logits[:, -1], gc.temperature, gc.top_p, gc.top_k)
+    else:
+        tau = _greedy(t_logits[:, -1])  # [B] first generated token
 
     buf = jnp.full((B, total), gc.pad_id, dtype=jnp.int32)
     buf = lax.dynamic_update_slice(buf, prompt_tokens.astype(jnp.int32), (0, 0))
@@ -135,36 +155,46 @@ def _spec_impl(tp, dp, prompt_tokens, prompt_mask, tc, dc, gc, G):
     count = jnp.ones((B,), jnp.int32)     # generated tokens so far (tau)
     accepted_total = jnp.zeros((B,), jnp.int32)
 
-    # (round, buf, t_cache, d_cache, tau, count, done, accepted_total)
+    # (round, buf, t_cache, d_cache, tau, count, done, accepted_total, rng)
     init = (jnp.zeros((), jnp.int32), buf, t_cache, d_cache, tau, count,
-            done, accepted_total)
+            done, accepted_total, rng)
 
     def cond(state):
-        rnd, _, _, _, _, count, done, _ = state
+        rnd, _, _, _, _, count, done, _, _ = state
         return jnp.logical_and(
             rnd < N, ~jnp.all(jnp.logical_or(done, count >= N))
         )
 
     def body(state):
-        rnd, buf, t_cache, d_cache, tau, count, done, accepted_total = state
+        (rnd, buf, t_cache, d_cache, tau, count, done, accepted_total,
+         rng) = state
+        rng, k_draft, k_accept, k_extra = jax.random.split(rng, 4)
         # tau sits at per-row position p = prompt_len + count - 1.
         p = prompt_lens + count - 1  # [B]
 
         # --- 1. draft G tokens autoregressively ---
         def draft_one(carry, j):
-            d_cache, tok = carry
+            d_cache, tok, key = carry
             pos = (p + j)[:, None]
             lg, d_cache = forward(
                 dp, tok[:, None], pos, dc, cache=d_cache,
                 attn_mask=jnp.ones((B, 1), bool),
             )
-            nxt = _greedy(lg[:, -1])
-            return (d_cache, nxt), nxt
+            if sampled:
+                key, sub = jax.random.split(key)
+                q = warped_probs(lg[:, -1], gc.temperature, gc.top_p, gc.top_k)
+                nxt = jax.random.categorical(sub, jnp.log(q + 1e-30), axis=-1)
+                nxt = nxt.astype(jnp.int32)
+            else:
+                q = jnp.zeros((B, dc.vocab_size), jnp.float32)  # unused
+                nxt = _greedy(lg[:, -1])
+            return (d_cache, nxt, key), (nxt, q)
 
-        (d_cache, d_last), drafts = lax.scan(
-            draft_one, (d_cache, tau), jnp.arange(G, dtype=jnp.int32)
+        (d_cache, d_last, _), (drafts, qprobs) = lax.scan(
+            draft_one, (d_cache, tau, k_draft), jnp.arange(G, dtype=jnp.int32)
         )
-        drafts = jnp.swapaxes(drafts, 0, 1)  # [B, G]
+        drafts = jnp.swapaxes(drafts, 0, 1)   # [B, G]
+        qprobs = jnp.swapaxes(qprobs, 0, 1)   # [B, G, V]
         # Feed d_G once more (logits discarded) so its KV lands in the
         # draft cache: the scan only cached inputs [tau, d_1..d_{G-1}], and
         # on a fully-accepted round the next tau is the *bonus* token at
@@ -184,11 +214,56 @@ def _spec_impl(tp, dp, prompt_tokens, prompt_mask, tc, dc, gc, G):
             tp, block, block_pos, tc, cache=t_cache,
             attn_mask=jnp.ones((B, G + 1), bool),
         )
-        outs = _greedy(t_logits)  # [B, G+1]; outs[:, j] follows block[:, j]
-
-        # --- 3. accept the matching draft prefix (+1 correction/bonus) ---
-        match = (drafts == outs[:, :G])                       # [B, G]
-        acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        # --- 3. verification ---
+        if sampled:
+            # Leviathan rejection sampling.  pprobs/qprobs are both
+            # post-warp, so acceptance min(1, p/q) + residual resampling
+            # reproduce the target's sampled distribution exactly.
+            pprobs = warped_probs(
+                t_logits, gc.temperature, gc.top_p, gc.top_k
+            )  # [B, G+1, V]
+            p_d = jnp.take_along_axis(
+                pprobs[:, :G], drafts[..., None], axis=-1
+            )[..., 0]  # [B, G]
+            q_d = jnp.take_along_axis(
+                qprobs, drafts[..., None], axis=-1
+            )[..., 0]
+            u = jax.random.uniform(k_accept, (B, G))
+            accept = u * q_d < p_d
+            acc = jnp.sum(
+                jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+            )
+            # Replacement dist at the first rejection, bonus dist (= p_G)
+            # on full acceptance; index both with acc in one gather.
+            resid = jnp.maximum(pprobs[:, :G] - qprobs, 0.0)  # [B, G, V]
+            cand = jnp.concatenate([resid, pprobs[:, G:]], axis=1)
+            dist = jnp.take_along_axis(
+                cand, acc[:, None, None], axis=1
+            )[:, 0]  # [B, V]
+            mass = jnp.sum(dist, axis=-1, keepdims=True)
+            p_at = jnp.take_along_axis(
+                pprobs, acc[:, None, None], axis=1
+            )[:, 0]
+            # Residual mass 0 means p <= q everywhere (p == q): rejection
+            # was probability-0 but float rounding can reach here — fall
+            # back to p.
+            dist = jnp.where(mass > 1e-12, dist, p_at)
+            extra = jax.random.categorical(
+                k_extra, jnp.log(dist + 1e-30), axis=-1
+            ).astype(jnp.int32)
+            # outs[:, j] = emitted token at offset j: accepted drafts for
+            # j < acc, the replacement/bonus at j == acc.
+            outs = jnp.concatenate(
+                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1
+            )
+            outs = outs.at[jnp.arange(B), acc].set(extra)
+        else:
+            outs = _greedy(t_logits)  # [B, G+1]; outs[:, j] follows block[:, j]
+            # Accept the matching draft prefix (+1 correction/bonus).
+            match = (drafts == outs[:, :G])                   # [B, G]
+            acc = jnp.sum(
+                jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+            )
         # Emitted candidates this round: outs[:, 0..acc] (acc+1 tokens).
         j = jnp.arange(G + 1, dtype=jnp.int32)[None, :]       # [1, G+1]
         in_prefix = j <= acc[:, None]
@@ -244,7 +319,9 @@ def _spec_impl(tp, dp, prompt_tokens, prompt_mask, tc, dc, gc, G):
         )
 
         return (rnd + 1, buf, t_cache, d_cache, tau, count, done,
-                accepted_total)
+                accepted_total, rng)
 
-    _, buf, _, _, _, _, _, accepted_total = lax.while_loop(cond, body, init)
+    _, buf, _, _, _, _, _, accepted_total, _ = lax.while_loop(
+        cond, body, init
+    )
     return buf, accepted_total
