@@ -63,7 +63,10 @@ class LLaMAConfig:
     scan_layers: bool = True              # lax.scan over stacked layers
     remat: bool = False                   # jax.checkpoint each block
     attn_impl: str = "xla"                # "xla" | "flash" (Pallas) | "ring"
-                                          #   (seq-parallel ring attention)
+                                          #   (seq-parallel ring attention) |
+                                          #   "auto" (flash for prefill /
+                                          #   long blocks, xla append-free
+                                          #   path for decode steps)
     pp_microbatches: Optional[int] = None # GPipe microbatch count when the
                                           #   mesh has stage > 1 (None -> S)
     attn_softmax_dtype: str = "float32"   # fp32 softmax island

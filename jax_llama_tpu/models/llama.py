@@ -177,8 +177,11 @@ def _block(
     cos: jnp.ndarray,
     sin: jnp.ndarray,
     bias_new: Optional[jnp.ndarray] = None,
+    impl: str = "xla",
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
-    """One pre-norm transformer block. x: [B, T, D]."""
+    """One pre-norm transformer block. x: [B, T, D].  ``impl`` is the
+    RESOLVED attention implementation (forward maps "auto" to "flash" or
+    "xla" per call based on T)."""
     B, T, D = x.shape
     adt = x.dtype
 
@@ -195,9 +198,7 @@ def _block(
     k = apply_rope(k, cos, sin, positions)
 
     softmax_dtype = jnp.dtype(config.attn_softmax_dtype)
-    if config.attn_impl not in ("xla", "flash", "ring"):
-        raise NotImplementedError(f"attn_impl={config.attn_impl!r}")
-    if cache_k is not None and config.attn_impl == "xla":
+    if cache_k is not None and impl == "xla":
         # Append-free decode: the cache stays immutable through the layer
         # scan; sdpa_cached softmaxes jointly over (cache slots, new
         # tokens) at the scores level, and the caller applies ONE in-place
@@ -227,13 +228,13 @@ def _block(
             kk, vv = cache_k.astype(adt), cache_v.astype(adt)
         else:
             kk, vv = k, v
-        if config.attn_impl == "ring" and cache_k is None:
+        if impl == "ring" and cache_k is None:
             # Sequence-parallel path (training / scoring / cache-free
             # prefill): ring over the seq mesh axis.
             from ..parallel.ring import ring_sdpa
 
             attn = ring_sdpa(q, kk, vv, positions, slot_pos)
-        elif config.attn_impl in ("flash", "ring"):
+        elif impl in ("flash", "ring"):
             attn = flash_attention(q, kk, vv, positions, slot_pos)
         else:
             attn = sdpa(q, kk, vv, bias, softmax_dtype=softmax_dtype)
@@ -328,9 +329,17 @@ def forward(
         )
     else:
         slot_pos = new_slot_pos
+    if config.attn_impl not in ("xla", "flash", "ring", "auto"):
+        raise NotImplementedError(f"attn_impl={config.attn_impl!r}")
+    # "auto": Pallas flash for prefill/long blocks (no dense [B,1,T,S] bias,
+    # O(S*d) memory), append-free xla path for decode-sized steps (T small)
+    # where flash's one-row grid and in-scan cache writes lose.
+    impl = config.attn_impl
+    if impl == "auto":
+        impl = "flash" if T > 8 else "xla"
     bias_new = None
-    xla_cached = cache is not None and config.attn_impl == "xla"
-    if config.attn_impl in ("flash", "ring"):
+    xla_cached = cache is not None and impl == "xla"
+    if impl in ("flash", "ring"):
         bias = None
     elif xla_cached:
         # Append-free decode (see _block): the cache bias masks the OLD
@@ -351,6 +360,7 @@ def forward(
         cos=cos,
         sin=sin,
         bias_new=bias_new,
+        impl=impl,
     )
     if config.remat:
         block = jax.checkpoint(block)
@@ -386,7 +396,7 @@ def forward(
         def stage_fn(stage_layers, xx, pos, spos):
             sbias = (
                 None
-                if config.attn_impl in ("flash", "ring")
+                if impl in ("flash", "ring")
                 else attention_bias(pos, spos, spos >= 0)
             )
 
@@ -395,6 +405,7 @@ def forward(
                     carry, lp_i, None, None,
                     config=config, positions=pos, bias=sbias,
                     slot_pos=spos, cache_index=None, cos=cos, sin=sin,
+                    impl=impl,
                 )
                 return y, None
 

@@ -42,8 +42,11 @@ def main() -> None:
     ap.add_argument("--temperature", type=float, default=0.8)
     ap.add_argument("--top-p", type=float, default=0.95)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--attn", default=None, choices=["xla", "flash"],
-                    help="override attn_impl from the checkpoint config")
+    ap.add_argument("--attn", default=None,
+                    choices=["xla", "flash", "auto"],
+                    help="override attn_impl from the checkpoint config "
+                         "(auto = flash prefill + append-free xla decode; "
+                         "recommended for long prompts)")
     ap.add_argument("--quantize", action="store_true",
                     help="int8-quantize weights after load (weight-only, "
                          "per-channel; ~2x decode throughput)")

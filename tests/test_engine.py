@@ -116,3 +116,40 @@ def test_generate_from_str_roundtrip():
         ["hello", "a longer prompt here"], max_gen_len=8, temperature=0.0
     )
     assert outs == outs2
+
+
+def test_auto_impl_decode_matches_full_forward():
+    """attn_impl='auto' mixes flash prefill (T>8) with the append-free xla
+    decode path (T==1); chunked decode must still match the full forward."""
+    import numpy as np
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.models import forward
+    from jax_llama_tpu.models.llama import init_cache
+
+    config = get_config(
+        "tiny", vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64, attn_impl="auto",
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    B, T = 2, 32
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (B, T)), jnp.int32
+    )
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    full, _ = forward(params, tokens, pos, config)
+    want = np.asarray(full)
+
+    # prefill 16 (flash), then 16 single-token xla decode steps
+    cache = init_cache(config, B, max_len=T)
+    lg, cache = forward(
+        params, tokens[:, :16], pos[:, :16], config, cache=cache
+    )
+    outs = [np.asarray(lg)]
+    for i in range(16, T):
+        lg, cache = forward(
+            params, tokens[:, i:i + 1], pos[:, i:i + 1], config, cache=cache
+        )
+        outs.append(np.asarray(lg))
+    got = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(got, want, atol=3e-4, rtol=3e-4)
