@@ -71,6 +71,10 @@ class LLaMAConfig:
                                           #   mesh has stage > 1 (None -> S)
     attn_softmax_dtype: str = "float32"   # fp32 softmax island
     logits_dtype: str = "float32"         # fp32 logits island
+    kv_cache_dtype: str = "auto"          # "auto" (= activation dtype) |
+                                          #   "int8" (per-slot-per-head
+                                          #   scales; halves cache HBM
+                                          #   traffic/memory; xla path)
 
     @property
     def kv_heads(self) -> int:

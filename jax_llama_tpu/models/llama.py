@@ -62,27 +62,50 @@ Params = Dict[str, Any]
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=["k", "v", "pos", "index"],
+    data_fields=["k", "v", "pos", "index", "k_scale", "v_scale"],
     meta_fields=[],
 )
 @dataclasses.dataclass
 class KVCache:
     """Fixed-capacity per-layer KV cache with per-slot absolute positions.
 
-    k, v:  [L, B, S_max, KVH, head_dim]
+    k, v:  [L, B, S_max, KVH, head_dim] — activation dtype, or int8 when
+           the cache is quantized (config.kv_cache_dtype == "int8").
     pos:   [B, S_max] int32 — absolute position written into each slot;
            -1 marks an invalid (padding / unwritten) slot.
     index: scalar int32 — next write offset (number of slots filled).
+    k_scale, v_scale: [L, B, S_max, KVH] fp32 per-slot-per-head dequant
+           scales (int8 cache only; None otherwise).  Scales are constant
+           along head_dim, so dequantization commutes with the attention
+           contractions — sdpa_cached folds them into scores/weights and
+           the int8 payload is never materialized at full precision.
     """
 
     k: jnp.ndarray
     v: jnp.ndarray
     pos: jnp.ndarray
     index: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
 
     @property
     def max_len(self) -> int:
         return self.k.shape[2]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 over the trailing head_dim: x [..., hd] ->
+    (int8 [..., hd], fp32 scale [...])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
 
 
 def init_cache(
@@ -94,13 +117,16 @@ def init_cache(
     """Allocate an empty cache (parity: reference ``init_cache``,
     model.py:459-476 — but as a plain pytree, not a Flax collection)."""
     max_len = max_len or config.max_seq_len
-    dtype = dtype or config.activation_dtype
+    int8_kv = config.kv_cache_dtype == "int8" and dtype is None
+    dtype = jnp.int8 if int8_kv else (dtype or config.activation_dtype)
     shape = (config.n_layers, batch, max_len, config.kv_heads, config.head_dim)
     return KVCache(
         k=jnp.zeros(shape, dtype=dtype),
         v=jnp.zeros(shape, dtype=dtype),
         pos=jnp.full((batch, max_len), -1, dtype=jnp.int32),
         index=jnp.zeros((), dtype=jnp.int32),
+        k_scale=jnp.zeros(shape[:-1], jnp.float32) if int8_kv else None,
+        v_scale=jnp.zeros(shape[:-1], jnp.float32) if int8_kv else None,
     )
 
 
@@ -168,6 +194,8 @@ def _block(
     lp: Dict[str, jnp.ndarray],
     cache_k: Optional[jnp.ndarray],
     cache_v: Optional[jnp.ndarray],
+    cache_k_scale: Optional[jnp.ndarray] = None,
+    cache_v_scale: Optional[jnp.ndarray] = None,
     *,
     config: LLaMAConfig,
     positions: jnp.ndarray,
@@ -208,10 +236,17 @@ def _block(
         # inside the attention op, after the cache (parity with reference
         # model.py:269-270).  ``bias`` masks the cache (unwritten slots
         # carry pos -1), ``bias_new`` masks/causes the new tokens.
-        attn = sdpa_cached(
-            q, cache_k.astype(adt), cache_v.astype(adt), k, v,
-            bias, bias_new, softmax_dtype=softmax_dtype,
-        )
+        if cache_k_scale is not None:
+            attn = sdpa_cached(
+                q, cache_k, cache_v, k, v, bias, bias_new,
+                softmax_dtype=softmax_dtype,
+                k_scale=cache_k_scale, v_scale=cache_v_scale,
+            )
+        else:
+            attn = sdpa_cached(
+                q, cache_k.astype(adt), cache_v.astype(adt), k, v,
+                bias, bias_new, softmax_dtype=softmax_dtype,
+            )
         # ys: just this step's projections; forward writes them into the
         # cache once, outside the scan.
         cache_k, cache_v = k, v
@@ -337,6 +372,12 @@ def forward(
     impl = config.attn_impl
     if impl == "auto":
         impl = "flash" if T > 8 else "xla"
+    if cache is not None and cache.quantized and impl != "xla":
+        raise NotImplementedError(
+            "int8 KV cache requires the xla attention path (the Pallas "
+            "kernels read the cache dtype directly); use attn_impl='xla', "
+            "or kv_cache_dtype='auto' with flash/ring"
+        )
     bias_new = None
     xla_cached = cache is not None and impl == "xla"
     if impl in ("flash", "ring"):
@@ -419,8 +460,20 @@ def forward(
             mesh=_mesh,
             n_microbatches=config.pp_microbatches or pp_stages,
         )
-    elif config.scan_layers:
-        if cache is not None:
+    new_k_scale = cache.k_scale if cache is not None else None
+    new_v_scale = cache.v_scale if cache is not None else None
+    if config.scan_layers and pp_stages <= 1:
+        if cache is not None and cache.quantized:
+            def scan_fn(carry, xs):
+                layer_params, ck, cv, cks, cvs = xs
+                y, ck, cv = block(carry, layer_params, ck, cv, cks, cvs)
+                return y, (ck, cv)
+
+            x, (new_k, new_v) = lax.scan(
+                scan_fn, x,
+                (lp, cache.k, cache.v, cache.k_scale, cache.v_scale),
+            )
+        elif cache is not None:
             # On the xla_cached path the cache rides xs READ-ONLY and the
             # ys are just each layer's new [B,T,KVH,hd] projections —
             # rebuilding the full cache as ys would force a whole-cache
@@ -437,13 +490,15 @@ def forward(
                 return y, None
 
             x, _ = lax.scan(scan_fn, x, lp)
-    else:
+    elif pp_stages <= 1:
         new_ks, new_vs = [], []
         for i in range(config.n_layers):
             layer_params = jax.tree.map(lambda a: a[i], lp)
             ck = cache.k[i] if cache is not None else None
             cv = cache.v[i] if cache is not None else None
-            x, ck, cv = block(x, layer_params, ck, cv)
+            cks = cache.k_scale[i] if cache is not None and cache.quantized else None
+            cvs = cache.v_scale[i] if cache is not None and cache.quantized else None
+            x, ck, cv = block(x, layer_params, ck, cv, cks, cvs)
             new_ks.append(ck)
             new_vs.append(cv)
         if cache is not None:
@@ -451,7 +506,17 @@ def forward(
             new_v = jnp.stack(new_vs)
     if cache is not None and xla_cached:
         # new_k/new_v hold the per-layer NEW projections [L, B, T, KVH, hd];
-        # one in-place dynamic-update-slice writes them all into the cache.
+        # one in-place dynamic-update-slice (per array) writes them all
+        # into the cache — quantizing first when the cache is int8.
+        if cache.quantized:
+            new_k, k_s = quantize_kv(new_k)
+            new_v, v_s = quantize_kv(new_v)
+            new_k_scale = lax.dynamic_update_slice(
+                cache.k_scale, k_s, (0, 0, cache.index, 0)
+            )
+            new_v_scale = lax.dynamic_update_slice(
+                cache.v_scale, v_s, (0, 0, cache.index, 0)
+            )
         new_k = lax.dynamic_update_slice(
             cache.k, new_k.astype(cache.k.dtype), (0, 0, cache.index, 0, 0)
         )
@@ -473,7 +538,8 @@ def forward(
 
     if cache is not None:
         new_cache = KVCache(
-            k=new_k, v=new_v, pos=slot_pos, index=cache.index + T
+            k=new_k, v=new_v, pos=slot_pos, index=cache.index + T,
+            k_scale=new_k_scale, v_scale=new_v_scale,
         )
         return logits, new_cache
     return logits, None

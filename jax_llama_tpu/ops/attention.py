@@ -67,6 +67,8 @@ def sdpa_cached(
     bias_cache: jnp.ndarray,
     bias_new: jnp.ndarray,
     softmax_dtype: jnp.dtype = jnp.float32,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Append-free cached attention: softmax over the (immutable) cache and
     the step's new KV jointly, concatenated at the *scores* level.
@@ -80,11 +82,17 @@ def sdpa_cached(
     Args:
       q: [B, T, H, D].
       k_cache, v_cache: [B, S, KVH, D] — previously written slots only
-        (unwritten slots must be masked by ``bias_cache``).
+        (unwritten slots must be masked by ``bias_cache``); int8 when
+        ``k_scale``/``v_scale`` are given.
       k_new, v_new: [B, T, KVH, D] — this step's projections.
       bias_cache: [B, 1, T, S] additive bias over the cache slots.
       bias_new: [B, 1, T, T] additive bias over the new tokens
         (within-step causality + padding).
+      k_scale, v_scale: optional [B, S, KVH] fp32 dequant scales for an
+        int8 cache.  Scales are constant along D, so they commute with
+        both contractions: QK scores are rescaled after the dot, and
+        v_scale folds into the softmax weights before the PV dot — the
+        int8 payload goes straight into the MXU, never dequantized in HBM.
     Returns:
       [B, T, H, D] in q.dtype.
     """
@@ -93,17 +101,29 @@ def sdpa_cached(
     g = h // kvh
     qg = q.reshape(b, t, kvh, g, d)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    kc = k_cache if k_scale is None else k_cache.astype(q.dtype)
     s1 = jnp.einsum(
-        "btkgd,bskd->bkgts", qg, k_cache, preferred_element_type=jnp.float32
-    ) * scale + bias_cache[:, :, None]
+        "btkgd,bskd->bkgts", qg, kc, preferred_element_type=jnp.float32
+    ) * scale
+    if k_scale is not None:
+        s1 = s1 * jnp.transpose(k_scale, (0, 2, 1))[:, :, None, None, :]
+    s1 = s1 + bias_cache[:, :, None]
     s2 = jnp.einsum(
         "btkgd,bskd->bkgts", qg, k_new, preferred_element_type=jnp.float32
     ) * scale + bias_new[:, :, None]
     s = jnp.concatenate([s1, s2], axis=-1).astype(softmax_dtype)
     w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     w1, w2 = w[..., : s1.shape[-1]], w[..., s1.shape[-1]:]
+    vc = v_cache
+    if v_scale is not None:
+        # Fold the dequant scale into the (tiny) weights, not the cache.
+        w1 = (
+            w1.astype(jnp.float32)
+            * jnp.transpose(v_scale, (0, 2, 1))[:, :, None, None, :]
+        ).astype(q.dtype)
+        vc = v_cache.astype(q.dtype)
     out = jnp.einsum(
-        "bkgts,bskd->btkgd", w1, v_cache, preferred_element_type=jnp.float32
+        "bkgts,bskd->btkgd", w1, vc, preferred_element_type=jnp.float32
     ) + jnp.einsum(
         "bkgts,bskd->btkgd", w2, v_new, preferred_element_type=jnp.float32
     )

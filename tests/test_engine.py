@@ -153,3 +153,37 @@ def test_auto_impl_decode_matches_full_forward():
         outs.append(np.asarray(lg))
     got = np.concatenate(outs, axis=1)
     np.testing.assert_allclose(got, want, atol=3e-4, rtol=3e-4)
+
+
+def test_chunked_prefill_matches_single_shot():
+    """Chunked prefill (incl. a ragged final chunk) must generate exactly
+    the same tokens as single-shot prefill."""
+    import numpy as np
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.engine import GenerationConfig, generate
+
+    config = get_config(
+        "tiny", vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    B, P = 2, 20  # 20 % 8 != 0 -> ragged last chunk
+    rng = np.random.RandomState(0)
+    tokens = np.full((B, P), 0, np.int32)
+    mask = np.zeros((B, P), bool)
+    for b in range(B):
+        n = rng.randint(5, P + 1)
+        tokens[b, P - n:] = rng.randint(1, 128, n)
+        mask[b, P - n:] = True
+    tokens, mask = jnp.asarray(tokens), jnp.asarray(mask)
+    key = jax.random.PRNGKey(0)
+
+    gc1 = GenerationConfig(max_new_tokens=12, temperature=0.0, stop_tokens=())
+    want = np.asarray(generate(params, tokens, mask, key, config=config,
+                               gen_config=gc1))
+    for chunk in (4, 8, 16, 64):
+        gcc = GenerationConfig(max_new_tokens=12, temperature=0.0,
+                               stop_tokens=(), prefill_chunk=chunk)
+        got = np.asarray(generate(params, tokens, mask, key, config=config,
+                                  gen_config=gcc))
+        np.testing.assert_array_equal(got, want, err_msg=f"chunk={chunk}")

@@ -137,3 +137,75 @@ def test_quantized_sharded_forward_matches_single_device():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
     )
+
+
+def test_int8_kv_cache_decode_close_to_fp():
+    """Incremental decode over an int8 cache must track the fp32 full
+    forward closely (per-slot-per-head scales keep error ~0.5%)."""
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.models import forward
+    from jax_llama_tpu.models.llama import init_cache
+
+    config = get_config(
+        "tiny", vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=32, kv_cache_dtype="int8",
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    B, T = 2, 16
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (B, T)), jnp.int32
+    )
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    want = np.asarray(forward(params, tokens, pos, config)[0])
+
+    cache = init_cache(config, B, max_len=T)
+    assert cache.k.dtype == jnp.int8 and cache.quantized
+    lg, cache = forward(params, tokens[:, :8], pos[:, :8], config, cache=cache)
+    outs = [np.asarray(lg)]
+    for i in range(8, T):
+        lg, cache = forward(
+            params, tokens[:, i:i + 1], pos[:, i:i + 1], config, cache=cache
+        )
+        outs.append(np.asarray(lg))
+    got = np.concatenate(outs, axis=1)
+    rel = np.abs(got - want).max() / np.abs(want).max()
+    assert rel < 0.02, rel
+
+
+def test_int8_kv_cache_generate_end_to_end():
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.engine import GenerationConfig, generate
+
+    config = get_config(
+        "tiny", vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64, kv_cache_dtype="int8",
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, 128, (2, 8)), jnp.int32
+    )
+    mask = jnp.ones((2, 8), bool)
+    gc = GenerationConfig(max_new_tokens=12, temperature=0.0, stop_tokens=())
+    out = generate(params, tokens, mask, jax.random.PRNGKey(0),
+                   config=config, gen_config=gc)
+    o = np.asarray(out)
+    assert o.shape == (2, 20) and (o[:, 8:] < 128).all()
+
+
+def test_int8_kv_cache_rejects_flash():
+    import pytest
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.models import forward
+    from jax_llama_tpu.models.llama import init_cache
+
+    config = get_config(
+        "tiny", vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=32, kv_cache_dtype="int8",
+        attn_impl="flash",
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    cache = init_cache(config, 2, max_len=16)
+    tokens = jnp.zeros((2, 4), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32), (2, 4))
+    with pytest.raises(NotImplementedError, match="int8 KV"):
+        forward(params, tokens, pos, config, cache=cache)
