@@ -107,6 +107,15 @@ class LLaMAConfig:
         assert self.n_heads % self.kv_heads == 0, (
             "n_heads must be a multiple of n_kv_heads (GQA group size)"
         )
+        if self.attn_impl not in ("xla", "flash", "ring", "auto"):
+            raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
+        if self.kv_cache_dtype not in ("auto", "int8"):
+            # A typo here would silently fall back to the full-precision
+            # cache; fail instead.
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r}; "
+                "expected 'auto' or 'int8'"
+            )
 
 
 # ---------------------------------------------------------------------------

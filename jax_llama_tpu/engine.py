@@ -124,7 +124,10 @@ def _generate_impl(params, prompt_tokens, prompt_mask, rng, config, gc):
         # Static chunk count: P is a trace-time constant, so the Python
         # loop unrolls into ceil(P/chunk) sequential forwards; each writes
         # its KV and attends the cache so far.  Only the final chunk's
-        # logits matter (the last prompt token sits in column P-1).
+        # logits matter (the last prompt token sits in column P-1) —
+        # non-final chunks skip the lm_head entirely: their discarded
+        # [B, chunk, V] fp32 logits would otherwise dwarf the activation
+        # memory chunking exists to bound.
         for start in range(0, P, chunk):
             end = min(start + chunk, P)
             logits, cache = forward(
@@ -134,6 +137,7 @@ def _generate_impl(params, prompt_tokens, prompt_mask, rng, config, gc):
                 config,
                 cache=cache,
                 attn_mask=prompt_mask[:, start:end],
+                compute_logits=end >= P,
             )
     else:
         logits, cache = forward(

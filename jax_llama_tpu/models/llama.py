@@ -116,6 +116,7 @@ def init_cache(
 ) -> KVCache:
     """Allocate an empty cache (parity: reference ``init_cache``,
     model.py:459-476 — but as a plain pytree, not a Flax collection)."""
+    config.validate()
     max_len = max_len or config.max_seq_len
     int8_kv = config.kv_cache_dtype == "int8" and dtype is None
     dtype = jnp.int8 if int8_kv else (dtype or config.activation_dtype)
@@ -298,7 +299,8 @@ def forward(
     config: LLaMAConfig,
     cache: Optional[KVCache] = None,
     attn_mask: Optional[jnp.ndarray] = None,
-) -> Tuple[jnp.ndarray, Optional[KVCache]]:
+    compute_logits: bool = True,
+) -> Tuple[Optional[jnp.ndarray], Optional[KVCache]]:
     """Run the transformer.
 
     Args:
@@ -316,8 +318,12 @@ def forward(
         decode engine enforces this bound statically).
       attn_mask: optional [B, T] bool, False for padding.  Defaults to
         positions >= 0.
+      compute_logits: False skips final-norm + lm_head and returns
+        (None, cache) — for cache-building forwards (e.g. non-final
+        prefill chunks) whose [B, T, V] fp32 logits would be thrown away.
     Returns:
-      (logits [B, T, V] in config.logits_dtype, updated cache or None).
+      (logits [B, T, V] in config.logits_dtype, updated cache or None);
+      logits is None when compute_logits=False.
     """
     B, T = tokens.shape
     adt = config.activation_dtype
@@ -371,12 +377,15 @@ def forward(
     # where flash's one-row grid and in-scan cache writes lose.
     impl = config.attn_impl
     if impl == "auto":
-        impl = "flash" if T > 8 else "xla"
+        # int8 caches are only readable on the xla path, so "auto" resolves
+        # there regardless of T when the cache is quantized.
+        quantized = cache is not None and cache.quantized
+        impl = "flash" if T > 8 and not quantized else "xla"
     if cache is not None and cache.quantized and impl != "xla":
         raise NotImplementedError(
             "int8 KV cache requires the xla attention path (the Pallas "
-            "kernels read the cache dtype directly); use attn_impl='xla', "
-            "or kv_cache_dtype='auto' with flash/ring"
+            "kernels read the cache dtype directly); use attn_impl='xla' "
+            "or 'auto', or kv_cache_dtype='auto' with flash/ring"
         )
     bias_new = None
     xla_cached = cache is not None and impl == "xla"
@@ -524,17 +533,19 @@ def forward(
             cache.v, new_v.astype(cache.v.dtype), (0, 0, cache.index, 0, 0)
         )
 
-    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
-
-    if config.tie_word_embeddings:
-        kernel = params["embed"]["embedding"].T
+    if compute_logits:
+        x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+        if config.tie_word_embeddings:
+            kernel = params["embed"]["embedding"].T
+        else:
+            kernel = params["lm_head"]
+        logits = qeinsum(
+            x, kernel, "btd,dv->btv", adt,
+            preferred_element_type=jnp.dtype(config.logits_dtype),
+        ).astype(config.logits_dtype)
+        logits = constrain(logits, "data", "seq", "tensor")
     else:
-        kernel = params["lm_head"]
-    logits = qeinsum(
-        x, kernel, "btd,dv->btv", adt,
-        preferred_element_type=jnp.dtype(config.logits_dtype),
-    ).astype(config.logits_dtype)
-    logits = constrain(logits, "data", "seq", "tensor")
+        logits = None
 
     if cache is not None:
         new_cache = KVCache(

@@ -32,6 +32,7 @@ TPU-native mechanics worth noting:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional, Tuple
 
@@ -41,7 +42,7 @@ from jax import lax
 
 from .config import LLaMAConfig
 from .engine import GenerationConfig, _is_stop, prompt_positions
-from .models.llama import KVCache, forward, init_cache
+from .models.llama import forward, init_cache
 from .ops.sampling import sample, warped_probs
 from .parallel.mesh import use_mesh
 
@@ -298,10 +299,9 @@ def _spec_impl(tp, dp, prompt_tokens, prompt_mask, rng, tc, dc, gc, G):
         # done rows — their buf writes are suppressed.)
         t_valid = j <= acc[:, None]                            # [B, G+1]
         t_patch = jnp.where(t_valid, block_pos, -1).astype(jnp.int32)
-        t_cache = KVCache(
-            k=t_cache.k, v=t_cache.v,
+        t_cache = dataclasses.replace(
+            t_cache,
             pos=lax.dynamic_update_slice(t_cache.pos, t_patch, (0, t_idx)),
-            index=t_cache.index,
         )
         # Draft wrote G+1 slots: [tau, d_1 .. d_G] — slot j holds the token
         # at position p+j, valid iff j <= acc (d_G survives exactly on a
@@ -312,10 +312,9 @@ def _spec_impl(tp, dp, prompt_tokens, prompt_mask, rng, tc, dc, gc, G):
         d_patch = jnp.where(
             d_valid, p[:, None] + jd, -1
         ).astype(jnp.int32)
-        d_cache = KVCache(
-            k=d_cache.k, v=d_cache.v,
+        d_cache = dataclasses.replace(
+            d_cache,
             pos=lax.dynamic_update_slice(d_cache.pos, d_patch, (0, d_idx)),
-            index=d_cache.index,
         )
 
         return (rnd + 1, buf, t_cache, d_cache, tau, count, done,

@@ -209,3 +209,33 @@ def test_int8_kv_cache_rejects_flash():
     pos = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32), (2, 4))
     with pytest.raises(NotImplementedError, match="int8 KV"):
         forward(params, tokens, pos, config, cache=cache)
+
+
+def test_int8_kv_auto_impl_prefill_resolves_to_xla():
+    """attn_impl='auto' + int8 cache must prefill via the xla path (flash
+    cannot read int8), not raise."""
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.engine import GenerationConfig, generate
+
+    config = get_config(
+        "tiny", vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64, kv_cache_dtype="int8",
+        attn_impl="auto",
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, 128, (2, 16)), jnp.int32
+    )
+    mask = jnp.ones((2, 16), bool)
+    gc = GenerationConfig(max_new_tokens=4, temperature=0.0, stop_tokens=())
+    out = generate(params, tokens, mask, jax.random.PRNGKey(0),
+                   config=config, gen_config=gc)
+    assert np.asarray(out).shape == (2, 20)
+
+
+def test_bad_kv_cache_dtype_rejected():
+    import pytest
+    from jax_llama_tpu import get_config
+
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        get_config("tiny", kv_cache_dtype="fp8").validate()
