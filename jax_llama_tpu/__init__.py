@@ -6,7 +6,8 @@ surface, re-expressed for the functional TPU-first design):
   Model:      LLaMAConfig, get_config, init_params, forward, KVCache,
               init_cache
   Parallel:   make_mesh, auto_mesh, use_mesh, constrain
-  Decode:     GenerationConfig, generate, generate_speculative, LLaMA
+  Decode:     GenerationConfig, generate, generate_speculative, LLaMA,
+              ContinuousBatcher
   Tokenizers: ByteTokenizer (vocab-file-free; LLaMA2/3 tokenizers in
               jax_llama_tpu.tokenizers)
   Weights:    convert_meta_checkpoint, save_checkpoint, load_checkpoint
@@ -16,6 +17,7 @@ surface, re-expressed for the functional TPU-first design):
 from .config import LLaMAConfig, get_config, swiglu_hidden_size
 from .engine import GenerationConfig, generate
 from .generation import LLaMA
+from .serving import ContinuousBatcher
 from .spec_decode import generate_speculative
 from .models import KVCache, forward, init_cache, init_params, param_count
 from .ops.quant import QuantizedTensor, quantize_params
@@ -31,6 +33,7 @@ __all__ = [
     "GenerationConfig",
     "generate",
     "generate_speculative",
+    "ContinuousBatcher",
     "LLaMA",
     "ByteTokenizer",
     "KVCache",

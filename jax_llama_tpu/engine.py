@@ -55,6 +55,12 @@ class GenerationConfig:
     prefill_chunk: Optional[int] = None
 
 
+def next_pow2(n: int) -> int:
+    """Bucket serving lengths to powers of two so varied prompt lengths
+    trigger O(log max_len) compilations, not one per distinct length."""
+    return 1 << max(n - 1, 1).bit_length()
+
+
 def prompt_positions(prompt_mask: jnp.ndarray) -> jnp.ndarray:
     """Left-padded prompt mask [B, P] (bool) -> absolute positions [B, P],
     -1 on padding (parity: reference model.py:756-761 computes

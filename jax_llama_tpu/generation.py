@@ -26,11 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import LLaMAConfig
-from .engine import GenerationConfig, generate as engine_generate
-
-
-def _next_pow2(n: int) -> int:
-    return 1 << max(n - 1, 1).bit_length()
+from .engine import GenerationConfig, generate as engine_generate, next_pow2
 
 
 @dataclasses.dataclass
@@ -102,7 +98,7 @@ class LLaMA:
         # Bucket the padded length to the next power of two so serving
         # varied prompt lengths triggers O(log max_len) compilations, not
         # one per distinct length.
-        max_len = _next_pow2(max(len(e) for e in encoded))
+        max_len = next_pow2(max(len(e) for e in encoded))
         pad = self._pad_id()
         B = len(encoded)
         tokens = np.full((B, max_len), pad, dtype=np.int32)

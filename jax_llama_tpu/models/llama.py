@@ -73,7 +73,8 @@ class KVCache:
            the cache is quantized (config.kv_cache_dtype == "int8").
     pos:   [B, S_max] int32 — absolute position written into each slot;
            -1 marks an invalid (padding / unwritten) slot.
-    index: scalar int32 — next write offset (number of slots filled).
+    index: int32 — next write offset: scalar (lockstep decode) or [B]
+           vector (per-row offsets, continuous batching; xla path only).
     k_scale, v_scale: [L, B, S_max, KVH] fp32 per-slot-per-head dequant
            scales (int8 cache only; None otherwise).  Scales are constant
            along head_dim, so dequantization commutes with the attention
@@ -95,6 +96,13 @@ class KVCache:
     @property
     def quantized(self) -> bool:
         return self.k_scale is not None
+
+    @property
+    def per_row_index(self) -> bool:
+        """True when ``index`` is a [B] vector — each batch row writes at
+        its own offset (continuous batching).  Scalar = classic lockstep
+        decode.  Vector indices require the xla attention path."""
+        return self.index.ndim == 1
 
 
 def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -359,17 +367,6 @@ def forward(
     x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(adt)
     x = constrain(x, "data", "seq", None)
 
-    # Slot positions / masking state are layer-independent: compute once,
-    # close over them.  The dense [B,1,T,S] bias is only materialized on the
-    # XLA reference path — the flash kernel recomputes masks blockwise from
-    # the positions and never holds an S×S buffer.
-    new_slot_pos = jnp.where(attn_mask, q_positions, -1).astype(jnp.int32)
-    if cache is not None:
-        slot_pos = lax.dynamic_update_slice(
-            cache.pos, new_slot_pos, (0, cache.index)
-        )
-    else:
-        slot_pos = new_slot_pos
     if config.attn_impl not in ("xla", "flash", "ring", "auto"):
         raise NotImplementedError(f"attn_impl={config.attn_impl!r}")
     # "auto": Pallas flash for prefill/long blocks (no dense [B,1,T,S] bias,
@@ -377,10 +374,12 @@ def forward(
     # where flash's one-row grid and in-scan cache writes lose.
     impl = config.attn_impl
     if impl == "auto":
-        # int8 caches are only readable on the xla path, so "auto" resolves
-        # there regardless of T when the cache is quantized.
-        quantized = cache is not None and cache.quantized
-        impl = "flash" if T > 8 and not quantized else "xla"
+        # int8 caches and per-row indices are only supported on the xla
+        # path, so "auto" resolves there regardless of T in those cases.
+        must_xla = cache is not None and (
+            cache.quantized or cache.per_row_index
+        )
+        impl = "flash" if T > 8 and not must_xla else "xla"
     if cache is not None and cache.quantized and impl != "xla":
         raise NotImplementedError(
             "int8 KV cache requires the xla attention path (the Pallas "
@@ -389,6 +388,29 @@ def forward(
         )
     bias_new = None
     xla_cached = cache is not None and impl == "xla"
+
+    # Slot positions / masking state are layer-independent: compute once,
+    # close over them.  The dense [B,1,T,S] bias is only materialized on the
+    # XLA reference path — the flash kernel recomputes masks blockwise from
+    # the positions and never holds an S×S buffer.
+    new_slot_pos = jnp.where(attn_mask, q_positions, -1).astype(jnp.int32)
+    if cache is not None and cache.per_row_index:
+        if not xla_cached:
+            raise NotImplementedError(
+                "per-row cache indices (continuous batching) require the "
+                "xla attention path"
+            )
+        # Scatter the T new slot positions at each row's own offset;
+        # rows whose offset would run past the cache drop the write.
+        _rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+        _cols = cache.index[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        slot_pos = cache.pos.at[_rows, _cols].set(new_slot_pos, mode="drop")
+    elif cache is not None:
+        slot_pos = lax.dynamic_update_slice(
+            cache.pos, new_slot_pos, (0, cache.index)
+        )
+    else:
+        slot_pos = new_slot_pos
     if impl in ("flash", "ring"):
         bias = None
     elif xla_cached:
@@ -515,23 +537,47 @@ def forward(
             new_v = jnp.stack(new_vs)
     if cache is not None and xla_cached:
         # new_k/new_v hold the per-layer NEW projections [L, B, T, KVH, hd];
-        # one in-place dynamic-update-slice (per array) writes them all
-        # into the cache — quantizing first when the cache is int8.
+        # one in-place write (per array) lands them all in the cache —
+        # quantizing first when the cache is int8.  Scalar index: a
+        # dynamic-update-slice at the shared offset.  Per-row index
+        # (continuous batching): a scatter at each row's own offset —
+        # advanced indices on the contiguous (B, S) axes keep the update
+        # shape [L, B, T, KVH, hd]; out-of-capacity rows drop the write.
         if cache.quantized:
             new_k, k_s = quantize_kv(new_k)
             new_v, v_s = quantize_kv(new_v)
-            new_k_scale = lax.dynamic_update_slice(
-                cache.k_scale, k_s, (0, 0, cache.index, 0)
+        if cache.per_row_index:
+            rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+            cols = (
+                cache.index[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
             )
-            new_v_scale = lax.dynamic_update_slice(
-                cache.v_scale, v_s, (0, 0, cache.index, 0)
+            if cache.quantized:
+                new_k_scale = cache.k_scale.at[:, rows, cols].set(
+                    k_s, mode="drop"
+                )
+                new_v_scale = cache.v_scale.at[:, rows, cols].set(
+                    v_s, mode="drop"
+                )
+            new_k = cache.k.at[:, rows, cols].set(
+                new_k.astype(cache.k.dtype), mode="drop"
             )
-        new_k = lax.dynamic_update_slice(
-            cache.k, new_k.astype(cache.k.dtype), (0, 0, cache.index, 0, 0)
-        )
-        new_v = lax.dynamic_update_slice(
-            cache.v, new_v.astype(cache.v.dtype), (0, 0, cache.index, 0, 0)
-        )
+            new_v = cache.v.at[:, rows, cols].set(
+                new_v.astype(cache.v.dtype), mode="drop"
+            )
+        else:
+            if cache.quantized:
+                new_k_scale = lax.dynamic_update_slice(
+                    cache.k_scale, k_s, (0, 0, cache.index, 0)
+                )
+                new_v_scale = lax.dynamic_update_slice(
+                    cache.v_scale, v_s, (0, 0, cache.index, 0)
+                )
+            new_k = lax.dynamic_update_slice(
+                cache.k, new_k.astype(cache.k.dtype), (0, 0, cache.index, 0, 0)
+            )
+            new_v = lax.dynamic_update_slice(
+                cache.v, new_v.astype(cache.v.dtype), (0, 0, cache.index, 0, 0)
+            )
 
     if compute_logits:
         x = rms_norm(x, params["final_norm"], config.rms_norm_eps)

@@ -1,0 +1,270 @@
+"""Continuous batching: slot-based serving over per-row cache offsets.
+
+Beyond the reference's capability surface (its only serving mode is one
+batch of same-length prompts through `LLaMA.generate`, reference
+``generation.py:22-45``) — a production decode loop where requests enter
+and leave a fixed pool of batch slots independently, vLLM-style, so the
+TPU never idles waiting for the longest generation in a batch.
+
+TPU-native mechanics:
+  * **Static shapes everywhere.**  The pool is ``n_slots`` rows; every
+    decode step is one jitted [B=n_slots, T=1] forward.  Admission runs a
+    B=1 prefill whose length is bucketed to powers of two, so the jit
+    cache holds O(log max_prompt) prefill programs + 1 decode program.
+  * **Per-row cache offsets.**  Each slot writes its KV at its own
+    ``cache.index[b]`` (scatter, not dynamic-update-slice) and masking is
+    purely positional, so rows at different sequence lengths coexist in
+    one cache with no synchronization (models.llama KVCache.per_row_index).
+  * **Idle slots cost nothing semantically**: they decode garbage that is
+    positionally masked (pos -1) and their buffered tokens are never
+    surfaced; their cache writes drop once they hit capacity.
+
+Greedy only for now (per-pool temperature would be easy; per-request
+sampling policies are future work).  Use `engine.generate` for classic
+lockstep batch generation and `spec_decode` for draft-accelerated decode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .config import LLaMAConfig
+from .engine import next_pow2, prompt_positions
+from .models.llama import KVCache, forward, init_cache
+from .parallel.mesh import use_mesh
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "mesh"), donate_argnames=("cache",)
+)
+def _decode_step(params, cache, tau, pos, active, *, config, mesh=None):
+    """One [n_slots, 1] greedy decode step.
+
+    tau: [B] current token per slot; pos: [B] its absolute position;
+    active: [B] bool.  Inactive rows run masked (their writes carry pos -1
+    and their sampled token is ignored by the host).
+    """
+    with use_mesh(mesh):
+        B = tau.shape[0]
+        positions = jnp.where(active, pos, -1)[:, None]
+        logits, cache = forward(
+            params, tau[:, None], positions, config, cache=cache,
+            attn_mask=active[:, None],
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return nxt, cache
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "mesh"), donate_argnames=("cache",)
+)
+def _insert_row(params, cache, row, prompt_tokens, prompt_mask, *,
+                config, mesh=None):
+    """Prefill one request into slot ``row`` of the pool cache.
+
+    prompt_tokens/prompt_mask: [1, P] left-padded (P bucketed by caller).
+    Runs a B=1 prefill against a fresh single-row cache of the pool's
+    capacity, then splices the row back — slot state never leaks between
+    requests.  Returns (first sampled token, its position, updated cache).
+    """
+    with use_mesh(mesh):
+        S = cache.max_len
+        sub = init_cache(config, 1, max_len=S)
+        positions = prompt_positions(prompt_mask)
+        logits, sub = forward(
+            params, prompt_tokens, positions, config, cache=sub,
+            attn_mask=prompt_mask,
+        )
+        tau = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[0]
+        plen = jnp.sum(prompt_mask.astype(jnp.int32))
+
+        def splice(dst, src, axis_b):
+            start = (0,) * axis_b + (row,) + (0,) * (dst.ndim - axis_b - 1)
+            return lax.dynamic_update_slice(dst, src, start)
+
+        new = dataclasses.replace(
+            cache,
+            k=splice(cache.k, sub.k, 1),
+            v=splice(cache.v, sub.v, 1),
+            pos=splice(cache.pos, sub.pos, 0),
+            index=cache.index.at[row].set(prompt_tokens.shape[1]),
+        )
+        if cache.quantized:
+            new = dataclasses.replace(
+                new,
+                k_scale=splice(cache.k_scale, sub.k_scale, 1),
+                v_scale=splice(cache.v_scale, sub.v_scale, 1),
+            )
+        return tau, plen, new
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    emitted: List[int]
+    max_new: int
+    stop_tokens: frozenset
+
+
+class ContinuousBatcher:
+    """Host-side slot manager around the jitted step/insert programs.
+
+    Usage:
+        cb = ContinuousBatcher(params, config, n_slots=8, max_len=2048)
+        rid = cb.submit([1, 5, 9, ...], max_new_tokens=128)
+        while cb.pending():
+            for request_id, token, done in cb.step():
+                ...stream token to the caller...
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        config: LLaMAConfig,
+        n_slots: int = 8,
+        max_len: Optional[int] = None,
+        stop_tokens: Tuple[int, ...] = (),
+        mesh=None,
+    ):
+        if config.attn_impl not in ("xla", "auto"):
+            raise ValueError(
+                "continuous batching requires attn_impl 'xla' or 'auto' "
+                "(per-row cache offsets run on the xla path)"
+            )
+        self.params = params
+        self.config = config
+        self.mesh = mesh
+        self.n_slots = n_slots
+        self.max_len = max_len or config.max_seq_len
+        self.default_stop = frozenset(int(s) for s in stop_tokens)
+
+        base = init_cache(config, n_slots, max_len=self.max_len)
+        self.cache = dataclasses.replace(
+            base, index=jnp.zeros((n_slots,), jnp.int32)
+        )
+        self.tau = jnp.zeros((n_slots,), jnp.int32)
+        self.pos = jnp.zeros((n_slots,), jnp.int32)
+        self.active = jnp.zeros((n_slots,), bool)
+
+        self.slots: Dict[int, Optional[_Slot]] = {
+            b: None for b in range(n_slots)
+        }
+        self.queue: List[Tuple[int, List[int], int, frozenset]] = []
+        self._next_id = 0
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt_tokens: List[int],
+        max_new_tokens: int = 256,
+        stop_tokens: Optional[Tuple[int, ...]] = None,
+    ) -> int:
+        """Queue a request; returns its id.  Tokens only — tokenize first."""
+        if not prompt_tokens:
+            raise ValueError("empty prompt")
+        # Capacity must cover the BUCKETED prompt length: _admit pads the
+        # prompt to the next power of two and the row's write offset starts
+        # there, so checking the raw length would let bucketing silently
+        # push decode writes past capacity (where they drop).
+        bucketed = next_pow2(len(prompt_tokens))
+        if bucketed + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt_tokens)}, padded to {bucketed}) + "
+                f"max_new ({max_new_tokens}) exceeds pool capacity "
+                f"{self.max_len}"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        stops = (
+            self.default_stop if stop_tokens is None
+            else frozenset(int(s) for s in stop_tokens)
+        )
+        self.queue.append((rid, list(prompt_tokens), max_new_tokens, stops))
+        self._admit()
+        return rid
+
+    def pending(self) -> bool:
+        return bool(self.queue) or any(
+            s is not None for s in self.slots.values()
+        )
+
+    def step(self) -> List[Tuple[int, int, bool]]:
+        """One decode step for every active slot.
+
+        Returns [(request_id, token, done)] for tokens emitted this step.
+        Finished slots free up and queued requests are admitted for the
+        NEXT step.
+        """
+        self._admit()
+        if not any(s is not None for s in self.slots.values()):
+            return []
+
+        # Emit each active slot's current tau; free finished slots BEFORE
+        # the decode so a completing request doesn't pay for one more
+        # forward whose output would be discarded.
+        out: List[Tuple[int, int, bool]] = []
+        taus = np.asarray(self.tau)
+        for b, slot in self.slots.items():
+            if slot is None:
+                continue
+            tok = int(taus[b])
+            slot.emitted.append(tok)
+            done = (
+                tok in slot.stop_tokens
+                or len(slot.emitted) >= slot.max_new
+            )
+            out.append((slot.request_id, tok, done))
+            if done:
+                self.slots[b] = None
+                self.active = self.active.at[b].set(False)
+
+        if any(s is not None for s in self.slots.values()):
+            nxt, self.cache = _decode_step(
+                self.params, self.cache, self.tau, self.pos, self.active,
+                config=self.config, mesh=self.mesh,
+            )
+            self.tau = nxt
+            self.pos = self.pos + self.active.astype(jnp.int32)
+        self._admit()
+        return out
+
+    def run_to_completion(self) -> Dict[int, List[int]]:
+        """Drain everything; returns {request_id: emitted tokens}."""
+        results: Dict[int, List[int]] = {}
+        while self.pending():
+            for rid, tok, done in self.step():
+                results.setdefault(rid, []).append(tok)
+        return results
+
+    # -- internals ----------------------------------------------------------
+
+    def _admit(self) -> None:
+        for b, slot in self.slots.items():
+            if slot is not None or not self.queue:
+                continue
+            rid, toks, max_new, stops = self.queue.pop(0)
+            P = next_pow2(len(toks))
+            pt = np.zeros((1, P), np.int32)
+            pm = np.zeros((1, P), bool)
+            pt[0, P - len(toks):] = toks
+            pm[0, P - len(toks):] = True
+            tau, plen, self.cache = _insert_row(
+                self.params, self.cache, jnp.int32(b),
+                jnp.asarray(pt), jnp.asarray(pm),
+                config=self.config, mesh=self.mesh,
+            )
+            self.tau = self.tau.at[b].set(tau)
+            self.pos = self.pos.at[b].set(plen)
+            self.active = self.active.at[b].set(True)
+            self.slots[b] = _Slot(
+                request_id=rid, emitted=[], max_new=max_new,
+                stop_tokens=stops,
+            )
