@@ -6,8 +6,8 @@ surface, re-expressed for the functional TPU-first design):
   Model:      LLaMAConfig, get_config, init_params, forward, KVCache,
               init_cache
   Parallel:   make_mesh, auto_mesh, use_mesh, constrain
-  Decode:     GenerationConfig, generate, generate_speculative, LLaMA,
-              ContinuousBatcher
+  Decode:     GenerationConfig, generate, score, generate_speculative,
+              LLaMA, ContinuousBatcher
   Tokenizers: ByteTokenizer (vocab-file-free; LLaMA2/3 tokenizers in
               jax_llama_tpu.tokenizers)
   Weights:    convert_meta_checkpoint, save_checkpoint, load_checkpoint
@@ -15,7 +15,7 @@ surface, re-expressed for the functional TPU-first design):
 """
 
 from .config import LLaMAConfig, get_config, swiglu_hidden_size
-from .engine import GenerationConfig, generate
+from .engine import GenerationConfig, generate, score
 from .generation import LLaMA
 from .serving import ContinuousBatcher
 from .spec_decode import generate_speculative
@@ -32,6 +32,7 @@ __all__ = [
     "swiglu_hidden_size",
     "GenerationConfig",
     "generate",
+    "score",
     "generate_speculative",
     "ContinuousBatcher",
     "LLaMA",

@@ -55,6 +55,46 @@ class GenerationConfig:
     prefill_chunk: Optional[int] = None
 
 
+@functools.partial(jax.jit, static_argnames=("config", "mesh"))
+def score(
+    params,
+    tokens: jnp.ndarray,
+    attn_mask: Optional[jnp.ndarray] = None,
+    *,
+    config: LLaMAConfig,
+    mesh=None,
+) -> jnp.ndarray:
+    """Per-token log-probabilities of a given sequence (evals/perplexity).
+
+    Args:
+      tokens: [B, T] int32; position t is scored against target tokens[t+1].
+      attn_mask: optional [B, T] bool, False on (left) padding.
+    Returns:
+      [B, T-1] fp32: logp[b, t] = log p(tokens[b, t+1] | tokens[b, :t+1]);
+      positions whose query or target is padding score 0.
+    """
+    from .parallel.mesh import current_mesh
+
+    if mesh is None and current_mesh() is not None:
+        raise ValueError(
+            "score: pass mesh= explicitly (it is part of the jit cache key)"
+        )
+    with use_mesh(mesh):
+        B, T = tokens.shape
+        if attn_mask is None:
+            attn_mask = jnp.ones((B, T), bool)
+        positions = prompt_positions(attn_mask)
+        logits, _ = forward(
+            params, tokens, positions, config, attn_mask=attn_mask
+        )
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        out = jnp.take_along_axis(
+            logp, tokens[:, 1:, None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        valid = attn_mask[:, :-1] & attn_mask[:, 1:]
+        return jnp.where(valid, out, 0.0)
+
+
 def next_pow2(n: int) -> int:
     """Bucket serving lengths to powers of two so varied prompt lengths
     trigger O(log max_len) compilations, not one per distinct length."""

@@ -187,3 +187,32 @@ def test_chunked_prefill_matches_single_shot():
         got = np.asarray(generate(params, tokens, mask, key, config=config,
                                   gen_config=gcc))
         np.testing.assert_array_equal(got, want, err_msg=f"chunk={chunk}")
+
+
+def test_score_matches_manual_softmax():
+    import numpy as np
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.engine import score
+    from jax_llama_tpu.models import forward
+
+    config = get_config(
+        "tiny", vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=32,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    B, T = 2, 10
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (B, T)), jnp.int32
+    )
+    got = np.asarray(score(params, tokens, config=config))
+
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    logits, _ = forward(params, tokens, pos, config)
+    lp = np.asarray(jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32)))
+    want = np.take_along_axis(lp, np.asarray(tokens)[:, 1:, None], axis=-1)[..., 0]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    assert got.shape == (B, T - 1)
+    # padded rows score 0
+    mask = jnp.ones((B, T), bool).at[0, :3].set(False)
+    got2 = np.asarray(score(params, tokens, mask, config=config))
+    assert (got2[0, :3] == 0).all()
