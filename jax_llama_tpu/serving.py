@@ -19,9 +19,9 @@ TPU-native mechanics:
     positionally masked (pos -1) and their buffered tokens are never
     surfaced; their cache writes drop once they hit capacity.
 
-Greedy only for now (per-pool temperature would be easy; per-request
-sampling policies are future work).  Use `engine.generate` for classic
-lockstep batch generation and `spec_decode` for draft-accelerated decode.
+Sampling policy (temperature/top-p/top-k) is pool-wide; per-request
+policies are future work.  Use `engine.generate` for classic lockstep
+batch generation and `spec_decode` for draft-accelerated decode.
 """
 
 from __future__ import annotations
@@ -38,35 +38,40 @@ from jax import lax
 from .config import LLaMAConfig
 from .engine import next_pow2, prompt_positions
 from .models.llama import KVCache, forward, init_cache
+from .ops.sampling import sample
 from .parallel.mesh import use_mesh
 
 
 @functools.partial(
-    jax.jit, static_argnames=("config", "mesh"), donate_argnames=("cache",)
+    jax.jit,
+    static_argnames=("config", "mesh", "temperature", "top_p", "top_k"),
+    donate_argnames=("cache",),
 )
-def _decode_step(params, cache, tau, pos, active, *, config, mesh=None):
-    """One [n_slots, 1] greedy decode step.
+def _decode_step(params, cache, tau, pos, active, rng, *, config,
+                 temperature=0.0, top_p=None, top_k=None, mesh=None):
+    """One [n_slots, 1] decode step (greedy or pool-wide sampling policy).
 
     tau: [B] current token per slot; pos: [B] its absolute position;
     active: [B] bool.  Inactive rows run masked (their writes carry pos -1
     and their sampled token is ignored by the host).
     """
     with use_mesh(mesh):
-        B = tau.shape[0]
         positions = jnp.where(active, pos, -1)[:, None]
         logits, cache = forward(
             params, tau[:, None], positions, config, cache=cache,
             attn_mask=active[:, None],
         )
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        return nxt, cache
+        nxt = sample(rng, logits[:, -1], temperature, top_p, top_k)
+        return nxt.astype(jnp.int32), cache
 
 
 @functools.partial(
-    jax.jit, static_argnames=("config", "mesh"), donate_argnames=("cache",)
+    jax.jit,
+    static_argnames=("config", "mesh", "temperature", "top_p", "top_k"),
+    donate_argnames=("cache",),
 )
-def _insert_row(params, cache, row, prompt_tokens, prompt_mask, *,
-                config, mesh=None):
+def _insert_row(params, cache, row, prompt_tokens, prompt_mask, rng, *,
+                config, temperature=0.0, top_p=None, top_k=None, mesh=None):
     """Prefill one request into slot ``row`` of the pool cache.
 
     prompt_tokens/prompt_mask: [1, P] left-padded (P bucketed by caller).
@@ -82,7 +87,8 @@ def _insert_row(params, cache, row, prompt_tokens, prompt_mask, *,
             params, prompt_tokens, positions, config, cache=sub,
             attn_mask=prompt_mask,
         )
-        tau = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[0]
+        tau = sample(rng, logits[:, -1], temperature, top_p, top_k)
+        tau = tau.astype(jnp.int32)[0]
         plen = jnp.sum(prompt_mask.astype(jnp.int32))
 
         def splice(dst, src, axis_b):
@@ -131,6 +137,10 @@ class ContinuousBatcher:
         n_slots: int = 8,
         max_len: Optional[int] = None,
         stop_tokens: Tuple[int, ...] = (),
+        temperature: float = 0.0,
+        top_p: Optional[float] = None,
+        top_k: Optional[int] = None,
+        seed: int = 0,
         mesh=None,
     ):
         if config.attn_impl not in ("xla", "auto"):
@@ -144,6 +154,10 @@ class ContinuousBatcher:
         self.n_slots = n_slots
         self.max_len = max_len or config.max_seq_len
         self.default_stop = frozenset(int(s) for s in stop_tokens)
+        self.temperature = float(temperature)
+        self.top_p = top_p
+        self.top_k = top_k
+        self._rng = jax.random.PRNGKey(seed)
 
         base = init_cache(config, n_slots, max_len=self.max_len)
         self.cache = dataclasses.replace(
@@ -227,9 +241,11 @@ class ContinuousBatcher:
                 self.active = self.active.at[b].set(False)
 
         if any(s is not None for s in self.slots.values()):
+            self._rng, sub = jax.random.split(self._rng)
             nxt, self.cache = _decode_step(
                 self.params, self.cache, self.tau, self.pos, self.active,
-                config=self.config, mesh=self.mesh,
+                sub, config=self.config, temperature=self.temperature,
+                top_p=self.top_p, top_k=self.top_k, mesh=self.mesh,
             )
             self.tau = nxt
             self.pos = self.pos + self.active.astype(jnp.int32)
@@ -256,10 +272,12 @@ class ContinuousBatcher:
             pm = np.zeros((1, P), bool)
             pt[0, P - len(toks):] = toks
             pm[0, P - len(toks):] = True
+            self._rng, sub = jax.random.split(self._rng)
             tau, plen, self.cache = _insert_row(
                 self.params, self.cache, jnp.int32(b),
-                jnp.asarray(pt), jnp.asarray(pm),
-                config=self.config, mesh=self.mesh,
+                jnp.asarray(pt), jnp.asarray(pm), sub,
+                config=self.config, temperature=self.temperature,
+                top_p=self.top_p, top_k=self.top_k, mesh=self.mesh,
             )
             self.tau = self.tau.at[b].set(tau)
             self.pos = self.pos.at[b].set(plen)
