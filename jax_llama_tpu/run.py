@@ -50,6 +50,12 @@ def main() -> None:
     ap.add_argument("--quantize", action="store_true",
                     help="int8-quantize weights after load (weight-only, "
                          "per-channel; ~2x decode throughput)")
+    ap.add_argument("--serve", action="store_true",
+                    help="continuous-batching mode: read prompts (one per "
+                         "line) from stdin, stream completions as they "
+                         "finish; requests share a slot pool")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="slot-pool size for --serve")
     args = ap.parse_args()
 
     import jax
@@ -96,6 +102,10 @@ def main() -> None:
             params = quantize_params(params, donate=True)
     print(f"restored {args.ckpt_dir} onto {mesh.shape} in {load_t.elapsed_s:.1f}s")
 
+    if args.serve:
+        _serve(params, config, tokenizer, mesh, args)
+        return
+
     model = LLaMA(params=params, config=config, tokenizer=tokenizer, mesh=mesh)
     prompts = args.prompt or DEFAULT_PROMPTS
 
@@ -115,6 +125,43 @@ def main() -> None:
     for p, o in zip(prompts, outs):
         print(f"\n=== {p!r}\n{o}")
     print(f"\n[{stats.summary()}] (incl. compile)")
+
+
+def _serve(params, config, tokenizer, mesh, args) -> None:
+    """Continuous-batching loop over stdin prompts (one per line)."""
+    import sys
+
+    from .serving import ContinuousBatcher
+
+    stops = tuple(
+        int(s) for s in getattr(tokenizer, "stop_tokens", [tokenizer.eos_id])
+    )
+    cb = ContinuousBatcher(
+        params, config, n_slots=args.slots,
+        max_len=config.max_seq_len, stop_tokens=stops,
+        temperature=args.temperature, top_p=args.top_p,
+        seed=args.seed, mesh=mesh,
+    )
+    rid_prompt: dict = {}
+    emitted: dict = {}
+    lines = [ln.rstrip("\n") for ln in sys.stdin if ln.strip()]
+    for line in lines:
+        rid = cb.submit(
+            tokenizer.encode(line, bos=True, eos=False),
+            max_new_tokens=args.max_gen_len,
+        )
+        rid_prompt[rid] = line
+    while cb.pending():
+        for rid, tok, done in cb.step():
+            emitted.setdefault(rid, []).append(tok)
+            if done:
+                toks = [
+                    t for t in emitted[rid]
+                    if t not in stops
+                ]
+                print(f"\n=== {rid_prompt[rid]!r}\n{tokenizer.decode(toks)}",
+                      flush=True)
+    print(f"\nserved {len(rid_prompt)} request(s) on {args.slots} slot(s)")
 
 
 if __name__ == "__main__":

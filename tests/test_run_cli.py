@@ -40,3 +40,28 @@ def test_run_cli_requires_tokenizer(tmp_path, monkeypatch):
     )
     with pytest.raises(SystemExit):
         run_cli.main()
+
+
+def test_run_cli_serve_mode(tmp_path, capsys, monkeypatch):
+    """--serve streams completions for stdin prompts via the batcher."""
+    import io
+
+    config = get_config(
+        "tiny", vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(str(ckpt), params, config)
+
+    monkeypatch.setattr(
+        sys, "argv",
+        ["run", "--ckpt-dir", str(ckpt), "--byte-tokenizer", "--serve",
+         "--slots", "2", "--tensor", "2", "--max-gen-len", "6",
+         "--temperature", "0.0"],
+    )
+    monkeypatch.setattr(sys, "stdin", io.StringIO("hello\nworld\n"))
+    run_cli.main()
+    out = capsys.readouterr().out
+    assert "'hello'" in out and "'world'" in out
+    assert "served 2 request(s)" in out
