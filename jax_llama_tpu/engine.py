@@ -12,8 +12,9 @@ Shape discipline (the reference's recipe, kept):
     prompt token sits in column P-1 and one gather serves the whole batch
     (reference generation.py:55-57 left-pads with eos for the same reason).
   * The token buffer is preallocated to P + max_new_tokens; the KV cache to
-    the same.  `cache.index + T <= max_len` is checked statically here —
-    `dynamic_update_slice` would clamp silently otherwise.
+    the same, so `cache.index + T <= max_len` holds by construction (the
+    while cond caps decode steps at max_new_tokens) — important because
+    `dynamic_update_slice` would clamp out-of-range writes silently.
   * Stop tokens are a static tuple (llama3 has two: end_of_text and eot_id,
     reference llama3_tokenizer.py:91-94).  A stop token is written to the
     buffer (so callers can see it), then the row emits pad_id forever.
