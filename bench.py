@@ -63,9 +63,11 @@ def main() -> None:
 
     def measure(p):
         """Steady-state decode rate: the (prefill + N) vs (prefill + 1)
-        difference cancels prefill time out of the metric."""
-        full = min(run(p, N) for _ in range(3))
-        short = min(run(p, 1) for _ in range(3))
+        difference cancels both prefill time and the constant per-call
+        dispatch overhead of this environment's tunnel out of the metric.
+        min-of-5 on each side tames the tunnel's run-to-run jitter."""
+        full = min(run(p, N) for _ in range(5))
+        short = min(run(p, 1) for _ in range(5))
         decode_s = max(full - short, 1e-9)
         return B * (N - 1) / decode_s, decode_s, full, short
 
