@@ -67,26 +67,36 @@ def _decode_step(params, cache, tau, pos, active, rng, *, config,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "mesh", "temperature", "top_p", "top_k"),
+    static_argnames=("config", "mesh", "temperature", "top_p", "top_k",
+                     "prefill_chunk"),
     donate_argnames=("cache",),
 )
 def _insert_row(params, cache, row, prompt_tokens, prompt_mask, rng, *,
-                config, temperature=0.0, top_p=None, top_k=None, mesh=None):
+                config, temperature=0.0, top_p=None, top_k=None,
+                prefill_chunk=None, mesh=None):
     """Prefill one request into slot ``row`` of the pool cache.
 
     prompt_tokens/prompt_mask: [1, P] left-padded (P bucketed by caller).
     Runs a B=1 prefill against a fresh single-row cache of the pool's
-    capacity, then splices the row back — slot state never leaks between
-    requests.  Returns (first sampled token, its position, updated cache).
+    capacity (optionally in fixed chunks, bounding activation memory for
+    long prompts), then splices the row back — slot state never leaks
+    between requests.  Returns (first sampled token, its position,
+    updated cache).
     """
     with use_mesh(mesh):
         S = cache.max_len
         sub = init_cache(config, 1, max_len=S)
         positions = prompt_positions(prompt_mask)
-        logits, sub = forward(
-            params, prompt_tokens, positions, config, cache=sub,
-            attn_mask=prompt_mask,
-        )
+        P = prompt_tokens.shape[1]
+        chunk = prefill_chunk if prefill_chunk and prefill_chunk < P else P
+        for start in range(0, P, chunk):
+            end = min(start + chunk, P)
+            logits, sub = forward(
+                params, prompt_tokens[:, start:end],
+                positions[:, start:end], config, cache=sub,
+                attn_mask=prompt_mask[:, start:end],
+                compute_logits=end >= P,
+            )
         tau = sample(rng, logits[:, -1], temperature, top_p, top_k)
         tau = tau.astype(jnp.int32)[0]
         plen = jnp.sum(prompt_mask.astype(jnp.int32))
@@ -140,6 +150,7 @@ class ContinuousBatcher:
         temperature: float = 0.0,
         top_p: Optional[float] = None,
         top_k: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
         seed: int = 0,
         mesh=None,
     ):
@@ -157,6 +168,7 @@ class ContinuousBatcher:
         self.temperature = float(temperature)
         self.top_p = top_p
         self.top_k = top_k
+        self.prefill_chunk = prefill_chunk
         self._rng = jax.random.PRNGKey(seed)
 
         base = init_cache(config, n_slots, max_len=self.max_len)
@@ -277,7 +289,8 @@ class ContinuousBatcher:
                 self.params, self.cache, jnp.int32(b),
                 jnp.asarray(pt), jnp.asarray(pm), sub,
                 config=self.config, temperature=self.temperature,
-                top_p=self.top_p, top_k=self.top_k, mesh=self.mesh,
+                top_p=self.top_p, top_k=self.top_k,
+                prefill_chunk=self.prefill_chunk, mesh=self.mesh,
             )
             self.tau = self.tau.at[b].set(tau)
             self.pos = self.pos.at[b].set(plen)

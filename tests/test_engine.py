@@ -216,3 +216,32 @@ def test_score_matches_manual_softmax():
     mask = jnp.ones((B, T), bool).at[0, :3].set(False)
     got2 = np.asarray(score(params, tokens, mask, config=config))
     assert (got2[0, :3] == 0).all()
+
+
+def test_prompt_containing_eos_is_not_masked():
+    """The reference pads with eos and derives its mask as tokens != eos
+    (reference generation.py:55-60), silently masking genuine eos tokens
+    inside a prompt.  This framework takes an explicit mask, so an eos in
+    the prompt participates in attention like any other token — outputs
+    must differ from the same prompt with that position masked out."""
+    import numpy as np
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.engine import GenerationConfig, generate
+
+    config = get_config(
+        "tiny", vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    eos = 2
+    prompt = jnp.asarray([[9, eos, 13, 21, 40, 7]], jnp.int32)
+    mask_full = jnp.ones((1, 6), bool)
+    mask_holed = mask_full.at[0, 1].set(False)  # what the reference does
+    gc = GenerationConfig(max_new_tokens=8, temperature=0.0, stop_tokens=())
+    a = np.asarray(generate(params, prompt, mask_full, jax.random.PRNGKey(0),
+                            config=config, gen_config=gc))
+    b = np.asarray(generate(params, prompt, mask_holed, jax.random.PRNGKey(0),
+                            config=config, gen_config=gc))
+    assert not np.array_equal(a[:, 6:], b[:, 6:]), (
+        "masking the eos position should change the continuation"
+    )

@@ -150,3 +150,15 @@ def test_sampled_pool_runs_and_varies(model):
     assert a == b            # deterministic per seed
     assert a != c            # varies across seeds
     assert all(0 <= t < 128 for t in a)
+
+
+def test_chunked_admission_matches_single_shot(model):
+    """Batcher prefill in chunks must yield identical completions."""
+    params, config = model
+    prompt = list(np.random.RandomState(3).randint(1, 128, size=23))
+    want = _reference(params, config, prompt, 10)
+    for chunk in (8, 16, None):
+        cb = ContinuousBatcher(params, config, n_slots=1, max_len=64,
+                               prefill_chunk=chunk)
+        rid = cb.submit(prompt, max_new_tokens=10)
+        assert cb.run_to_completion()[rid] == want, f"chunk={chunk}"
