@@ -4,7 +4,7 @@ The reference has no training and therefore no data loader (SURVEY.md: the
 repo is inference-only).  Training here is first-class, so the input side
 is too — the TPU-idiomatic shape: fixed-size [B, T] batches (static shapes
 keep one compiled train_step), greedy document packing with EOS separators
-(no padding waste), a loss mask that excludes the separator targets, and
+(no padding waste), a loss mask that excludes padding targets, and
 `jax.device_put` with the batch sharded over the mesh's data axes so each
 host/device group receives only its slice.
 
@@ -27,9 +27,11 @@ class Batch:
     """One packed training batch.
 
     tokens:    [B, T] int32.
-    loss_mask: [B, T] bool — True where the position's *target* (the next
-               token) is a real document token; False on padding and at
-               document boundaries crossing into a new document's BOS.
+    loss_mask: [B, T] bool, query-position-indexed — loss_mask[t] gates
+               the loss term predicting token t+1 from position t; False
+               where that target would be padding.  Cross-document
+               EOS→BOS transitions are trained on (the standard packed-LM
+               convention); `train.lm_loss` consumes this same indexing.
     """
 
     tokens: np.ndarray

@@ -146,19 +146,26 @@ def _serve(params, config, tokenizer, mesh, args) -> None:
     emitted: dict = {}
     lines = [ln.rstrip("\n") for ln in sys.stdin if ln.strip()]
     for line in lines:
-        rid = cb.submit(
-            tokenizer.encode(line, bos=True, eos=False),
-            max_new_tokens=args.max_gen_len,
-        )
+        try:
+            rid = cb.submit(
+                tokenizer.encode(line, bos=True, eos=False),
+                max_new_tokens=args.max_gen_len,
+            )
+        except ValueError as e:
+            # One over-long prompt must not take down the whole serve loop.
+            print(f"\n=== {line!r}\n[rejected: {e}]", flush=True)
+            continue
         rid_prompt[rid] = line
     while cb.pending():
         for rid, tok, done in cb.step():
             emitted.setdefault(rid, []).append(tok)
             if done:
-                toks = [
-                    t for t in emitted[rid]
-                    if t not in stops
-                ]
+                toks = emitted[rid]
+                # The batcher finishes a request at its first stop token,
+                # so a stop id can only be the terminal element; strip just
+                # that one rather than filtering stop ids everywhere.
+                if toks and toks[-1] in stops:
+                    toks = toks[:-1]
                 print(f"\n=== {rid_prompt[rid]!r}\n{tokenizer.decode(toks)}",
                       flush=True)
     print(f"\nserved {len(rid_prompt)} request(s) on {args.slots} slot(s)")

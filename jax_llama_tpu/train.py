@@ -79,8 +79,11 @@ def lm_loss(
     """Masked next-token cross-entropy.
 
     tokens: [B, T] int32; position t predicts token t+1.
-    loss_mask: optional [B, T] bool — True where the *target* token counts
-      (defaults to all positions).
+    loss_mask: optional [B, T] bool, query-position-indexed: mask[:, t]
+      gates the loss term predicting token t+1 from position t (the
+      convention `data.pack_documents` emits; the final position has no
+      in-row target, so mask[:, -1] is never consumed).  Defaults to all
+      positions.
     """
     B, T = tokens.shape
     targets = tokens[:, 1:]
@@ -92,7 +95,9 @@ def lm_loss(
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[:, :, None], axis=-1)[..., 0]
     if loss_mask is not None:
-        m = loss_mask[:, 1:].astype(jnp.float32)
+        # Query-indexed: mask[:, t] aligns with nll[:, t] (the loss for
+        # target tokens[:, t+1]); drop the final, target-less position.
+        m = loss_mask[:, :-1].astype(jnp.float32)
         return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
     return jnp.mean(nll)
 

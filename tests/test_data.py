@@ -20,6 +20,44 @@ def test_pack_concatenates_and_pads():
     assert rows[1].loss_mask.tolist() == [True, False, False, False]
 
 
+def test_partial_row_last_target_receives_loss():
+    """Convention regression (ADVICE r1): data.py's query-indexed mask and
+    lm_loss's consumption must agree, so the last real target of a partial
+    row ('6' below, predicted from position of '5') contributes loss."""
+    import jax.numpy as jnp
+    from jax_llama_tpu.train import lm_loss
+
+    docs = [[1, 2, 3], [4, 5], [6]]
+    rows = list(pack_documents(docs, seq_len=4, pad_id=0))
+    partial = rows[1]  # tokens [5, 6, 0, 0], mask [T, F, F, F]
+    config = get_config(
+        "tiny", vocab_size=8, dim=16, n_layers=1, n_heads=2, n_kv_heads=2,
+        multiple_of=16, max_seq_len=4,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    tokens = jnp.asarray(partial.tokens)[None]
+    mask = jnp.asarray(partial.loss_mask)[None]
+
+    base = lm_loss(params, tokens, config, mask)
+    # Perturb only the '6' target's ground truth: if that term is in the
+    # loss, changing the token at its *target* position changes the loss.
+    toks2 = tokens.at[0, 1].set(7)
+    changed = lm_loss(params, toks2, config, mask)
+    assert not np.isclose(float(base), float(changed)), (
+        "the partial row's last real target is excluded from the loss"
+    )
+    # Exactly one term is active: the masked mean equals the NLL of
+    # target '6' predicted from query position 0.
+    from jax_llama_tpu.models import forward
+
+    logits, _ = forward(
+        params, tokens, jnp.arange(4)[None, :], config
+    )
+    logp = jax.nn.log_softmax(np.asarray(logits, np.float64), axis=-1)
+    want = -logp[0, 0, int(tokens[0, 1])]
+    np.testing.assert_allclose(float(base), want, rtol=1e-5)
+
+
 def test_pack_long_document_spans_rows():
     rows = list(pack_documents([list(range(10))], seq_len=4, pad_id=99))
     assert [r.tokens.tolist() for r in rows] == [

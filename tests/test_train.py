@@ -47,8 +47,9 @@ def test_loss_mask_excludes_positions():
     mask = jnp.asarray([[True, True, True, False, False, False]])
     got = float(lm_loss(params, tokens, CFG, loss_mask=mask))
 
-    # Expected: mean NLL over exactly the unmasked *targets* (positions 1,2
-    # of the shifted targets — mask[:, 1:] selects targets 2 and 3).
+    # Query-indexed convention: mask[:, t] gates the loss predicting token
+    # t+1 from position t, so mask [T,T,T,F,F,F] keeps the loss terms at
+    # query positions 0,1,2 (targets 2,3,4) — lm_loss drops mask[:, -1].
     logits, _ = forward(
         params, tokens[:, :-1],
         jnp.arange(5)[None, :], CFG,
@@ -56,7 +57,7 @@ def test_loss_mask_excludes_positions():
     logp = jax.nn.log_softmax(np.asarray(logits, np.float64), axis=-1)
     targets = np.asarray(tokens)[0, 1:]
     nll = -logp[0, np.arange(5), targets]
-    want = nll[:2].mean()  # targets at shifted positions 0,1 are unmasked
+    want = nll[:3].mean()  # query positions 0,1,2 are unmasked
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
