@@ -1,0 +1,337 @@
+"""Full-model numerical parity against the reference implementation ITSELF.
+
+The reference validates its Flax model against Meta's torch ``llama``
+(/root/reference/jax_test.py:427-522: last-token logits within atol, greedy
+string equality).  Meta's checkpoints aren't available here, so the
+strongest independent oracle in this environment is the reference's own
+``FlaxLLaMAForCausalLM`` (/root/reference/jax_llama/model.py:745): we load
+IDENTICAL weights into both models through a param-mapping shim and require
+fp32 logit agreement for plain forward, left-padded batches, cached decode,
+and token-for-token greedy generation — plus an exact tree diff of the two
+Meta-checkpoint converters over the same synthetic sharded checkpoint
+(/root/reference/jax_llama/convert_weights.py:52-92).
+
+The reference package is imported from /root/reference via a synthetic
+package entry (its ``__init__`` pulls sentencepiece, which this image lacks
+— we stub it; everything these tests exercise is flax/transformers only).
+Tests skip if the reference tree is absent.
+"""
+
+import importlib
+import sys
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jax_llama_tpu import get_config, init_cache, init_params
+from jax_llama_tpu.engine import GenerationConfig, generate
+from jax_llama_tpu.models import forward
+
+REF_DIR = Path("/root/reference")
+
+pytestmark = pytest.mark.skipif(
+    not (REF_DIR / "jax_llama" / "model.py").exists(),
+    reason="reference tree not available",
+)
+
+
+def _load_reference():
+    """Import reference submodules without executing the package __init__
+    (which requires sentencepiece)."""
+    if "sentencepiece" not in sys.modules:
+        try:
+            importlib.import_module("sentencepiece")
+        except ImportError:
+            stub = types.ModuleType("sentencepiece")
+            stub.SentencePieceProcessor = object
+            # transformers probes availability via find_spec, which requires
+            # a real-looking __spec__ on an already-imported module.
+            stub.__spec__ = importlib.machinery.ModuleSpec(
+                "sentencepiece", loader=None
+            )
+            sys.modules["sentencepiece"] = stub
+    if "jax_llama" not in sys.modules:
+        pkg = types.ModuleType("jax_llama")
+        pkg.__path__ = [str(REF_DIR / "jax_llama")]
+        sys.modules["jax_llama"] = pkg
+    model = importlib.import_module("jax_llama.model")
+    config = importlib.import_module("jax_llama.config")
+    return model, config
+
+
+# Small but non-degenerate: GQA (H != KVH), 3 layers, odd-ish vocab.
+DIM, HEADS, KV_HEADS, LAYERS, VOCAB, FFN_MULT, MAX_LEN = 64, 4, 2, 3, 199, 32, 64
+
+
+@pytest.fixture(scope="module")
+def models():
+    ref_model_mod, ref_config_mod = _load_reference()
+    config = get_config(
+        "tiny", vocab_size=VOCAB, dim=DIM, n_layers=LAYERS, n_heads=HEADS,
+        n_kv_heads=KV_HEADS, multiple_of=FFN_MULT, max_seq_len=MAX_LEN,
+        dtype="float32", param_dtype="float32",
+    )
+    ref_config = ref_config_mod.LLaMAConfig(
+        vocab_size=VOCAB, hidden_size=DIM, intermediate_size=config.ffn_dim,
+        num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+        num_key_value_heads=KV_HEADS, max_sequence_length=MAX_LEN,
+        rms_norm_eps=config.rms_norm_eps, rope_theta=config.rope_theta,
+    )
+    ref = ref_model_mod.FlaxLLaMAForCausalLM(
+        ref_config, input_shape=(1, 2), seed=0, dtype=jnp.float32,
+        _do_init=False,
+    )
+    params = init_params(jax.random.PRNGKey(7), config)
+    return ref, to_reference_params(params, config), params, config
+
+
+def to_reference_params(params, config):
+    """Map our stacked-layer pytree onto the reference's Flax param tree.
+
+    Layout contract (reference model.py:105-180,302-341,602-744): Dense
+    kernels are [in, out]; our per-layer q/k/v [D, H, hd] flatten to the
+    reference's fused [D, H*hd]; o [H, hd, D] flattens to [H*hd, D];
+    gate/up/down are w1/w3/w2 unchanged; norms are 1-D 'kernel's.
+    """
+    D, H, KVH, hd = config.dim, config.n_heads, config.kv_heads, config.head_dim
+    lp = params["layers"]
+    f32 = lambda x: np.asarray(x, np.float32)
+    h = {}
+    for i in range(config.n_layers):
+        h[str(i)] = {
+            "attention": {
+                "wq": {"kernel": f32(lp["q"][i]).reshape(D, H * hd)},
+                "wk": {"kernel": f32(lp["k"][i]).reshape(D, KVH * hd)},
+                "wv": {"kernel": f32(lp["v"][i]).reshape(D, KVH * hd)},
+                "wo": {"kernel": f32(lp["o"][i]).reshape(H * hd, D)},
+            },
+            "feed_forward": {
+                "w1": {"kernel": f32(lp["gate"][i])},
+                "w2": {"kernel": f32(lp["down"][i])},
+                "w3": {"kernel": f32(lp["up"][i])},
+            },
+            "attention_norm": {"kernel": f32(lp["attn_norm"][i])},
+            "ffn_norm": {"kernel": f32(lp["mlp_norm"][i])},
+        }
+    return {
+        "transformer": {
+            "wte": {"embedding": f32(params["embed"]["embedding"])},
+            "ln_f": {"kernel": f32(params["final_norm"])},
+            "h": h,
+        },
+        "lm_head": {"kernel": f32(params["lm_head"])},
+    }
+
+
+def _assert_close(mine, ref, atol=1e-3, what=""):
+    mine, ref = np.asarray(mine, np.float64), np.asarray(ref, np.float64)
+    np.testing.assert_allclose(mine, ref, atol=atol, rtol=0, err_msg=what)
+
+
+def test_plain_forward_logits_match(models):
+    ref, ref_params, params, config = models
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, VOCAB, size=(2, 16)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+
+    mine, _ = forward(params, tokens, positions, config)
+    theirs = ref(tokens, params=ref_params).logits
+    _assert_close(mine, theirs, what="plain forward")
+
+
+def test_left_padded_batch_matches(models):
+    ref, ref_params, params, config = models
+    rng = np.random.RandomState(1)
+    B, P = 3, 12
+    lens = [12, 7, 4]
+    tokens = np.zeros((B, P), np.int32)
+    mask = np.zeros((B, P), bool)
+    for b, L in enumerate(lens):
+        tokens[b, P - L:] = rng.randint(1, VOCAB, size=L)
+        mask[b, P - L:] = True
+
+    # Reference convention (model.py:756-761): position_ids = cumsum - 1.
+    att = jnp.asarray(mask, jnp.int32)
+    ref_pos = jnp.cumsum(att, axis=-1) - 1
+    theirs = ref(
+        jnp.asarray(tokens), attention_mask=att, position_ids=ref_pos,
+        params=ref_params,
+    ).logits
+
+    # Our convention: padding carries position -1 (mask derives from it).
+    my_pos = jnp.where(jnp.asarray(mask), ref_pos, -1).astype(jnp.int32)
+    mine, _ = forward(params, jnp.asarray(tokens), my_pos, config)
+
+    # Compare only real positions: logits at padded slots are unspecified
+    # (both models mask them out of every downstream attention).
+    for b, L in enumerate(lens):
+        _assert_close(
+            mine[b, P - L:], theirs[b, P - L:], what=f"left-pad row {b}"
+        )
+
+
+def test_cached_decode_matches_for_20_steps(models):
+    ref, ref_params, params, config = models
+    rng = np.random.RandomState(2)
+    B, P, STEPS = 2, 8, 20
+    prompt = jnp.asarray(rng.randint(0, VOCAB, size=(B, P)), jnp.int32)
+    max_len = P + STEPS
+
+    # Reference: Flax mutable-cache protocol (model.py:459-546).
+    ref_cache = ref.init_cache(B, max_len)
+    att = jnp.ones((B, max_len), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+    out = ref(prompt, attention_mask=att, position_ids=pos,
+              params=ref_params, past_key_values=ref_cache)
+    ref_logits = [np.asarray(out.logits[:, -1])]
+    ref_cache = out.past_key_values
+
+    # Ours: explicit KVCache pytree.
+    cache = init_cache(config, B, max_len=max_len)
+    mine, cache = forward(params, prompt, pos, config, cache=cache)
+    my_logits = [np.asarray(mine[:, -1])]
+
+    step_tok = prompt[:, -1:]
+    for i in range(STEPS - 1):
+        step_pos = jnp.full((B, 1), P + i, dtype=jnp.int32)
+        out = ref(step_tok, attention_mask=att, position_ids=step_pos,
+                  params=ref_params, past_key_values=ref_cache)
+        ref_cache = out.past_key_values
+        ref_logits.append(np.asarray(out.logits[:, -1]))
+
+        lg, cache = forward(params, step_tok, step_pos, config, cache=cache)
+        my_logits.append(np.asarray(lg[:, -1]))
+
+        # Drive both with the same (reference-chosen) greedy next token so
+        # any divergence is a numerics failure, not drift.
+        step_tok = jnp.argmax(out.logits[:, -1], axis=-1)[:, None].astype(jnp.int32)
+
+    for i, (m, r) in enumerate(zip(my_logits, ref_logits)):
+        _assert_close(m, r, what=f"cached decode step {i}")
+
+
+def test_greedy_generation_token_for_token(models):
+    ref, ref_params, params, config = models
+    rng = np.random.RandomState(3)
+    B, P, NEW = 2, 6, 16
+    prompt = jnp.asarray(rng.randint(1, VOCAB, size=(B, P)), jnp.int32)
+
+    # Reference greedy loop over its cached decode path.
+    ref_cache = ref.init_cache(B, P + NEW)
+    att = jnp.ones((B, P + NEW), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+    out = ref(prompt, attention_mask=att, position_ids=pos,
+              params=ref_params, past_key_values=ref_cache)
+    ref_cache = out.past_key_values
+    tok = jnp.argmax(out.logits[:, -1], axis=-1)[:, None].astype(jnp.int32)
+    ref_tokens = [np.asarray(tok[:, 0])]
+    for i in range(NEW - 1):
+        out = ref(tok, attention_mask=att,
+                  position_ids=jnp.full((B, 1), P + i, dtype=jnp.int32),
+                  params=ref_params, past_key_values=ref_cache)
+        ref_cache = out.past_key_values
+        tok = jnp.argmax(out.logits[:, -1], axis=-1)[:, None].astype(jnp.int32)
+        ref_tokens.append(np.asarray(tok[:, 0]))
+    ref_tokens = np.stack(ref_tokens, axis=1)  # [B, NEW]
+
+    # Our whole generation engine (jitted prefill + while_loop decode).
+    got = generate(
+        params, prompt, jnp.ones((B, P), bool), jax.random.PRNGKey(0),
+        config=config,
+        gen_config=GenerationConfig(max_new_tokens=NEW, temperature=0.0),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got[:, P:]), ref_tokens,
+        err_msg="greedy generation diverged from the reference model",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Converter cross-check: both converters over one synthetic Meta checkpoint
+# ---------------------------------------------------------------------------
+
+def _write_synthetic_meta_checkpoint(tmpdir, n_shards=2):
+    """Emit a sharded Llama-2-style Meta checkpoint (Megatron splits:
+    wq/wk/wv/w1/w3/output on rows, wo/w2/embedding on columns)."""
+    import json
+
+    import torch
+
+    D, H, KVH, L, V = DIM, HEADS, KV_HEADS, LAYERS, VOCAB + 1  # even vocab
+    hd = D // H
+    FF = 2 * (D * 4) // 3
+    FF = FFN_MULT * ((FF + FFN_MULT - 1) // FFN_MULT)
+    rng = np.random.RandomState(11)
+    t = lambda *s: torch.from_numpy(rng.randn(*s).astype(np.float32))
+
+    full = {"tok_embeddings.weight": t(V, D), "norm.weight": t(D),
+            "output.weight": t(V, D)}
+    for i in range(L):
+        p = f"layers.{i}."
+        full[p + "attention.wq.weight"] = t(H * hd, D)
+        full[p + "attention.wk.weight"] = t(KVH * hd, D)
+        full[p + "attention.wv.weight"] = t(KVH * hd, D)
+        full[p + "attention.wo.weight"] = t(D, H * hd)
+        full[p + "feed_forward.w1.weight"] = t(FF, D)
+        full[p + "feed_forward.w2.weight"] = t(D, FF)
+        full[p + "feed_forward.w3.weight"] = t(FF, D)
+        full[p + "attention_norm.weight"] = t(D)
+        full[p + "ffn_norm.weight"] = t(D)
+
+    col_split = {"tok_embeddings.weight": 1, "attention.wo.weight": 1,
+                 "feed_forward.w2.weight": 1}
+    for s in range(n_shards):
+        shard = {}
+        for k, v_ in full.items():
+            axis = next(
+                (ax for suf, ax in col_split.items() if k.endswith(suf)), 0
+            )
+            if v_.ndim == 1:
+                shard[k] = v_.clone()  # replicated
+            else:
+                shard[k] = torch.chunk(v_, n_shards, dim=axis)[s].clone()
+        torch.save(shard, f"{tmpdir}/consolidated.{s:02d}.pth")
+    with open(f"{tmpdir}/params.json", "w") as f:
+        json.dump({"dim": D, "n_layers": L, "n_heads": H, "n_kv_heads": KVH,
+                   "multiple_of": FFN_MULT, "norm_eps": 1e-5}, f)
+    return V
+
+
+def test_converters_agree_on_synthetic_checkpoint(tmp_path):
+    _load_reference()
+    ref_convert = importlib.import_module("jax_llama.convert_weights")
+    from jax_llama_tpu.convert.meta import convert_meta_checkpoint
+
+    V = _write_synthetic_meta_checkpoint(tmp_path)
+
+    class FakeTok:
+        def __len__(self):
+            return V
+
+    ref_tree, ref_cfg = ref_convert.convert_llama_weights(
+        str(tmp_path), FakeTok(), max_seq_len=MAX_LEN,
+    )
+    mine, my_cfg = convert_meta_checkpoint(
+        str(tmp_path), vocab_size=V, max_seq_len=MAX_LEN, dtype="float32",
+    )
+    assert my_cfg.ffn_dim == ref_cfg.intermediate_size
+    assert my_cfg.n_kv_heads == ref_cfg.num_key_value_heads
+
+    mapped = to_reference_params(mine, my_cfg)
+    ref_flat = jax.tree_util.tree_flatten_with_path(ref_tree)[0]
+    my_flat = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_flatten_with_path(mapped)[0]
+    )
+    assert len(ref_flat) == len(my_flat)
+    for key, ref_leaf in ref_flat:
+        ks = jax.tree_util.keystr(key)
+        np.testing.assert_array_equal(
+            my_flat[ks], np.asarray(ref_leaf),
+            err_msg=f"converter mismatch at {ks}",
+        )
