@@ -57,6 +57,14 @@ class LLaMAConfig:
     use_scaled_rope: bool = False         # Llama-3.1 context-extension RoPE
     tie_word_embeddings: bool = False
 
+    # --- training regularization (reference config.py:85-87 capability).
+    # Applied only when a dropout_rng is passed to forward/train_step;
+    # inference paths stay deterministic regardless.
+    resid_pdrop: float = 0.0              # after attention out and MLP out
+    embd_pdrop: float = 0.0               # on token embeddings
+    attn_pdrop: float = 0.0               # on attention probabilities
+                                          #   (xla attention path only)
+
     # --- numerics / execution policy (TPU-first) ---
     dtype: str = "bfloat16"               # activation/compute dtype
     param_dtype: str = "float32"          # parameter storage dtype
@@ -109,6 +117,10 @@ class LLaMAConfig:
         )
         if self.attn_impl not in ("xla", "flash", "ring", "auto"):
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
+        for name in ("resid_pdrop", "embd_pdrop", "attn_pdrop"):
+            p = getattr(self, name)
+            if not 0.0 <= p < 1.0:
+                raise ValueError(f"{name}={p} must be in [0, 1)")
         if self.kv_cache_dtype not in ("auto", "int8"):
             # A typo here would silently fall back to the full-precision
             # cache; fail instead.

@@ -46,7 +46,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..config import LLaMAConfig
-from ..ops.attention import attention_bias, sdpa, sdpa_cached
+from ..ops.attention import attention_bias, dropout as _dropout, sdpa, sdpa_cached
 from ..ops.flash_attention import flash_attention
 from ..ops.norm import rms_norm
 from ..ops.quant import matmul as qeinsum
@@ -205,6 +205,7 @@ def _block(
     cache_v: Optional[jnp.ndarray],
     cache_k_scale: Optional[jnp.ndarray] = None,
     cache_v_scale: Optional[jnp.ndarray] = None,
+    dropout_rng: Optional[jax.Array] = None,
     *,
     config: LLaMAConfig,
     positions: jnp.ndarray,
@@ -281,10 +282,22 @@ def _block(
         elif impl in ("flash", "ring"):
             attn = flash_attention(q, kk, vv, positions, slot_pos)
         else:
-            attn = sdpa(q, kk, vv, bias, softmax_dtype=softmax_dtype)
+            attn = sdpa(
+                q, kk, vv, bias, softmax_dtype=softmax_dtype,
+                dropout_rng=(
+                    jax.random.fold_in(dropout_rng, 0)
+                    if dropout_rng is not None and config.attn_pdrop > 0.0
+                    else None
+                ),
+                dropout_rate=config.attn_pdrop,
+            )
 
     attn_out = qeinsum(attn, lp["o"], "bthk,hkd->btd", adt)
     attn_out = constrain(attn_out, "data", "seq", None)
+    if dropout_rng is not None and config.resid_pdrop > 0.0:
+        attn_out = _dropout(
+            jax.random.fold_in(dropout_rng, 1), attn_out, config.resid_pdrop
+        )
     x = x + attn_out
 
     # --- SwiGLU MLP ---
@@ -296,6 +309,10 @@ def _block(
     hidden = jax.nn.silu(gate) * up
     down = qeinsum(hidden, lp["down"], "btf,fd->btd", adt)
     down = constrain(down, "data", "seq", None)
+    if dropout_rng is not None and config.resid_pdrop > 0.0:
+        down = _dropout(
+            jax.random.fold_in(dropout_rng, 2), down, config.resid_pdrop
+        )
     x = x + down
     return x, cache_k, cache_v
 
@@ -308,6 +325,7 @@ def forward(
     cache: Optional[KVCache] = None,
     attn_mask: Optional[jnp.ndarray] = None,
     compute_logits: bool = True,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> Tuple[Optional[jnp.ndarray], Optional[KVCache]]:
     """Run the transformer.
 
@@ -329,6 +347,10 @@ def forward(
       compute_logits: False skips final-norm + lm_head and returns
         (None, cache) — for cache-building forwards (e.g. non-final
         prefill chunks) whose [B, T, V] fp32 logits would be thrown away.
+      dropout_rng: optional PRNG key enabling dropout (training only —
+        requires cache=None) at the config's embd/resid/attn_pdrop rates
+        (reference capability: config.py:85-87, model.py:166-168,296-299).
+        None, or all rates zero, means fully deterministic.
     Returns:
       (logits [B, T, V] in config.logits_dtype, updated cache or None);
       logits is None when compute_logits=False.
@@ -349,6 +371,16 @@ def forward(
                 "mesh with seq > 1; use a seq=1 mesh for generation or "
                 "the cache-free forward for sequence-parallel scoring"
             )
+    if dropout_rng is not None and not (
+        config.embd_pdrop > 0.0 or config.resid_pdrop > 0.0
+        or config.attn_pdrop > 0.0
+    ):
+        dropout_rng = None  # all rates zero: identical trace either way
+    if dropout_rng is not None and cache is not None:
+        raise ValueError(
+            "dropout_rng is training-only; cached decode is deterministic "
+            "(pass dropout_rng=None)"
+        )
     if attn_mask is None:
         attn_mask = positions >= 0
     q_positions = jnp.maximum(positions, 0)
@@ -367,6 +399,16 @@ def forward(
     x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(adt)
     x = constrain(x, "data", "seq", None)
 
+    layers_rng = None
+    if dropout_rng is not None:
+        emb_rng, rest_rng = jax.random.split(dropout_rng)
+        if config.embd_pdrop > 0.0:
+            x = _dropout(emb_rng, x, config.embd_pdrop)
+        if config.resid_pdrop > 0.0 or config.attn_pdrop > 0.0:
+            # Embedding-only dropout needs no per-layer rng threading (and
+            # therefore composes with every layer-stack execution path).
+            layers_rng = rest_rng
+
     if config.attn_impl not in ("xla", "flash", "ring", "auto"):
         raise NotImplementedError(f"attn_impl={config.attn_impl!r}")
     # "auto": Pallas flash for prefill/long blocks (no dense [B,1,T,S] bias,
@@ -374,12 +416,19 @@ def forward(
     # where flash's one-row grid and in-scan cache writes lose.
     impl = config.attn_impl
     if impl == "auto":
-        # int8 caches and per-row indices are only supported on the xla
-        # path, so "auto" resolves there regardless of T in those cases.
-        must_xla = cache is not None and (
-            cache.quantized or cache.per_row_index
-        )
+        # int8 caches, per-row indices, and attention-probability dropout
+        # are only supported on the xla path, so "auto" resolves there
+        # regardless of T in those cases.
+        must_xla = (
+            cache is not None and (cache.quantized or cache.per_row_index)
+        ) or (dropout_rng is not None and config.attn_pdrop > 0.0)
         impl = "flash" if T > 8 and not must_xla else "xla"
+    if dropout_rng is not None and config.attn_pdrop > 0.0 and impl != "xla":
+        raise NotImplementedError(
+            "attn_pdrop requires the xla attention path (the flash/ring "
+            "kernels do not implement probability dropout); use "
+            "attn_impl='xla'/'auto' for dropout training or attn_pdrop=0"
+        )
     if cache is not None and cache.quantized and impl != "xla":
         raise NotImplementedError(
             "int8 KV cache requires the xla attention path (the Pallas "
@@ -458,6 +507,13 @@ def forward(
         # per-stage); generation meshes keep stage == 1.
         from ..parallel.pipeline import pipeline_blocks
 
+        if layers_rng is not None:
+            raise NotImplementedError(
+                "dropout does not compose with stage > 1 pipeline meshes "
+                "(per-layer rng threading through microbatched stages is "
+                "not implemented); train with stage == 1 or pdrop = 0"
+            )
+
         if _mesh.shape.get("seq", 1) > 1:
             raise NotImplementedError(
                 "stage > 1 does not compose with seq > 1 (ring attention "
@@ -515,6 +571,19 @@ def forward(
                 return y, (ck, cv)
 
             x, (new_k, new_v) = lax.scan(scan_fn, x, (lp, cache.k, cache.v))
+        elif layers_rng is not None:
+            # Per-layer dropout keys ride the scan as xs alongside the
+            # stacked weights.
+            layer_rngs = jax.random.split(layers_rng, config.n_layers)
+
+            def scan_fn(carry, xs):
+                layer_params, rng_i = xs
+                y, _, _ = block(
+                    carry, layer_params, None, None, None, None, rng_i
+                )
+                return y, None
+
+            x, _ = lax.scan(scan_fn, x, (lp, layer_rngs))
         else:
             def scan_fn(carry, layer_params):
                 y, _, _ = block(carry, layer_params, None, None)
@@ -522,6 +591,10 @@ def forward(
 
             x, _ = lax.scan(scan_fn, x, lp)
     elif pp_stages <= 1:
+        unroll_rngs = (
+            jax.random.split(layers_rng, config.n_layers)
+            if layers_rng is not None else None
+        )
         new_ks, new_vs = [], []
         for i in range(config.n_layers):
             layer_params = jax.tree.map(lambda a: a[i], lp)
@@ -529,7 +602,10 @@ def forward(
             cv = cache.v[i] if cache is not None else None
             cks = cache.k_scale[i] if cache is not None and cache.quantized else None
             cvs = cache.v_scale[i] if cache is not None and cache.quantized else None
-            x, ck, cv = block(x, layer_params, ck, cv, cks, cvs)
+            x, ck, cv = block(
+                x, layer_params, ck, cv, cks, cvs,
+                unroll_rngs[i] if unroll_rngs is not None else None,
+            )
             new_ks.append(ck)
             new_vs.append(cv)
         if cache is not None:

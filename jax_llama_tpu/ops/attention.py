@@ -24,6 +24,13 @@ import jax.numpy as jnp
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
+def dropout(rng: jax.Array, x: jnp.ndarray, rate: float) -> jnp.ndarray:
+    """Inverted dropout (expectation-preserving), shared by the attention
+    probabilities path and the model's embedding/residual sites."""
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
 def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     """Broadcast KV heads to match query heads for GQA.
 
@@ -136,6 +143,8 @@ def sdpa(
     v: jnp.ndarray,
     bias: Optional[jnp.ndarray] = None,
     softmax_dtype: jnp.dtype = jnp.float32,
+    dropout_rng: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
 ) -> jnp.ndarray:
     """Scaled dot-product attention with GQA.
 
@@ -143,6 +152,9 @@ def sdpa(
       q: [B, T, H, D].
       k, v: [B, S, KVH, D] with H % KVH == 0.
       bias: optional [B, 1, T, S] additive bias (fp32).
+      dropout_rng, dropout_rate: attention-probability dropout (training
+        only; parity with the reference's attn_pdrop, model.py:276-288).
+        Inverted scaling keeps the expectation unchanged.
     Returns:
       [B, T, H, D] in q.dtype.
     """
@@ -165,6 +177,8 @@ def sdpa(
         scores = scores + bias[:, :, None]  # [B,1,T,S] -> [B,1,1,T,S]
     scores = scores.astype(softmax_dtype)
     weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_rng is not None and dropout_rate > 0.0:
+        weights = dropout(dropout_rng, weights, dropout_rate)
     out = jnp.einsum(
         "bkgts,bskd->btkgd", weights, v, preferred_element_type=jnp.float32
     )

@@ -61,6 +61,10 @@ def pipeline_blocks(
       n_microbatches: M; must divide B.
     Returns:
       [B, T, D] block-stack output.
+
+    Call under ``jax.jit`` (as every engine/train entry point does): in
+    eager mode the shard_map's auto-axes/out_specs interaction trips a
+    strictness check even though the jitted program is valid.
     """
     S = mesh.shape[axis_name]
     M = n_microbatches

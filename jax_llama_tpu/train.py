@@ -75,6 +75,7 @@ def lm_loss(
     tokens: jnp.ndarray,
     config: LLaMAConfig,
     loss_mask: Optional[jnp.ndarray] = None,
+    dropout_rng: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Masked next-token cross-entropy.
 
@@ -91,7 +92,9 @@ def lm_loss(
     # Forward over the full T (not T-1): sequence-parallel meshes need the
     # model-visible length to stay divisible by the seq axis; the final
     # position's logits are simply dropped from the loss.
-    logits, _ = forward(params, tokens, positions, config)
+    logits, _ = forward(
+        params, tokens, positions, config, dropout_rng=dropout_rng
+    )
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[:, :, None], axis=-1)[..., 0]
     if loss_mask is not None:
@@ -114,6 +117,7 @@ def train_step(
     optimizer: optax.GradientTransformation,
     loss_mask: Optional[jnp.ndarray] = None,
     mesh=None,
+    dropout_rng: Optional[jnp.ndarray] = None,
 ) -> Tuple[TrainState, jnp.ndarray]:
     """One optimizer step.  `optimizer` must be a hashable static (module-
     level) GradientTransformation; under a mesh the donated state keeps
@@ -136,8 +140,14 @@ def train_step(
             "the compiled executable on later calls"
         )
     with use_mesh(mesh):
+        # One base key serves the whole run: folding in the step count
+        # gives every step fresh masks without the caller re-splitting.
+        step_rng = (
+            jax.random.fold_in(dropout_rng, state.step)
+            if dropout_rng is not None else None
+        )
         loss, grads = jax.value_and_grad(lm_loss)(
-            state.params, tokens, config, loss_mask
+            state.params, tokens, config, loss_mask, step_rng
         )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
