@@ -51,12 +51,14 @@ def _flash_kernel(
     k_ref,  # [1, 1, bk, d]
     v_ref,  # [1, 1, bk, d]
     o_ref,  # [1, 1, bq, d]
-    m_ref,  # [bq, LANES] f32 scratch — running row max
-    l_ref,  # [bq, LANES] f32 scratch — running row denominator
-    acc_ref,  # [bq, d] f32 scratch — running weighted-V accumulator
-    *,
+    *rest,  # (lse_ref,) when with_lse, then m/l/acc scratch
     scale: float,
+    with_lse: bool,
 ):
+    if with_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        (m_ref, l_ref, acc_ref), lse_ref = rest, None
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -115,6 +117,13 @@ def _flash_kernel(
         o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
             o_ref.dtype
         )
+        if with_lse:
+            # Row logsumexp of the (scaled, masked) scores — the backward
+            # kernels rebuild P = exp(s - lse) from it without storing
+            # any S×S tensor.  Lane-replicated like m/l (tiling rules).
+            lse_ref[0, 0] = m_ref[:] + jnp.log(
+                jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+            )
 
 
 def _pad_to(x: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
@@ -142,11 +151,11 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Blockwise attention; drop-in for ``ops.attention.sdpa`` + bias.
 
-    Differentiable: the forward runs the Pallas kernel; the backward
-    recomputes attention densely and differentiates that (O(T·S) scores in
-    the backward only — fine at training context lengths; sequence-parallel
-    ring attention is the long-context training path, and a Pallas backward
-    kernel can replace this without API change).
+    Differentiable end-to-end in O(S·d) memory: the forward kernel saves
+    the per-row logsumexp, and the backward runs two Pallas kernels
+    (dQ sweep and dK/dV sweep) that rebuild probabilities tile-by-tile —
+    no [T, S] score matrix exists in either direction, so 32k+ training
+    contexts fit.
 
     Args:
       q: [B, T, H, d].
@@ -189,22 +198,17 @@ def _flash(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
-    out = _flash_forward(
-        q, k, v, q_pos, kv_pos, block_q, block_k, interpret
+    out, lse = _flash_forward(
+        q, k, v, q_pos, kv_pos, block_q, block_k, interpret, need_lse=True
     )
-    return out, (q, k, v, q_pos, kv_pos)
+    return out, (q, k, v, q_pos, kv_pos, out, lse)
 
 
 def _flash_bwd(block_q, block_k, interpret, res, g):
-    from .attention import attention_bias, sdpa
-
-    q, k, v, q_pos, kv_pos = res
-
-    def dense(q, k, v):
-        return sdpa(q, k, v, attention_bias(q_pos, kv_pos, kv_pos >= 0))
-
-    _, vjp = jax.vjp(dense, q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, q_pos, kv_pos, out, lse = res
+    dq, dk, dv = _flash_backward(
+        q, k, v, q_pos, kv_pos, out, lse, g, block_q, block_k, interpret
+    )
     # Integer primals take float0 cotangents.
     zq = np.zeros(q_pos.shape, jax.dtypes.float0)
     zk = np.zeros(kv_pos.shape, jax.dtypes.float0)
@@ -214,17 +218,15 @@ def _flash_bwd(block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _flash_forward(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
-    B, T, H, d = q.shape
-    S, KVH = k.shape[1], k.shape[2]
-    assert H % KVH == 0, (H, KVH)
-    group = H // KVH
-    scale = 1.0 / (d ** 0.5)
+def _resolve_interpret(interpret):
     if interpret is None:
         # Mosaic only targets TPU; everywhere else (CPU test meshes) run the
         # kernel interpreted.  default_backend() is concrete at trace time.
         interpret = jax.default_backend() != "tpu"
+    return interpret
 
+
+def _clamp_blocks(T, S, block_q, block_k, interpret):
     block_q = min(block_q, T)
     block_k = min(block_k, S)
     if not interpret:
@@ -237,6 +239,19 @@ def _flash_forward(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
         if block_k < S:
             block_k = -(-block_k // _LANES) * _LANES
         block_q, block_k = min(block_q, T), min(block_k, S)
+    return block_q, block_k
+
+
+def _flash_forward(
+    q, k, v, q_pos, kv_pos, block_q, block_k, interpret, need_lse=False
+):
+    B, T, H, d = q.shape
+    S, KVH = k.shape[1], k.shape[2]
+    assert H % KVH == 0, (H, KVH)
+    group = H // KVH
+    scale = 1.0 / (d ** 0.5)
+    interpret = _resolve_interpret(interpret)
+    block_q, block_k = _clamp_blocks(T, S, block_q, block_k, interpret)
 
     # Pad sequence axes up to tile multiples OUTSIDE the kernel: Pallas
     # out-of-bounds tile reads are undefined, so padded kv slots must carry
@@ -253,8 +268,24 @@ def _flash_forward(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
     kv_pos_r = jnp.broadcast_to(kv_pos_p[:, None, :], (B, _SUBLANES, Sp))
 
     grid = (B, H, nq, nk)
+    out_shape = jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype)
+    out_spec = pl.BlockSpec(
+        (1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)
+    )
+    if need_lse:
+        # Lane-replicated row logsumexp for the backward kernels.
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((B, H, Tp, _LANES), jnp.float32),
+        )
+        out_spec = (
+            out_spec,
+            pl.BlockSpec(
+                (1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)
+            ),
+        )
     out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale),
+        functools.partial(_flash_kernel, scale=scale, with_lse=need_lse),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -275,10 +306,8 @@ def _flash_forward(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
                 lambda b, h, qi, ki: (b, h // group, ki, 0),
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype),
+        out_specs=out_spec,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -293,4 +322,230 @@ def _flash_forward(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
         ),
         interpret=interpret,
     )(q_pos_r, kv_pos_r, qt, kt, vt)
+    if need_lse:
+        out, lse = out
+        return jnp.swapaxes(out[:, :, :T, :], 1, 2), lse
     return jnp.swapaxes(out[:, :, :T, :], 1, 2)  # [B, T, H, d]
+
+
+# ---------------------------------------------------------------------------
+# Backward: blockwise dQ / dK / dV with recomputed probabilities.
+#
+# Standard flash-attention backward split into two kernels so each output
+# has a clean accumulation sweep (never an S×S tensor in memory):
+#   * dQ kernel: grid (B, H, nq, nk) — for each q block, sweep kv blocks,
+#     accumulating dQ_i += scale · dS_ij · K_j.
+#   * dK/dV kernel: grid (B, H, nk, nq) — for each kv block, sweep q
+#     blocks, accumulating dV_j += P_ijᵀ · dO_i and
+#     dK_j += scale · dS_ijᵀ · Q_i.
+# with P = exp(S − lse) rebuilt per tile from the forward's saved row
+# logsumexp, dP = dO · Vᵀ, D = rowsum(dO ∘ O), dS = P ∘ (dP − D).
+#
+# GQA needs no extra handling: the public wrapper packs the `group` query
+# heads of each KV head into the row axis before the custom_vjp boundary,
+# so these kernels always see H == KVH and the sum over a KV head's query
+# group happens naturally in the q-row sweep of the dK/dV kernel.
+# ---------------------------------------------------------------------------
+
+
+def _flash_dq_kernel(
+    q_pos_ref, kv_pos_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+    dq_ref, dq_acc, *, scale: float,
+):
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    qp = q_pos_ref[0, :, :1]  # [bq, 1]
+    kp = kv_pos_ref[0, :1, :]  # [1, bk]
+    live_kp = jnp.where(kp >= 0, kp, jnp.iinfo(jnp.int32).max)
+    block_live = jnp.min(live_kp) <= jnp.max(qp)
+
+    @pl.when(block_live)
+    def _compute():
+        qb, kb, vb, gb = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], g_ref[0, 0]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        allowed = (kp <= qp) & (kp >= 0)
+        p = jnp.where(allowed, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
+        dp = jax.lax.dot_general(
+            gb, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    q_pos_ref, kv_pos_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+):
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    qp = q_pos_ref[0, :, :1]  # [bq, 1]
+    kp = kv_pos_ref[0, :1, :]  # [1, bk]
+    live_kp = jnp.where(kp >= 0, kp, jnp.iinfo(jnp.int32).max)
+    block_live = jnp.min(live_kp) <= jnp.max(qp)
+
+    @pl.when(block_live)
+    def _compute():
+        qb, kb, vb, gb = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], g_ref[0, 0]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        allowed = (kp <= qp) & (kp >= 0)
+        p = jnp.where(allowed, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
+        # dV_j += P_ijᵀ dO_i: contract the q-row axis.
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(gb.dtype), gb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            gb, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, q_pos, kv_pos, out, lse, g, block_q, block_k, interpret
+):
+    """Blockwise VJP.  Memory is O(S·d) per head (plus the lane-replicated
+    lse/Δ rows) — replacing the r1 dense-recompute fallback whose backward
+    materialized the full [B, H, T, S] score matrix."""
+    B, T, H, d = q.shape
+    S = k.shape[1]
+    assert k.shape[2] == H, "custom_vjp operates on GQA-packed operands"
+    scale = 1.0 / (d ** 0.5)
+    interpret = _resolve_interpret(interpret)
+    block_q, block_k = _clamp_blocks(T, S, block_q, block_k, interpret)
+
+    # Δ = rowsum(dO ∘ O): tiny elementwise pass outside the kernels.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B, T, H]
+
+    qt = _pad_to(jnp.swapaxes(q, 1, 2), 2, block_q)  # [B, H, Tp, d]
+    kt = _pad_to(jnp.swapaxes(k, 1, 2), 2, block_k)  # [B, H, Sp, d]
+    vt = _pad_to(jnp.swapaxes(v, 1, 2), 2, block_k)
+    gt = _pad_to(jnp.swapaxes(g, 1, 2), 2, block_q)  # dO; pad rows are 0 so
+    #   padded-q contributions to every gradient vanish (Δ is 0 there too).
+    q_pos_p = _pad_to(q_pos.astype(jnp.int32), 1, block_q)
+    kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), 1, block_k, value=-1)
+    Tp, Sp = qt.shape[2], kt.shape[2]
+    nq, nk = Tp // block_q, Sp // block_k
+    q_pos_r = jnp.broadcast_to(q_pos_p[:, :, None], (B, Tp, _LANES))
+    kv_pos_r = jnp.broadcast_to(kv_pos_p[:, None, :], (B, _SUBLANES, Sp))
+    delta_r = jnp.broadcast_to(
+        _pad_to(jnp.moveaxis(delta, 2, 1), 2, block_q)[..., None],
+        (B, H, Tp, _LANES),
+    )
+    # lse comes from the forward already padded/replicated [B, H, Tp, LANES].
+
+    pos_specs = [
+        pl.BlockSpec((1, block_q, _LANES), lambda b, h, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, _SUBLANES, block_k), lambda b, h, qi, ki: (b, 0, ki)),
+    ]
+    q_row_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
+    ]
+    kv_specs = [
+        pl.BlockSpec((1, 1, block_k, d), lambda b, h, qi, ki: (b, h, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d), lambda b, h, qi, ki: (b, h, ki, 0)),
+    ]
+    row_aux_specs = [
+        pl.BlockSpec(
+            (1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)
+        ),
+    ]
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale),
+        grid=(B, H, nq, nk),
+        in_specs=pos_specs + q_row_specs + kv_specs + q_row_specs
+        + row_aux_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_pos_r, kv_pos_r, qt, kt, vt, gt, lse, delta_r)
+
+    # dK/dV kernel: kv blocks third, q sweep innermost.
+    def qrow(b, h, ki, qi):
+        return (b, h, qi, 0)
+
+    def kvrow(b, h, ki, qi):
+        return (b, h, ki, 0)
+
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, _LANES), lambda b, h, ki, qi: (b, qi, 0)),
+        pl.BlockSpec((1, _SUBLANES, block_k), lambda b, h, ki, qi: (b, 0, ki)),
+        pl.BlockSpec((1, 1, block_q, d), qrow),
+        pl.BlockSpec((1, 1, block_k, d), kvrow),
+        pl.BlockSpec((1, 1, block_k, d), kvrow),
+        pl.BlockSpec((1, 1, block_q, d), qrow),
+        pl.BlockSpec((1, 1, block_q, _LANES), qrow),
+        pl.BlockSpec((1, 1, block_q, _LANES), qrow),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale),
+        grid=(B, H, nk, nq),
+        in_specs=dkv_specs,
+        out_specs=(
+            pl.BlockSpec((1, 1, block_k, d), kvrow),
+            pl.BlockSpec((1, 1, block_k, d), kvrow),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, H, Sp, d), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Sp, d), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_pos_r, kv_pos_r, qt, kt, vt, gt, lse, delta_r)
+
+    dq = jnp.swapaxes(dq[:, :, :T, :], 1, 2)
+    dk = jnp.swapaxes(dk[:, :, :S, :], 1, 2)
+    dv = jnp.swapaxes(dv[:, :, :S, :], 1, 2)
+    return dq, dk, dv

@@ -161,3 +161,111 @@ def test_flash_gradients_match_xla():
         ),
         g1, g0,
     )
+
+
+# ---------------------------------------------------------------------------
+# Blockwise backward kernels (dQ / dK / dV with recomputed probabilities)
+# ---------------------------------------------------------------------------
+
+def _vjps(q, k, v, q_pos, kv_pos, g, bq, bk):
+    import jax
+
+    q, k, v, g = map(jnp.asarray, (q, k, v, g))
+    q_pos, kv_pos = jnp.asarray(q_pos), jnp.asarray(kv_pos)
+
+    def flash_fn(q, k, v):
+        return flash_attention(q, k, v, q_pos, kv_pos, block_q=bq, block_k=bk)
+
+    def dense_fn(q, k, v):
+        return sdpa(q, k, v, attention_bias(q_pos, kv_pos, kv_pos >= 0))
+
+    _, fvjp = jax.vjp(flash_fn, q, k, v)
+    _, dvjp = jax.vjp(dense_fn, q, k, v)
+    return fvjp(g), dvjp(g)
+
+
+def test_flash_backward_matches_dense_gqa_and_padding():
+    B, T, H, KVH, D = 2, 24, 4, 2, 16
+    q, k, v = _rand(B, T, T, H, KVH, D)
+    # Realistic left-pad geometry (engine.prompt_positions): padded slots
+    # carry -1 and real positions restart at 0.  (Fully-masked rows are
+    # out of scope: their forward output is unspecified garbage on both
+    # paths, so their cotangents are too.)
+    pos = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+    pos[1, :5] = -1
+    pos[1, 5:] = np.arange(T - 5)
+    qp = np.maximum(pos, 0)
+    g = np.random.randn(B, T, H, D).astype(np.float32)
+    g[1, :5] = 0.0  # pad rows are masked downstream; no cotangent flows
+    (fdq, fdk, fdv), (ddq, ddk, ddv) = _vjps(q, k, v, qp, pos, g, 8, 8)
+    np.testing.assert_allclose(np.asarray(fdq), np.asarray(ddq), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(fdk), np.asarray(ddk), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(fdv), np.asarray(ddv), atol=1e-4, rtol=1e-4)
+
+
+def test_flash_backward_matches_dense_8k():
+    """Long-context gradient parity at the production block sizes
+    (VERDICT r1 item 4).  Small head count keeps the dense oracle's S^2
+    buffers manageable in interpret mode."""
+    B, S, H, D = 1, 8192, 1, 64
+    q, k, v = _rand(B, S, S, H, H, D)
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    g = np.random.randn(B, S, H, D).astype(np.float32)
+    (fdq, fdk, fdv), (ddq, ddk, ddv) = _vjps(q, k, v, pos, pos, g, 512, 2048)
+    for f, dref, name in ((fdq, ddq, "dq"), (fdk, ddk, "dk"), (fdv, ddv, "dv")):
+        f, dref = np.asarray(f), np.asarray(dref)
+        denom = np.abs(dref).max()
+        assert np.abs(f - dref).max() / denom < 1e-4, name
+
+
+def test_flash_backward_fdiff_16k():
+    """At 16k a dense oracle no longer fits; check the analytic gradient
+    against a central finite difference along a random direction."""
+    import jax
+
+    B, S, H, D = 1, 16384, 1, 32
+    rng = np.random.RandomState(0)
+    q = rng.randn(B, S, H, D).astype(np.float32) * 0.1
+    k = rng.randn(B, S, H, D).astype(np.float32) * 0.1
+    v = rng.randn(B, S, H, D).astype(np.float32) * 0.1
+    pos = jnp.asarray(np.tile(np.arange(S, dtype=np.int32), (B, 1)))
+    w = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+
+    def loss(k):
+        o = flash_attention(jnp.asarray(q), k, jnp.asarray(v), pos, pos)
+        return jnp.vdot(o, w)
+
+    gk = jax.grad(loss)(jnp.asarray(k))
+    u = rng.randn(*k.shape).astype(np.float32)
+    u /= np.linalg.norm(u)
+    eps = 1e-2
+    lo = float(loss(jnp.asarray(k - eps * u)))
+    hi = float(loss(jnp.asarray(k + eps * u)))
+    fdiff = (hi - lo) / (2 * eps)
+    analytic = float(jnp.vdot(gk, jnp.asarray(u)))
+    np.testing.assert_allclose(analytic, fdiff, rtol=2e-2, atol=1e-3)
+
+
+def test_flash_backward_no_quadratic_memory_32k():
+    """The whole point of the kernel: no S x S intermediate anywhere in the
+    VJP jaxpr at 32k (the r1 dense fallback materialized [B, H, T, S])."""
+    import jax
+
+    B, S, H, D = 1, 32768, 1, 64
+
+    def loss(q, k, v):
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        return flash_attention(q, k, v, pos, pos).sum()
+
+    sds = jax.ShapeDtypeStruct((B, S, H, D), jnp.float32)
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(sds, sds, sds)
+
+    limit = S * 1024  # O(S*d) with the lane-replicated lse/delta rows
+    def walk(jpr):
+        for eqn in jpr.eqns:
+            for var in eqn.outvars:
+                size = int(np.prod(var.aval.shape)) if var.aval.shape else 1
+                assert size <= limit, (eqn.primitive.name, var.aval.shape)
+            for sub in jax.core.jaxprs_in_params(eqn.params):
+                walk(sub)
+    walk(jaxpr.jaxpr)
