@@ -18,6 +18,7 @@ from .config import LLaMAConfig, get_config, swiglu_hidden_size
 from .engine import GenerationConfig, generate, score
 from .generation import LLaMA
 from .serving import ContinuousBatcher
+from .server import LLMServer
 from .spec_decode import generate_speculative
 from .models import KVCache, forward, init_cache, init_params, param_count
 from .ops.quant import QuantizedTensor, quantize_params
@@ -35,6 +36,7 @@ __all__ = [
     "score",
     "generate_speculative",
     "ContinuousBatcher",
+    "LLMServer",
     "LLaMA",
     "ByteTokenizer",
     "KVCache",

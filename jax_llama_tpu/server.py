@@ -1,0 +1,263 @@
+"""Minimal HTTP serving front-end over ``ContinuousBatcher``.
+
+The reference has no server at all (its only entry point is a batch CLI,
+reference ``jax_example.py:33-40``); this is framework surface beyond
+parity.  Design constraints, in order:
+
+  * **One device thread.**  The batcher (and JAX dispatch) is driven by a
+    single serving loop thread; HTTP handler threads only enqueue work
+    and wait.  This keeps the jitted step/insert programs free of locking
+    and the device queue deep (the loop calls ``step()`` back-to-back
+    while any slot is active).
+  * **Stdlib only.**  ``http.server.ThreadingHTTPServer`` + ``json`` — no
+    web framework to vendor or pin.
+  * **Observability.**  ``GET /metrics`` exposes the batcher counters
+    (tokens, steps, slot/block occupancy, speculative acceptance) in
+    Prometheus text format; ``GET /healthz`` for liveness.
+
+Endpoints:
+  POST /generate   {"prompt": [ids]} or {"text": "..."} (needs tokenizer),
+                   optional max_new_tokens / temperature / top_p / top_k /
+                   seed / stop_tokens.  Blocks until the request finishes;
+                   returns {"request_id", "tokens", "text"?}.
+  GET  /metrics    Prometheus text exposition of ``ContinuousBatcher.stats()``.
+  GET  /healthz    {"ok": true}
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from .serving import ContinuousBatcher
+
+
+@dataclass
+class _Pending:
+    payload: Dict[str, Any]
+    done: threading.Event = field(default_factory=threading.Event)
+    tokens: List[int] = field(default_factory=list)
+    error: Optional[str] = None
+    error_code: int = 400  # 400 = rejected payload, 503 = server-side
+    request_id: Optional[int] = None
+
+    def fail(self, message: str, code: int) -> None:
+        self.error = message
+        self.error_code = code
+        self.done.set()
+
+
+class LLMServer:
+    """HTTP wrapper: handler threads enqueue; one loop thread owns the
+    batcher and the device."""
+
+    def __init__(
+        self,
+        batcher: ContinuousBatcher,
+        tokenizer: Any = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_queue: int = 256,
+    ):
+        self.batcher = batcher
+        self.tokenizer = tokenizer
+        self.max_queue = max_queue
+        self._inbox: "queue.Queue[_Pending]" = queue.Queue()
+        self._active: Dict[int, _Pending] = {}
+        self._stop = threading.Event()
+        self._closed = threading.Event()  # set once the loop has drained
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="llm-serving-loop", daemon=True
+        )
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet test output
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, code: int, obj: Dict[str, Any]):
+                self._reply(
+                    code, json.dumps(obj).encode(), "application/json"
+                )
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply_json(200, {"ok": True})
+                elif self.path == "/metrics":
+                    self._reply(
+                        200, server._metrics_text().encode(),
+                        "text/plain; version=0.0.4",
+                    )
+                else:
+                    self._reply_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._reply_json(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._reply_json(400, {"error": f"bad request: {e}"})
+                    return
+                if server._closed.is_set():
+                    self._reply_json(503, {"error": "server shutting down"})
+                    return
+                # Admission bound: each blocked POST holds an OS thread for
+                # the full generation, so an unbounded inbox is an
+                # unbounded thread/memory leak under flood.
+                depth = server._inbox.qsize() + len(server._active)
+                if depth >= server.max_queue:
+                    self._reply_json(
+                        503, {"error": "server overloaded; retry later"}
+                    )
+                    return
+                pending = _Pending(payload=payload)
+                server._inbox.put(pending)
+                # Poll _closed so a request enqueued just as the loop dies
+                # (put racing the final drain) still unblocks.
+                while not pending.done.wait(timeout=1.0):
+                    if server._closed.is_set() and not pending.done.is_set():
+                        pending.fail("server shutting down", 503)
+                        break
+                if pending.error is not None:
+                    self._reply_json(
+                        pending.error_code, {"error": pending.error}
+                    )
+                    return
+                out: Dict[str, Any] = {
+                    "request_id": pending.request_id,
+                    "tokens": pending.tokens,
+                }
+                if server.tokenizer is not None:
+                    out["text"] = server.tokenizer.decode(pending.tokens)
+                self._reply_json(200, out)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="llm-http", daemon=True
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "LLMServer":
+        self._loop_thread.start()
+        self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._loop_thread.join(timeout=30)
+
+    def __enter__(self) -> "LLMServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- serving loop (sole owner of the batcher) ---------------------------
+
+    def _submit(self, p: _Pending) -> None:
+        payload = p.payload
+        if "prompt" in payload:
+            tokens = [int(t) for t in payload["prompt"]]
+        elif "text" in payload:
+            if self.tokenizer is None:
+                raise ValueError(
+                    '"text" prompts need a server-side tokenizer; send '
+                    'token ids as "prompt"'
+                )
+            tokens = self.tokenizer.encode(
+                payload["text"], bos=True, eos=False
+            )
+        else:
+            raise ValueError('missing "prompt" (token ids) or "text"')
+        kwargs: Dict[str, Any] = {}
+        for k in ("max_new_tokens", "top_k", "seed"):
+            if payload.get(k) is not None:
+                kwargs[k] = int(payload[k])
+        for k in ("temperature", "top_p"):
+            if payload.get(k) is not None:
+                kwargs[k] = float(payload[k])
+        if payload.get("stop_tokens") is not None:
+            kwargs["stop_tokens"] = tuple(
+                int(t) for t in payload["stop_tokens"]
+            )
+        rid = self.batcher.submit(tokens, **kwargs)
+        p.request_id = rid
+        self._active[rid] = p
+
+    def _loop(self) -> None:
+        # The finally-drain guarantees no client blocks forever: whether
+        # the loop exits via stop() or an unexpected device/runtime error,
+        # every in-flight and queued request gets its done event set.
+        reason, code = "server shutting down", 503
+        try:
+            while not self._stop.is_set():
+                # Admit whatever is waiting; block briefly when fully idle
+                # so shutdown and new work are both responsive.
+                try:
+                    block = not self.batcher.pending()
+                    while True:
+                        p = self._inbox.get(block=block, timeout=0.05)
+                        block = False
+                        try:
+                            self._submit(p)
+                        except (ValueError, TypeError, KeyError) as e:
+                            # Malformed payloads must never kill the
+                            # device-owning thread.
+                            p.fail(str(e), 400)
+                except queue.Empty:
+                    pass
+                if not self.batcher.pending():
+                    continue
+                for rid, tok, done in self.batcher.step():
+                    p = self._active.get(rid)
+                    if p is None:
+                        continue
+                    p.tokens.append(tok)
+                    if done:
+                        del self._active[rid]
+                        p.done.set()
+        except Exception as e:  # device/runtime failure: fail loudly
+            reason = f"serving loop crashed: {e!r}"
+            raise
+        finally:
+            self._closed.set()
+            for p in list(self._active.values()):
+                p.fail(reason, code)
+            self._active.clear()
+            while not self._inbox.empty():
+                p = self._inbox.get_nowait()
+                p.fail(reason, code)
+
+    # -- metrics ------------------------------------------------------------
+
+    def _metrics_text(self) -> str:
+        lines = []
+        for k, v in self.batcher.stats().items():
+            name = f"llm_{k}"
+            kind = "gauge" if "total" not in k else "counter"
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {v}")
+        return "\n".join(lines) + "\n"

@@ -1,4 +1,4 @@
-"""Continuous batching: slot-based serving over per-row cache offsets.
+"""Continuous batching over a paged (block-table) KV cache.
 
 Beyond the reference's capability surface (its only serving mode is one
 batch of same-length prompts through `LLaMA.generate`, reference
@@ -9,85 +9,304 @@ TPU never idles waiting for the longest generation in a batch.
 TPU-native mechanics:
   * **Static shapes everywhere.**  The pool is ``n_slots`` rows; every
     decode step is one jitted [B=n_slots, T=1] forward.  Admission runs a
-    B=1 prefill whose length is bucketed to powers of two, so the jit
-    cache holds O(log max_prompt) prefill programs + 1 decode program.
-  * **Per-row cache offsets.**  Each slot writes its KV at its own
-    ``cache.index[b]`` (scatter, not dynamic-update-slice) and masking is
-    purely positional, so rows at different sequence lengths coexist in
-    one cache with no synchronization (models.llama KVCache.per_row_index).
-  * **Idle slots cost nothing semantically**: they decode garbage that is
-    positionally masked (pos -1) and their buffered tokens are never
-    surfaced; their cache writes drop once they hit capacity.
-
-Sampling policy (temperature/top-p/top-k) is pool-wide; per-request
-policies are future work.  Use `engine.generate` for classic lockstep
-batch generation and `spec_decode` for draft-accelerated decode.
+    B=1 prefill whose length is bucketed to block multiples, so the jit
+    cache holds O(max_len / block_size) prefill programs + 1 decode
+    program.
+  * **Paged KV.**  KV lives in a pool of fixed-size blocks
+    ([L, n_blocks, block_size, KVH, hd]); each slot holds a block table
+    (physical block ids in sequence order).  Admission *reserves* the
+    blocks a request can ever need (ceil((prompt_padded + max_new) /
+    block_size)); completion frees them.  The pool may be sized smaller
+    than n_slots × max_len (overcommit): requests whose reservation does
+    not fit wait in the queue, giving natural backpressure instead of the
+    per-slot contiguous regions + power-of-two bucketing this replaces.
+  * **Decode via a gathered view.**  Each step gathers the active block
+    tables into a per-row virtually-contiguous cache and runs the
+    model's per-row-offset forward unchanged; the one new KV entry per
+    row is scattered back to its physical block.  The gather costs one
+    extra KV read/write per step over a contiguous layout — acceptable
+    while decode is weights-bound; a Pallas paged-attention decode
+    kernel that walks the block table in-kernel is the planned
+    replacement.
+  * **Per-request sampling.**  temperature/top-p/top-k and the PRNG
+    chain are per-slot device arrays; each row samples with its own key
+    (same warp math as ``ops.sampling.sample``, dynamic per-row), so a
+    slot reproduces exactly what a standalone seeded ``engine.generate``
+    of its request would emit.
+  * **Idle slots cost nothing semantically**: their gathered positions
+    are -1 (masked), their sampled token is ignored by the host, and
+    their cache write-back is dropped (sentinel block id, scatter mode
+    "drop").
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from .config import LLaMAConfig
-from .engine import next_pow2, prompt_positions
+from .engine import prompt_positions
 from .models.llama import KVCache, forward, init_cache
-from .ops.sampling import sample
+from .ops.attention import NEG_INF
 from .parallel.mesh import use_mesh
 
 
+# ---------------------------------------------------------------------------
+# Paged KV pool
+# ---------------------------------------------------------------------------
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["k", "v", "pos", "k_scale", "v_scale"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class BlockPool:
+    """Paged KV storage shared by all slots.
+
+    k, v: [L, n_blocks, block_size, KVH, hd] (activation dtype or int8).
+    pos:  [n_blocks, block_size] int32 absolute position per cache slot;
+          -1 marks invalid (free block / unwritten / rolled back).
+    k_scale, v_scale: [L, n_blocks, block_size, KVH] fp32 (int8 pool only).
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    pos: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def init_pool(
+    config: LLaMAConfig, n_blocks: int, block_size: int
+) -> BlockPool:
+    config.validate()
+    int8_kv = config.kv_cache_dtype == "int8"
+    dtype = jnp.int8 if int8_kv else config.activation_dtype
+    shape = (
+        config.n_layers, n_blocks, block_size, config.kv_heads,
+        config.head_dim,
+    )
+    return BlockPool(
+        k=jnp.zeros(shape, dtype=dtype),
+        v=jnp.zeros(shape, dtype=dtype),
+        pos=jnp.full((n_blocks, block_size), -1, jnp.int32),
+        k_scale=jnp.zeros(shape[:-1], jnp.float32) if int8_kv else None,
+        v_scale=jnp.zeros(shape[:-1], jnp.float32) if int8_kv else None,
+    )
+
+
+def _gather_cache(
+    pool: BlockPool,
+    table: jnp.ndarray,     # [B, MB] int32 physical block ids (NB = invalid)
+    n_alloc: jnp.ndarray,   # [B] int32 allocated blocks per row
+    fill: jnp.ndarray,      # [B] int32 per-row write offset (tokens)
+) -> KVCache:
+    """Materialize the per-row virtually-contiguous cache view.
+
+    Out-of-range table entries (sentinel n_blocks) clip on gather; their
+    positions are forced to -1 via n_alloc so the garbage is never
+    attended.
+    """
+    L, NB, BLK, KVH, hd = pool.k.shape
+    B, MB = table.shape
+    # mode="clip": sentinel (out-of-range) table entries gather a real
+    # block's finite values — the default "fill" mode would inject NaN,
+    # which survives the additive -inf mask (NaN + -inf = NaN) and poisons
+    # the softmax.  Clipped garbage is masked via n_alloc below.
+    take = functools.partial(jnp.take, mode="clip")
+    kg = take(pool.k, table, axis=1).reshape(L, B, MB * BLK, KVH, hd)
+    vg = take(pool.v, table, axis=1).reshape(L, B, MB * BLK, KVH, hd)
+    posg = take(pool.pos, table, axis=0).reshape(B, MB * BLK)
+    valid = jnp.arange(MB, dtype=jnp.int32)[None, :] < n_alloc[:, None]
+    posg = jnp.where(jnp.repeat(valid, BLK, axis=1), posg, -1)
+    ks = vs = None
+    if pool.quantized:
+        ks = take(pool.k_scale, table, axis=1).reshape(L, B, MB * BLK, KVH)
+        vs = take(pool.v_scale, table, axis=1).reshape(L, B, MB * BLK, KVH)
+    return KVCache(k=kg, v=vg, pos=posg, index=fill, k_scale=ks, v_scale=vs)
+
+
+def _scatter_back(
+    pool: BlockPool,
+    view: KVCache,
+    table: jnp.ndarray,
+    fill: jnp.ndarray,
+    active: jnp.ndarray,
+    T: int,
+) -> BlockPool:
+    """Write the T new entries per row from the gathered view back into
+    their physical blocks.  Inactive rows and out-of-reservation columns
+    resolve to the sentinel block id and are dropped."""
+    NB, BLK = pool.pos.shape
+    B, MB = table.shape
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    cols = fill[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+    safe_cols = jnp.minimum(cols, MB * BLK - 1)
+    blk = jnp.take_along_axis(table, safe_cols // BLK, axis=1)      # [B, T]
+    blk = jnp.where(
+        active[:, None] & (cols < MB * BLK), blk, NB
+    )
+    off = safe_cols % BLK
+    nk = view.k[:, rows, safe_cols]        # [L, B, T, KVH, hd]
+    nv = view.v[:, rows, safe_cols]
+    npos = view.pos[rows, safe_cols]       # [B, T]
+    new = dataclasses.replace(
+        pool,
+        k=pool.k.at[:, blk, off].set(nk, mode="drop"),
+        v=pool.v.at[:, blk, off].set(nv, mode="drop"),
+        pos=pool.pos.at[blk, off].set(npos, mode="drop"),
+    )
+    if pool.quantized:
+        new = dataclasses.replace(
+            new,
+            k_scale=pool.k_scale.at[:, blk, off].set(
+                view.k_scale[:, rows, safe_cols], mode="drop"
+            ),
+            v_scale=pool.v_scale.at[:, blk, off].set(
+                view.v_scale[:, rows, safe_cols], mode="drop"
+            ),
+        )
+    return new
+
+
+# ---------------------------------------------------------------------------
+# Per-row sampling (dynamic policies)
+# ---------------------------------------------------------------------------
+
+def sample_rows(
+    keys: jnp.ndarray,         # [B, 2] uint32 PRNG keys (one per row)
+    logits: jnp.ndarray,       # [B, V]
+    temperature: jnp.ndarray,  # [B] fp32; 0 = greedy
+    top_p: jnp.ndarray,        # [B] fp32; 1.0 = off
+    top_k: jnp.ndarray,        # [B] int32; V (or 0) = off
+) -> jnp.ndarray:
+    """Per-row ``ops.sampling.sample`` with *traced* per-row policies.
+
+    Applies the identical warp math (scale, top-k threshold at the k-th
+    largest, nucleus threshold, categorical) row-wise so a row with
+    policy (t, p, k) and its own key chain draws bit-identically to
+    ``sample(key, row[None], t, p, k)``.
+    """
+    B, V = logits.shape
+    lg = logits.astype(jnp.float32)
+    greedy_tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = lg / t
+    # top-k: threshold at the k-th largest (k==V keeps everything, matching
+    # the static filter's no-op when top_k is None).
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
+    # top-p: same construction as ops.sampling.top_p_filter, p per-row.
+    sorted2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]
+    thr = jnp.min(
+        jnp.where(keep, sorted2, jnp.inf), axis=-1, keepdims=True
+    )
+    thr = jnp.minimum(thr, jnp.max(scaled, axis=-1, keepdims=True))
+    nucleus = jnp.where(top_p[:, None] < 1.0, thr, -jnp.inf)
+    scaled = jnp.where(scaled >= nucleus, scaled, NEG_INF)
+
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row)
+    )(keys, scaled).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+
+
+def _split_rows(keys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, 2] keys -> (carried [B, 2], subkeys [B, 2]) — the row-wise
+    mirror of ``rng, sub = jax.random.split(rng)``."""
+    out = jax.vmap(lambda key: jax.random.split(key))(keys)  # [B, 2, 2]
+    return out[:, 0], out[:, 1]
+
+
+# ---------------------------------------------------------------------------
+# Jitted step programs
+# ---------------------------------------------------------------------------
+
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "mesh", "temperature", "top_p", "top_k"),
-    donate_argnames=("cache",),
+    static_argnames=("config", "mesh", "all_greedy"),
+    donate_argnames=("pool",),
 )
-def _decode_step(params, cache, tau, pos, active, rng, *, config,
-                 temperature=0.0, top_p=None, top_k=None, mesh=None):
-    """One [n_slots, 1] decode step (greedy or pool-wide sampling policy).
+def _paged_decode_step(
+    params, pool, table, n_alloc, fill, tau, pos, active, keys,
+    temperature, top_p, top_k, *, config, all_greedy=False, mesh=None,
+):
+    """One [n_slots, 1] decode step over the paged pool.
 
     tau: [B] current token per slot; pos: [B] its absolute position;
-    active: [B] bool.  Inactive rows run masked (their writes carry pos -1
-    and their sampled token is ignored by the host).
+    active: [B] bool.  Inactive rows run masked (position -1, write-back
+    dropped, sampled token ignored by the host).
+
+    ``all_greedy`` is static: when every active slot is greedy the step
+    compiles to a pure argmax — no sorts/softmax/key-splits on the hot
+    path (the host flips to the sampling variant the moment a sampled
+    request is admitted; greedy rows' key chains are never consumed, so
+    skipping the split here is unobservable).
     """
     with use_mesh(mesh):
+        view = _gather_cache(pool, table, n_alloc, fill)
         positions = jnp.where(active, pos, -1)[:, None]
-        logits, cache = forward(
-            params, tau[:, None], positions, config, cache=cache,
+        logits, view = forward(
+            params, tau[:, None], positions, config, cache=view,
             attn_mask=active[:, None],
         )
-        nxt = sample(rng, logits[:, -1], temperature, top_p, top_k)
-        return nxt.astype(jnp.int32), cache
+        pool = _scatter_back(pool, view, table, fill, active, T=1)
+        if all_greedy:
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        else:
+            keys, subs = _split_rows(keys)
+            nxt = sample_rows(subs, logits[:, -1], temperature, top_p, top_k)
+        return nxt, keys, pool
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "mesh", "temperature", "top_p", "top_k",
-                     "prefill_chunk"),
-    donate_argnames=("cache",),
+    static_argnames=("config", "mesh", "prefill_chunk"),
+    donate_argnames=("pool",),
 )
-def _insert_row(params, cache, row, prompt_tokens, prompt_mask, rng, *,
-                config, temperature=0.0, top_p=None, top_k=None,
-                prefill_chunk=None, mesh=None):
-    """Prefill one request into slot ``row`` of the pool cache.
+def _paged_insert(
+    params, pool, block_ids, prompt_tokens, prompt_mask, key,
+    temperature, top_p, top_k, *,
+    config, prefill_chunk=None, mesh=None,
+):
+    """Prefill one request and land its KV in the reserved blocks.
 
-    prompt_tokens/prompt_mask: [1, P] left-padded (P bucketed by caller).
-    Runs a B=1 prefill against a fresh single-row cache of the pool's
-    capacity (optionally in fixed chunks, bounding activation memory for
-    long prompts), then splices the row back — slot state never leaks
-    between requests.  Returns (first sampled token, its position,
-    updated cache).
+    prompt_tokens/prompt_mask: [1, P] left-padded, P a block multiple.
+    block_ids: [P // block_size] physical blocks for the prompt span.
+    Runs a B=1 prefill into a fresh contiguous P-token cache (optionally
+    in fixed chunks, bounding activation memory for long prompts), then
+    scatters the cache — reshaped to blocks — into the pool.  Returns
+    (first sampled token, prompt length, carried key, updated pool).
     """
     with use_mesh(mesh):
-        S = cache.max_len
-        sub = init_cache(config, 1, max_len=S)
-        positions = prompt_positions(prompt_mask)
         P = prompt_tokens.shape[1]
+        BLK = pool.block_size
+        sub = init_cache(config, 1, max_len=P)
+        positions = prompt_positions(prompt_mask)
         chunk = prefill_chunk if prefill_chunk and prefill_chunk < P else P
         for start in range(0, P, chunk):
             end = min(start + chunk, P)
@@ -97,29 +316,134 @@ def _insert_row(params, cache, row, prompt_tokens, prompt_mask, rng, *,
                 attn_mask=prompt_mask[:, start:end],
                 compute_logits=end >= P,
             )
-        tau = sample(rng, logits[:, -1], temperature, top_p, top_k)
-        tau = tau.astype(jnp.int32)[0]
+        key, subkey = jax.random.split(key)
+        tau = sample_rows(
+            subkey[None], logits[:, -1], temperature[None], top_p[None],
+            top_k[None],
+        )[0]
         plen = jnp.sum(prompt_mask.astype(jnp.int32))
 
-        def splice(dst, src, axis_b):
-            start = (0,) * axis_b + (row,) + (0,) * (dst.ndim - axis_b - 1)
-            return lax.dynamic_update_slice(dst, src, start)
-
-        new = dataclasses.replace(
-            cache,
-            k=splice(cache.k, sub.k, 1),
-            v=splice(cache.v, sub.v, 1),
-            pos=splice(cache.pos, sub.pos, 0),
-            index=cache.index.at[row].set(prompt_tokens.shape[1]),
+        L, _, _, KVH, hd = pool.k.shape
+        nb = P // BLK
+        pool = dataclasses.replace(
+            pool,
+            k=pool.k.at[:, block_ids].set(
+                sub.k[:, 0].reshape(L, nb, BLK, KVH, hd)
+            ),
+            v=pool.v.at[:, block_ids].set(
+                sub.v[:, 0].reshape(L, nb, BLK, KVH, hd)
+            ),
+            pos=pool.pos.at[block_ids].set(sub.pos[0].reshape(nb, BLK)),
         )
-        if cache.quantized:
-            new = dataclasses.replace(
-                new,
-                k_scale=splice(cache.k_scale, sub.k_scale, 1),
-                v_scale=splice(cache.v_scale, sub.v_scale, 1),
+        if pool.quantized:
+            pool = dataclasses.replace(
+                pool,
+                k_scale=pool.k_scale.at[:, block_ids].set(
+                    sub.k_scale[:, 0].reshape(L, nb, BLK, KVH)
+                ),
+                v_scale=pool.v_scale.at[:, block_ids].set(
+                    sub.v_scale[:, 0].reshape(L, nb, BLK, KVH)
+                ),
             )
-        return tau, plen, new
+        return tau, plen, key, pool
 
+
+@functools.partial(jax.jit, donate_argnames=("pos",))
+def _release_blocks(pos, block_ids):
+    """Invalidate freed blocks' positions (block_ids padded with the
+    out-of-range sentinel; those drop)."""
+    return pos.at[block_ids].set(-1, mode="drop")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("t_config", "d_config", "n_draft", "mesh"),
+    donate_argnames=("t_pool", "d_pool"),
+)
+def _spec_round(
+    t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau, pos,
+    active, *, t_config, d_config, n_draft, mesh=None,
+):
+    """One speculative round for every active slot (greedy verification).
+
+    Draft proposes ``n_draft`` tokens autoregressively, the target verifies
+    them in ONE [B, n_draft+1] forward (weights stream once per round —
+    the whole point on HBM-bound TPU decode), and the accepted prefix is
+    committed.  Both models share the block geometry, so one table/fill
+    serves the two pools.  Returns (outs [B, G+1] greedy continuations,
+    acc [B] accepted-draft counts, updated pools).
+
+    Rollback is real here (unlike ``generate_speculative``'s masked-slot
+    approach): per-row fills let the host rewind to fill + acc + 1, so
+    rejected drafts cost no pool capacity.
+    """
+    G = n_draft
+    B = tau.shape[0]
+    with use_mesh(mesh):
+        t_view = _gather_cache(t_pool, table, n_alloc, fill)
+        d_view = _gather_cache(d_pool, table, n_alloc, fill)
+
+        # --- 1. draft chain: propose d_1 .. d_G ---
+        def draft_one(carry, j):
+            view, tok = carry
+            pp = jnp.where(active, pos + j, -1)[:, None]
+            lg, view = forward(
+                d_params, tok[:, None], pp, d_config, cache=view,
+                attn_mask=active[:, None],
+            )
+            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            return (view, nxt), nxt
+
+        (d_view, d_last), drafts = jax.lax.scan(
+            draft_one, (d_view, tau), jnp.arange(G, dtype=jnp.int32)
+        )
+        drafts = jnp.swapaxes(drafts, 0, 1)  # [B, G]
+        # Catch-up: land d_G's KV so a fully-accepted round leaves no hole
+        # at pos+G (same reasoning as generate_speculative's extra forward).
+        _, d_view = forward(
+            d_params, d_last[:, None],
+            jnp.where(active, pos + G, -1)[:, None], d_config,
+            cache=d_view, attn_mask=active[:, None],
+        )
+
+        # --- 2. one target pass over [tau, d_1 .. d_G] ---
+        block = jnp.concatenate([tau[:, None], drafts], axis=1)
+        j = jnp.arange(G + 1, dtype=jnp.int32)[None, :]
+        block_pos = jnp.where(
+            active[:, None], pos[:, None] + j, -1
+        ).astype(jnp.int32)
+        t_logits, t_view = forward(
+            t_params, block, block_pos, t_config, cache=t_view,
+            attn_mask=jnp.broadcast_to(active[:, None], block.shape),
+        )
+        outs = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, G+1]
+
+        # --- 3. accept the matching draft prefix ---
+        match = drafts == outs[:, :G]
+        acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+
+        # --- 4. commit: invalidate rejected slots, write back both pools.
+        # Slot j holds block[j] (= tau for j=0, d_j after), valid iff
+        # j <= acc; the host rewinds fill to +acc+1 so rejected slots are
+        # reused, not wasted.
+        valid = j <= acc[:, None]
+        patched = jnp.where(valid, block_pos, -1)
+        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+        cols = fill[:, None] + j
+        t_view = dataclasses.replace(
+            t_view, pos=t_view.pos.at[rows, cols].set(patched, mode="drop")
+        )
+        d_view = dataclasses.replace(
+            d_view, pos=d_view.pos.at[rows, cols].set(patched, mode="drop")
+        )
+        t_pool = _scatter_back(t_pool, t_view, table, fill, active, T=G + 1)
+        d_pool = _scatter_back(d_pool, d_view, table, fill, active, T=G + 1)
+        return outs, acc, t_pool, d_pool
+
+
+# ---------------------------------------------------------------------------
+# Host-side batcher
+# ---------------------------------------------------------------------------
 
 @dataclasses.dataclass
 class _Slot:
@@ -127,10 +451,31 @@ class _Slot:
     emitted: List[int]
     max_new: int
     stop_tokens: frozenset
+    blocks: List[int]
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    tokens: List[int]
+    max_new: int
+    stops: frozenset
+    temperature: float
+    top_p: float
+    top_k: int
+    seed: Optional[int]
+
+    def blocks_needed(self, block_size: int) -> int:
+        padded = _round_up(len(self.tokens), block_size)
+        return -(-(padded + self.max_new) // block_size)
 
 
 class ContinuousBatcher:
-    """Host-side slot manager around the jitted step/insert programs.
+    """Host-side slot manager around the jitted paged step programs.
 
     Usage:
         cb = ContinuousBatcher(params, config, n_slots=8, max_len=2048)
@@ -138,6 +483,18 @@ class ContinuousBatcher:
         while cb.pending():
             for request_id, token, done in cb.step():
                 ...stream token to the caller...
+
+    ``n_blocks`` sizes the KV pool; the default matches contiguous
+    capacity (n_slots × max_len).  A smaller pool overcommits: admission
+    reserves ceil((padded_prompt + max_new) / block_size) blocks and
+    requests queue until their reservation fits.
+
+    Passing ``draft_params``/``draft_config`` turns on speculative
+    decoding inside the batcher: each step drafts ``n_draft`` tokens per
+    slot and verifies them in one target forward — output is token-
+    identical to the plain greedy batcher (the draft only changes speed;
+    see ``acceptance_rate()``).  Spec mode is greedy-only; sampled
+    speculative decode exists standalone in ``spec_decode``.
     """
 
     def __init__(
@@ -152,6 +509,11 @@ class ContinuousBatcher:
         top_k: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
         seed: int = 0,
+        block_size: Optional[int] = None,
+        n_blocks: Optional[int] = None,
+        draft_params: Any = None,
+        draft_config: Optional[LLaMAConfig] = None,
+        n_draft: int = 4,
         mesh=None,
     ):
         if config.attn_impl not in ("xla", "auto"):
@@ -159,61 +521,130 @@ class ContinuousBatcher:
                 "continuous batching requires attn_impl 'xla' or 'auto' "
                 "(per-row cache offsets run on the xla path)"
             )
+        self.spec = draft_params is not None
+        if self.spec:
+            if draft_config is None:
+                raise ValueError("draft_params requires draft_config")
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError("target and draft must share a vocabulary")
+            if n_draft < 1:
+                raise ValueError("n_draft must be >= 1")
+            if temperature != 0.0:
+                raise ValueError(
+                    "speculative batching is greedy-only (temperature 0); "
+                    "use spec_decode.generate_speculative for sampled "
+                    "speculative decoding"
+                )
+        self.draft_params = draft_params
+        self.draft_config = draft_config
+        self.n_draft = n_draft
         self.params = params
         self.config = config
         self.mesh = mesh
         self.n_slots = n_slots
         self.max_len = max_len or config.max_seq_len
+        self.block_size = block_size or min(
+            128, max(16, self.max_len // 16)
+        )
+        self.blocks_per_slot = -(-self.max_len // self.block_size)
+        self.n_blocks = n_blocks or n_slots * self.blocks_per_slot
         self.default_stop = frozenset(int(s) for s in stop_tokens)
         self.temperature = float(temperature)
-        self.top_p = top_p
-        self.top_k = top_k
+        self.top_p = 1.0 if top_p is None else float(top_p)
+        self.top_k = 0 if top_k is None else int(top_k)
         self.prefill_chunk = prefill_chunk
-        self._rng = jax.random.PRNGKey(seed)
+        self.seed = seed
 
-        base = init_cache(config, n_slots, max_len=self.max_len)
-        self.cache = dataclasses.replace(
-            base, index=jnp.zeros((n_slots,), jnp.int32)
+        self.pool = init_pool(self.config, self.n_blocks, self.block_size)
+        self.draft_pool = (
+            init_pool(self.draft_config, self.n_blocks, self.block_size)
+            if self.spec else None
         )
-        self.tau = jnp.zeros((n_slots,), jnp.int32)
-        self.pos = jnp.zeros((n_slots,), jnp.int32)
-        self.active = jnp.zeros((n_slots,), bool)
+        self.free_blocks: List[int] = list(range(self.n_blocks))
+        # Observability counters (exposed via the HTTP /metrics endpoint).
+        self.emitted_total = 0
+        self.steps_total = 0
+        self.drafts_proposed = 0
+        self.drafts_accepted = 0
+        # Host-side numpy mirrors; uploaded per step (tiny) — the KV pool
+        # is the only state that stays resident/donated on device.
+        B, MB = n_slots, self.blocks_per_slot
+        self.table = np.full((B, MB), self.n_blocks, np.int32)
+        self.n_alloc = np.zeros((B,), np.int32)
+        self.fill = np.zeros((B,), np.int32)
+        self.tau = jnp.zeros((B,), jnp.int32)
+        self.pos = np.zeros((B,), np.int32)
+        self.active = np.zeros((B,), bool)
+        self.keys = jnp.zeros((B, 2), jnp.uint32)
+        self.temp_arr = np.zeros((B,), np.float32)
+        self.top_p_arr = np.ones((B,), np.float32)
+        self.top_k_arr = np.zeros((B,), np.int32)
 
         self.slots: Dict[int, Optional[_Slot]] = {
             b: None for b in range(n_slots)
         }
-        self.queue: List[Tuple[int, List[int], int, frozenset]] = []
+        self.queue: List[_Request] = []
         self._next_id = 0
 
     # -- public API ---------------------------------------------------------
 
     def submit(
         self,
-        prompt_tokens: List[int],
+        prompt_tokens: Sequence[int],
         max_new_tokens: int = 256,
         stop_tokens: Optional[Tuple[int, ...]] = None,
+        temperature: Optional[float] = None,
+        top_p: Optional[float] = None,
+        top_k: Optional[int] = None,
+        seed: Optional[int] = None,
     ) -> int:
-        """Queue a request; returns its id.  Tokens only — tokenize first."""
+        """Queue a request; returns its id.  Tokens only — tokenize first.
+
+        temperature/top_p/top_k default to the pool-level policy; ``seed``
+        starts the request's own PRNG chain (default: derived from the
+        pool seed and request id).
+        """
         if not prompt_tokens:
             raise ValueError("empty prompt")
-        # Capacity must cover the BUCKETED prompt length: _admit pads the
-        # prompt to the next power of two and the row's write offset starts
-        # there, so checking the raw length would let bucketing silently
-        # push decode writes past capacity (where they drop).
-        bucketed = next_pow2(len(prompt_tokens))
-        if bucketed + max_new_tokens > self.max_len:
+        if self.spec and (
+            (temperature or 0.0) != 0.0
+            or temperature is None and self.temperature != 0.0
+        ):
+            raise ValueError("speculative batching is greedy-only")
+        # Capacity covers the BLOCK-PADDED prompt: admission pads the
+        # prompt to a block multiple and the row's write offset starts
+        # there.
+        padded = _round_up(len(prompt_tokens), self.block_size)
+        if padded + max_new_tokens > self.max_len:
             raise ValueError(
-                f"prompt ({len(prompt_tokens)}, padded to {bucketed}) + "
-                f"max_new ({max_new_tokens}) exceeds pool capacity "
+                f"prompt ({len(prompt_tokens)}, padded to {padded}) + "
+                f"max_new ({max_new_tokens}) exceeds per-request capacity "
                 f"{self.max_len}"
             )
         rid = self._next_id
         self._next_id += 1
-        stops = (
-            self.default_stop if stop_tokens is None
-            else frozenset(int(s) for s in stop_tokens)
+        req = _Request(
+            rid=rid,
+            tokens=list(prompt_tokens),
+            max_new=max_new_tokens,
+            stops=(
+                self.default_stop if stop_tokens is None
+                else frozenset(int(s) for s in stop_tokens)
+            ),
+            temperature=(
+                self.temperature if temperature is None
+                else float(temperature)
+            ),
+            top_p=self.top_p if top_p is None else float(top_p),
+            top_k=self.top_k if top_k is None else int(top_k),
+            seed=seed,
         )
-        self.queue.append((rid, list(prompt_tokens), max_new_tokens, stops))
+        if req.blocks_needed(self.block_size) > self.n_blocks:
+            raise ValueError(
+                f"request needs {req.blocks_needed(self.block_size)} "
+                f"blocks; the pool has {self.n_blocks} total"
+            )
+        self.queue.append(req)
         self._admit()
         return rid
 
@@ -222,12 +653,35 @@ class ContinuousBatcher:
             s is not None for s in self.slots.values()
         )
 
+    def acceptance_rate(self) -> float:
+        """Fraction of proposed draft tokens accepted (speculative mode)."""
+        if not self.drafts_proposed:
+            return 0.0
+        return self.drafts_accepted / self.drafts_proposed
+
+    def stats(self) -> Dict[str, float]:
+        """Counters for observability (the HTTP /metrics endpoint)."""
+        return {
+            "emitted_tokens_total": self.emitted_total,
+            "decode_steps_total": self.steps_total,
+            "active_slots": sum(
+                s is not None for s in self.slots.values()
+            ),
+            "queued_requests": len(self.queue),
+            "free_blocks": len(self.free_blocks),
+            "total_blocks": self.n_blocks,
+            "drafts_proposed_total": self.drafts_proposed,
+            "drafts_accepted_total": self.drafts_accepted,
+            "draft_acceptance_rate": self.acceptance_rate(),
+        }
+
     def step(self) -> List[Tuple[int, int, bool]]:
         """One decode step for every active slot.
 
-        Returns [(request_id, token, done)] for tokens emitted this step.
-        Finished slots free up and queued requests are admitted for the
-        NEXT step.
+        Returns [(request_id, token, done)] for tokens emitted this step
+        (one per active slot; up to ``n_draft + 1`` per slot in
+        speculative mode).  Finished slots free their blocks and queued
+        requests are admitted for the NEXT step.
         """
         self._admit()
         if not any(s is not None for s in self.slots.values()):
@@ -243,26 +697,81 @@ class ContinuousBatcher:
                 continue
             tok = int(taus[b])
             slot.emitted.append(tok)
+            self.emitted_total += 1
             done = (
                 tok in slot.stop_tokens
                 or len(slot.emitted) >= slot.max_new
             )
             out.append((slot.request_id, tok, done))
             if done:
-                self.slots[b] = None
-                self.active = self.active.at[b].set(False)
+                self._free_slot(b)
 
         if any(s is not None for s in self.slots.values()):
-            self._rng, sub = jax.random.split(self._rng)
-            nxt, self.cache = _decode_step(
-                self.params, self.cache, self.tau, self.pos, self.active,
-                sub, config=self.config, temperature=self.temperature,
-                top_p=self.top_p, top_k=self.top_k, mesh=self.mesh,
-            )
-            self.tau = nxt
-            self.pos = self.pos + self.active.astype(jnp.int32)
+            self.steps_total += 1
+            if self.spec:
+                self._spec_tail(out)
+            else:
+                all_greedy = bool(
+                    np.all(self.temp_arr[self.active] == 0.0)
+                )
+                self.tau, self.keys, self.pool = _paged_decode_step(
+                    self.params, self.pool,
+                    jnp.array(self.table), jnp.array(self.n_alloc),
+                    jnp.array(self.fill), self.tau, jnp.array(self.pos),
+                    jnp.array(self.active), self.keys,
+                    jnp.array(self.temp_arr), jnp.array(self.top_p_arr),
+                    jnp.array(self.top_k_arr),
+                    config=self.config, all_greedy=all_greedy,
+                    mesh=self.mesh,
+                )
+                self.fill += self.active
+                self.pos += self.active
         self._admit()
         return out
+
+    def _spec_tail(self, out: List[Tuple[int, int, bool]]) -> None:
+        """Speculative remainder of a step: draft + verify, emit the
+        accepted prefix (appended to ``out``), rewind fills past rejected
+        slots."""
+        outs, acc, self.pool, self.draft_pool = _spec_round(
+            self.params, self.draft_params, self.pool, self.draft_pool,
+            jnp.array(self.table), jnp.array(self.n_alloc),
+            jnp.array(self.fill), self.tau, jnp.array(self.pos),
+            jnp.array(self.active),
+            t_config=self.config, d_config=self.draft_config,
+            n_draft=self.n_draft, mesh=self.mesh,
+        )
+        outs = np.asarray(outs)
+        acc = np.asarray(acc)
+        new_tau = np.zeros((self.n_slots,), np.int32)
+        for b, slot in self.slots.items():
+            if slot is None:
+                continue
+            a = int(acc[b])
+            self.drafts_proposed += self.n_draft
+            self.drafts_accepted += a
+            # Emit accepted drafts outs[0..a-1] (== the draft tokens);
+            # outs[a] becomes the next pending token, mirroring the plain
+            # batcher's sampled-but-unemitted tau.
+            done = False
+            for i in range(a):
+                tok = int(outs[b, i])
+                slot.emitted.append(tok)
+                self.emitted_total += 1
+                done = (
+                    tok in slot.stop_tokens
+                    or len(slot.emitted) >= slot.max_new
+                )
+                out.append((slot.request_id, tok, done))
+                if done:
+                    break
+            if done:
+                self._free_slot(b)
+            else:
+                new_tau[b] = outs[b, a]
+                self.fill[b] += a + 1
+                self.pos[b] += a + 1
+        self.tau = jnp.asarray(new_tau)
 
     def run_to_completion(self) -> Dict[int, List[int]]:
         """Drain everything; returns {request_id: emitted tokens}."""
@@ -274,28 +783,83 @@ class ContinuousBatcher:
 
     # -- internals ----------------------------------------------------------
 
+    def _free_slot(self, b: int) -> None:
+        slot = self.slots[b]
+        assert slot is not None
+        ids = np.full((self.blocks_per_slot,), self.n_blocks, np.int32)
+        ids[: len(slot.blocks)] = slot.blocks
+        new_pos = _release_blocks(self.pool.pos, jnp.asarray(ids))
+        self.pool = dataclasses.replace(self.pool, pos=new_pos)
+        if self.spec:
+            self.draft_pool = dataclasses.replace(
+                self.draft_pool,
+                pos=_release_blocks(self.draft_pool.pos, jnp.asarray(ids)),
+            )
+        self.free_blocks.extend(slot.blocks)
+        self.slots[b] = None
+        self.table[b] = self.n_blocks
+        self.n_alloc[b] = 0
+        self.fill[b] = 0
+        self.active[b] = False
+
     def _admit(self) -> None:
         for b, slot in self.slots.items():
             if slot is not None or not self.queue:
                 continue
-            rid, toks, max_new, stops = self.queue.pop(0)
-            P = next_pow2(len(toks))
+            need = self.queue[0].blocks_needed(self.block_size)
+            if need > len(self.free_blocks):
+                # Head-of-line blocking (FIFO fairness): wait for blocks.
+                return
+            req = self.queue.pop(0)
+            blocks = [self.free_blocks.pop(0) for _ in range(need)]
+
+            P = _round_up(len(req.tokens), self.block_size)
             pt = np.zeros((1, P), np.int32)
             pm = np.zeros((1, P), bool)
-            pt[0, P - len(toks):] = toks
-            pm[0, P - len(toks):] = True
-            self._rng, sub = jax.random.split(self._rng)
-            tau, plen, self.cache = _insert_row(
-                self.params, self.cache, jnp.int32(b),
-                jnp.asarray(pt), jnp.asarray(pm), sub,
-                config=self.config, temperature=self.temperature,
-                top_p=self.top_p, top_k=self.top_k,
-                prefill_chunk=self.prefill_chunk, mesh=self.mesh,
+            pt[0, P - len(req.tokens):] = req.tokens
+            pm[0, P - len(req.tokens):] = True
+            prompt_blocks = P // self.block_size
+            # Stable mix (NOT Python's hash(): its tuple algorithm is an
+            # interpreter implementation detail, which would silently
+            # change sampled outputs across Python versions).
+            seed = (
+                req.seed if req.seed is not None
+                else (self.seed * 1000003 + req.rid) & 0x7FFFFFFF
             )
+            key = jax.random.PRNGKey(seed)
+            prompt_block_ids = jnp.asarray(
+                np.asarray(blocks[:prompt_blocks], np.int32)
+            )
+            tau, plen, key, self.pool = _paged_insert(
+                self.params, self.pool, prompt_block_ids,
+                jnp.asarray(pt), jnp.asarray(pm), key,
+                jnp.float32(req.temperature), jnp.float32(req.top_p),
+                jnp.int32(req.top_k),
+                config=self.config, prefill_chunk=self.prefill_chunk,
+                mesh=self.mesh,
+            )
+            if self.spec:
+                # Prefill the draft pool over the same reserved blocks
+                # (its sampled token is discarded — the target picks tau).
+                _, _, _, self.draft_pool = _paged_insert(
+                    self.draft_params, self.draft_pool, prompt_block_ids,
+                    jnp.asarray(pt), jnp.asarray(pm), key,
+                    jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+                    config=self.draft_config,
+                    prefill_chunk=self.prefill_chunk, mesh=self.mesh,
+                )
             self.tau = self.tau.at[b].set(tau)
-            self.pos = self.pos.at[b].set(plen)
-            self.active = self.active.at[b].set(True)
+            self.keys = self.keys.at[b].set(key)
+            self.pos[b] = int(plen)
+            self.fill[b] = P
+            self.active[b] = True
+            self.table[b] = self.n_blocks
+            self.table[b, :need] = blocks
+            self.n_alloc[b] = need
+            self.temp_arr[b] = req.temperature
+            self.top_p_arr[b] = req.top_p
+            self.top_k_arr[b] = req.top_k
             self.slots[b] = _Slot(
-                request_id=rid, emitted=[], max_new=max_new,
-                stop_tokens=stops,
+                request_id=req.rid, emitted=[], max_new=req.max_new,
+                stop_tokens=req.stops, blocks=blocks,
             )

@@ -120,18 +120,118 @@ def test_queue_overflow_waits(model):
     assert results[r2] == _reference(params, config, [7, 8, 9], 4)
 
 
-def test_capacity_check_uses_bucketed_length(model):
-    """A 33-token prompt buckets to 64; with max_len=72 and max_new=16 the
-    bucketed start (64) + 16 > 72 must be rejected up front — accepting it
-    would silently drop decode KV writes past capacity."""
+def test_capacity_check_uses_block_padded_length(model):
+    """A 33-token prompt pads to the next block multiple (48 at block 16);
+    with max_len=56 and max_new=16 the padded start (48) + 16 > 56 must be
+    rejected up front — accepting it would silently drop decode KV writes
+    past the reservation."""
     params, config = model
-    cb = ContinuousBatcher(params, config, n_slots=1, max_len=72)
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=56,
+                           block_size=16)
+    assert cb.block_size == 16
     with pytest.raises(ValueError, match="padded"):
         cb.submit(list(range(1, 34)), max_new_tokens=16)
-    # 33 -> 64, 64 + 8 = 72 fits exactly
+    # 33 -> 48, 48 + 8 = 56 fits exactly
     rid = cb.submit(list(range(1, 34)), max_new_tokens=8)
     results = cb.run_to_completion()
     assert results[rid] == _reference(params, config, list(range(1, 34)), 8)
+
+
+def test_no_pow2_waste(model):
+    """Block padding reserves ceil((padded+max_new)/block) blocks — a
+    65-token prompt at block 16 reserves 96 slots of KV (not the 128 a
+    pow2 bucket would), so two such requests fit a 12-block pool."""
+    params, config = model
+    prompt = list(np.random.RandomState(1).randint(1, 128, size=65))
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=128,
+                           block_size=16, n_blocks=12)
+    r1 = cb.submit(prompt, max_new_tokens=8)
+    r2 = cb.submit(prompt[:10], max_new_tokens=8)
+    # 65 -> 80 padded, +8 -> 88 -> 6 blocks; 10 -> 16, +8 -> 24 -> 2 blocks
+    assert cb.slots[0] is not None and cb.slots[1] is not None
+    results = cb.run_to_completion()
+    assert results[r1] == _reference(params, config, prompt, 8)
+    assert results[r2] == _reference(params, config, prompt[:10], 8)
+
+
+def test_overcommit_pool_queues_until_blocks_free(model):
+    """The pool may be smaller than n_slots x max_len (overcommit):
+    requests whose reservation doesn't fit wait in the queue and run once
+    completions free blocks — with contiguous per-slot regions this
+    workload could not be configured at all."""
+    params, config = model
+    # 2 slots x max_len 96 would need 192 contiguous slots; pool holds 96.
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=96,
+                           block_size=16, n_blocks=6)
+    prompts = [[4, 5, 6], [7, 8, 9], [10, 11, 12]]
+    rids = [cb.submit(p, max_new_tokens=30) for p in prompts]
+    # each request reserves ceil((16+30)/16) = 3 blocks; only two fit at
+    # once, the third queues.
+    assert sum(s is not None for s in cb.slots.values()) == 2
+    assert len(cb.queue) == 1
+    results = cb.run_to_completion()
+    for rid, p in zip(rids, prompts):
+        assert results[rid] == _reference(params, config, p, 30)
+    assert sorted(cb.free_blocks) == list(range(6))
+
+
+def test_oversized_reservation_rejected(model):
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=96,
+                           block_size=16, n_blocks=3)
+    with pytest.raises(ValueError, match="blocks"):
+        cb.submit([1, 2, 3], max_new_tokens=70)
+
+
+def _reference_sampled(params, config, prompt, max_new, seed, temperature,
+                       top_p=None, top_k=None):
+    """Standalone SAMPLED generate for one prompt (B=1), trimmed like the
+    batcher."""
+    P = len(prompt)
+    Pp = 1 << max(P - 1, 1).bit_length()
+    toks = np.zeros((1, Pp), np.int32)
+    mask = np.zeros((1, Pp), bool)
+    toks[0, Pp - P:] = prompt
+    mask[0, Pp - P:] = True
+    gc = GenerationConfig(
+        max_new_tokens=max_new, temperature=temperature, top_p=top_p,
+        top_k=top_k, stop_tokens=(), pad_id=0,
+    )
+    out = np.asarray(
+        generate(params, jnp.asarray(toks), jnp.asarray(mask),
+                 jax.random.PRNGKey(seed), config=config, gen_config=gc)
+    )[0, Pp:]
+    return out[:max_new].tolist()
+
+
+def test_per_request_sampling_matches_standalone(model):
+    """Each slot's (seed, temperature, top_p, top_k) must reproduce the
+    standalone seeded engine.generate of that request exactly, even while
+    sharing decode steps with slots running different policies."""
+    params, config = model
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 128, size=rng.randint(3, 9)).tolist()
+               for _ in range(4)]
+    policies = [
+        dict(temperature=0.0),
+        dict(temperature=0.9, seed=11),
+        dict(temperature=0.7, top_p=0.8, seed=12),
+        dict(temperature=1.1, top_k=20, seed=13),
+    ]
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    rids = [cb.submit(p, max_new_tokens=8, **pol)
+            for p, pol in zip(prompts, policies)]
+    results = cb.run_to_completion()
+    for rid, p, pol in zip(rids, prompts, policies):
+        t = pol["temperature"]
+        if t == 0.0:
+            want = _reference(params, config, p, 8)
+        else:
+            want = _reference_sampled(
+                params, config, p, 8, pol["seed"], t,
+                pol.get("top_p"), pol.get("top_k"),
+            )
+        assert results[rid] == want, pol
 
 
 def test_sampled_pool_runs_and_varies(model):
@@ -150,6 +250,25 @@ def test_sampled_pool_runs_and_varies(model):
     assert a == b            # deterministic per seed
     assert a != c            # varies across seeds
     assert all(0 <= t < 128 for t in a)
+
+
+def test_int8_kv_paged_batcher(model):
+    """The paged pool's quantized branches (scale gather/scatter through
+    block tables) must produce the same tokens as the standalone int8-KV
+    generate path."""
+    params, config = model
+    import dataclasses
+    qconfig = dataclasses.replace(config, kv_cache_dtype="int8")
+    prompt = [5, 17, 99, 3, 42]
+    cb = ContinuousBatcher(params, qconfig, n_slots=2, max_len=64,
+                           block_size=16)
+    assert cb.pool.quantized
+    rid = cb.submit(prompt, max_new_tokens=12)
+    got = cb.run_to_completion()[rid]
+    want = _reference(params, qconfig, prompt, 12)
+    assert got == want
+    # int8 quantization changes numerics vs fp32 but stays plausible
+    assert all(0 <= t < 128 for t in got)
 
 
 def test_chunked_admission_matches_single_shot(model):

@@ -1,0 +1,136 @@
+"""Speculative decoding inside the continuous batcher: output must be
+token-identical to the plain greedy batcher — the draft model only changes
+speed (acceptance), never content."""
+
+import jax
+import numpy as np
+import pytest
+
+from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.serving import ContinuousBatcher
+
+CFG = dict(
+    vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    multiple_of=32, max_seq_len=128, dtype="float32", param_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def models():
+    config = get_config("tiny", **CFG)
+    params = init_params(jax.random.PRNGKey(0), config)
+    draft_config = get_config(
+        "tiny", **{**CFG, "dim": 32, "n_layers": 1, "n_heads": 2,
+                   "n_kv_heads": 1}
+    )
+    draft_params = init_params(jax.random.PRNGKey(1), draft_config)
+    return params, config, draft_params, draft_config
+
+
+def _plain(params, config, prompts, max_new, stop=()):
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64,
+                           stop_tokens=stop)
+    rids = [cb.submit(p, max_new_tokens=max_new) for p in prompts]
+    return rids, cb.run_to_completion()
+
+
+def test_spec_batcher_matches_plain_greedy(models):
+    params, config, draft_params, draft_config = models
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 128, size=rng.randint(3, 12)).tolist()
+               for _ in range(5)]
+    prids, pres = _plain(params, config, prompts, 12)
+
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64,
+        draft_params=draft_params, draft_config=draft_config, n_draft=3,
+    )
+    rids = [cb.submit(p, max_new_tokens=12) for p in prompts]
+    results = cb.run_to_completion()
+    for rid, prid in zip(rids, prids):
+        assert results[rid] == pres[prid]
+    assert cb.drafts_proposed > 0
+    assert 0.0 <= cb.acceptance_rate() <= 1.0
+
+
+def test_spec_batcher_self_draft_accepts_everything(models):
+    """With the target as its own draft, greedy proposals always match —
+    acceptance must be 100% and each request finishes in ~max_new/(G+1)
+    rounds instead of max_new steps."""
+    params, config, _, _ = models
+    prompt = [5, 17, 99, 3, 42]
+    _, pres = _plain(params, config, [prompt], 12)
+
+    cb = ContinuousBatcher(
+        params, config, n_slots=1, max_len=64,
+        draft_params=params, draft_config=config, n_draft=3,
+    )
+    rid = cb.submit(prompt, max_new_tokens=12)
+    results = cb.run_to_completion()
+    assert results[rid] == pres[0]
+    assert cb.acceptance_rate() == 1.0
+    # 1 emission step + ceil(11 / 4) spec rounds, not 12 steps.
+    assert cb.steps_total <= 4
+
+
+def test_spec_batcher_stop_tokens(models):
+    params, config, draft_params, draft_config = models
+    prompt = [5, 17, 99, 3, 42]
+    _, pres = _plain(params, config, [prompt], 16)
+    stop = pres[0][4]  # 5th emitted token becomes the stop
+    _, pres_stop = _plain(params, config, [prompt], 16, stop=(stop,))
+
+    cb = ContinuousBatcher(
+        params, config, n_slots=1, max_len=64, stop_tokens=(stop,),
+        draft_params=draft_params, draft_config=draft_config, n_draft=4,
+    )
+    rid = cb.submit(prompt, max_new_tokens=16)
+    results = cb.run_to_completion()
+    assert results[rid] == pres_stop[0]
+    assert not cb.pending()
+    assert sorted(cb.free_blocks) == list(range(cb.n_blocks))
+
+
+def test_spec_batcher_rejects_sampling(models):
+    params, config, draft_params, draft_config = models
+    cb = ContinuousBatcher(
+        params, config, n_slots=1, max_len=64,
+        draft_params=draft_params, draft_config=draft_config,
+    )
+    with pytest.raises(ValueError, match="greedy-only"):
+        cb.submit([1, 2, 3], max_new_tokens=4, temperature=0.8)
+    with pytest.raises(ValueError, match="greedy-only"):
+        ContinuousBatcher(
+            params, config, n_slots=1, max_len=64, temperature=0.7,
+            draft_params=draft_params, draft_config=draft_config,
+        )
+
+
+def test_spec_batcher_staggered_admission(models):
+    """Requests entering mid-flight under overcommit must still match the
+    plain batcher exactly."""
+    params, config, draft_params, draft_config = models
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 128, size=rng.randint(3, 10)).tolist()
+               for _ in range(4)]
+    prids, pres = _plain(params, config, prompts, 10)
+
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, block_size=16, n_blocks=5,
+        draft_params=draft_params, draft_config=draft_config, n_draft=2,
+    )
+    rids = {}
+    results = {}
+    rids[cb.submit(prompts[0], max_new_tokens=10)] = 0
+    submitted = 1
+    guard = 0
+    while cb.pending():
+        guard += 1
+        assert guard < 300
+        for rid, tok, done in cb.step():
+            results.setdefault(rid, []).append(tok)
+        if submitted < len(prompts):
+            rids[cb.submit(prompts[submitted], max_new_tokens=10)] = submitted
+            submitted += 1
+    for rid, pi in rids.items():
+        assert results[rid] == pres[prids[pi]], f"prompt {pi}"
