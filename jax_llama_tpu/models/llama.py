@@ -47,7 +47,7 @@ from jax import lax
 
 from ..config import LLaMAConfig
 from ..ops.attention import attention_bias, dropout as _dropout, sdpa, sdpa_cached
-from ..ops.flash_attention import flash_attention
+from ..ops.flash_attention import flash_attention, flash_attention_quantized
 from ..ops.norm import rms_norm
 from ..ops.quant import matmul as qeinsum
 from ..ops.rope import apply_rope, rope_table
@@ -103,6 +103,90 @@ class KVCache:
         its own offset (continuous batching).  Scalar = classic lockstep
         decode.  Vector indices require the xla attention path."""
         return self.index.ndim == 1
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["k", "v", "pos", "table", "fill"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class PagedKVCache:
+    """Paged (block-table) KV cache for continuous-batching decode.
+
+    The serving pool's own layout, consumed directly by ``paged_forward``
+    via the Pallas paged-attention kernel (``ops.paged_attention``) — the
+    kernel's index maps chase ``table``, so no gathered contiguous view
+    is ever materialized.
+
+    k, v:  [L, KVH, NB, BLK, head_dim] — KV-head-major so one
+           (head, block) tile is a clean (BLK, head_dim) VMEM page.
+    pos:   [NB, BLK] int32 absolute position per slot; -1 invalid.
+    table: [B, MB] int32 physical block ids in sequence order; NB marks
+           an unused entry.
+    fill:  [B] int32 per-row next write offset in tokens (the host
+           advances it after each step, like the gathered-view path).
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    pos: jnp.ndarray
+    table: jnp.ndarray
+    fill: jnp.ndarray
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[3]
+
+
+def paged_write_indices(
+    table: jnp.ndarray,      # [B, MB] physical block ids (sentinel = NB)
+    fill: jnp.ndarray,       # [B] per-row write offset (tokens)
+    active: jnp.ndarray,     # [B] bool
+    T: int,
+    n_blocks: int,
+    block_size: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Physical (block, offset) pairs for landing T new per-row entries.
+
+    THE paged write-back contract, shared by ``paged_forward`` and
+    ``serving._scatter_back`` so the two paths cannot drift: row b's
+    token j goes to block ``table[b, (fill[b]+j) // BLK]`` at offset
+    ``(fill[b]+j) % BLK``; inactive rows and columns past the row's
+    reserved capacity resolve to the sentinel block id ``n_blocks``
+    (callers scatter with ``mode="drop"``).
+    Returns (blk [B, T], off [B, T]) int32.
+    """
+    MB = table.shape[1]
+    cols = fill[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    safe = jnp.minimum(cols, MB * block_size - 1)
+    blk = jnp.take_along_axis(table, safe // block_size, axis=1)
+    blk = jnp.where(
+        active[:, None] & (cols < MB * block_size), blk, n_blocks
+    )
+    return blk, safe % block_size
+
+
+def lm_head_logits(
+    params: Params, x: jnp.ndarray, config: LLaMAConfig
+) -> jnp.ndarray:
+    """Final RMSNorm + (tied or untied) LM head — the one logits path
+    every forward variant shares.  x: [B, T, D] -> [B, T, V] in
+    config.logits_dtype (fp32 island, reference model.py:732-736)."""
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    if config.tie_word_embeddings:
+        kernel = params["embed"]["embedding"].T
+    else:
+        kernel = params["lm_head"]
+    logits = qeinsum(
+        x, kernel, "btd,dv->btv", config.activation_dtype,
+        preferred_element_type=jnp.dtype(config.logits_dtype),
+    ).astype(config.logits_dtype)
+    return constrain(logits, "data", "seq", "tensor")
 
 
 def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -216,10 +300,19 @@ def _block(
     sin: jnp.ndarray,
     bias_new: Optional[jnp.ndarray] = None,
     impl: str = "xla",
-) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    paged_pos: Optional[jnp.ndarray] = None,
+    paged_table: Optional[jnp.ndarray] = None,
+    paged_qpos: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, ...]:
     """One pre-norm transformer block. x: [B, T, D].  ``impl`` is the
     RESOLVED attention implementation (forward maps "auto" to "flash" or
-    "xla" per call based on T)."""
+    "xla" per call based on T).
+
+    Returns (x, cache_k, cache_v, cache_k_scale, cache_v_scale).  On the
+    xla cached path cache_k/v are just this step's new projections (the
+    caller writes them once, outside the layer scan) and the scales pass
+    through untouched; on the flash cached path they are the fully
+    updated per-layer cache (+ updated scales when int8)."""
     B, T, D = x.shape
     adt = x.dtype
 
@@ -260,6 +353,41 @@ def _block(
         # ys: just this step's projections; forward writes them into the
         # cache once, outside the scan.
         cache_k, cache_v = k, v
+    elif impl == "paged":
+        # Paged decode: cache_k/cache_v are the layer's block pool
+        # [KVH, NB, BLK, hd]; the Pallas kernel walks the block table in
+        # its index maps (pool read once, no gathered view) and the new
+        # token's slot merges at the softmax level.  Pool stays immutable
+        # through the scan — paged_forward scatters the ys once per step.
+        from ..ops.paged_attention import paged_decode_attention
+
+        attn = paged_decode_attention(
+            q, k, v, cache_k, cache_v, paged_pos, paged_table, paged_qpos
+        )
+        cache_k, cache_v = k, v
+    elif cache_k is not None and cache_k_scale is not None:
+        # int8 cache on the flash path: quantize this chunk's projections,
+        # land payload + scales at [cache_index, cache_index+T), and
+        # attend the whole cache with in-kernel scale folding — the int8
+        # bytes stream straight from HBM, never dequantized in memory.
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        cache_k = lax.dynamic_update_slice(
+            cache_k, kq, (0, cache_index, 0, 0)
+        )
+        cache_v = lax.dynamic_update_slice(
+            cache_v, vq, (0, cache_index, 0, 0)
+        )
+        cache_k_scale = lax.dynamic_update_slice(
+            cache_k_scale, ks, (0, cache_index, 0)
+        )
+        cache_v_scale = lax.dynamic_update_slice(
+            cache_v_scale, vs, (0, cache_index, 0)
+        )
+        attn = flash_attention_quantized(
+            q, cache_k, cache_v, cache_k_scale, cache_v_scale,
+            positions, slot_pos,
+        )
     else:
         if cache_k is not None:
             # Flash path: write the T new KV entries at
@@ -314,7 +442,7 @@ def _block(
             jax.random.fold_in(dropout_rng, 2), down, config.resid_pdrop
         )
     x = x + down
-    return x, cache_k, cache_v
+    return x, cache_k, cache_v, cache_k_scale, cache_v_scale
 
 
 def forward(
@@ -355,6 +483,13 @@ def forward(
       (logits [B, T, V] in config.logits_dtype, updated cache or None);
       logits is None when compute_logits=False.
     """
+    if isinstance(cache, PagedKVCache):
+        if dropout_rng is not None:
+            raise ValueError("dropout_rng is training-only (paged decode)")
+        return paged_forward(
+            params, tokens, positions, config, cache,
+            attn_mask=attn_mask, compute_logits=compute_logits,
+        )
     B, T = tokens.shape
     adt = config.activation_dtype
     if cache is not None and config.attn_impl == "ring":
@@ -416,11 +551,12 @@ def forward(
     # where flash's one-row grid and in-scan cache writes lose.
     impl = config.attn_impl
     if impl == "auto":
-        # int8 caches, per-row indices, and attention-probability dropout
-        # are only supported on the xla path, so "auto" resolves there
-        # regardless of T in those cases.
+        # Per-row indices and attention-probability dropout are only
+        # supported on the xla path, so "auto" resolves there regardless
+        # of T in those cases.  (int8 caches run on both: the flash
+        # kernel folds the dequant scales in-kernel.)
         must_xla = (
-            cache is not None and (cache.quantized or cache.per_row_index)
+            cache is not None and cache.per_row_index
         ) or (dropout_rng is not None and config.attn_pdrop > 0.0)
         impl = "flash" if T > 8 and not must_xla else "xla"
     if dropout_rng is not None and config.attn_pdrop > 0.0 and impl != "xla":
@@ -428,12 +564,6 @@ def forward(
             "attn_pdrop requires the xla attention path (the flash/ring "
             "kernels do not implement probability dropout); use "
             "attn_impl='xla'/'auto' for dropout training or attn_pdrop=0"
-        )
-    if cache is not None and cache.quantized and impl != "xla":
-        raise NotImplementedError(
-            "int8 KV cache requires the xla attention path (the Pallas "
-            "kernels read the cache dtype directly); use attn_impl='xla' "
-            "or 'auto', or kv_cache_dtype='auto' with flash/ring"
         )
     bias_new = None
     xla_cached = cache is not None and impl == "xla"
@@ -529,7 +659,7 @@ def forward(
             )
 
             def one(carry, lp_i):
-                y, _, _ = _block(
+                y, *_ = _block(
                     carry, lp_i, None, None,
                     config=config, positions=pos, bias=sbias,
                     slot_pos=spos, cache_index=None, cos=cos, sin=sin,
@@ -551,15 +681,24 @@ def forward(
     new_v_scale = cache.v_scale if cache is not None else None
     if config.scan_layers and pp_stages <= 1:
         if cache is not None and cache.quantized:
+            # Scales ride the scan alongside the int8 payload.  On the
+            # xla path the returned ck/cv are this step's projections and
+            # the scales pass through unchanged (forward quantizes after
+            # the scan); on the flash path they are the updated int8
+            # cache + scales per layer.
             def scan_fn(carry, xs):
                 layer_params, ck, cv, cks, cvs = xs
-                y, ck, cv = block(carry, layer_params, ck, cv, cks, cvs)
-                return y, (ck, cv)
+                y, ck, cv, cks, cvs = block(
+                    carry, layer_params, ck, cv, cks, cvs
+                )
+                return y, (ck, cv, cks, cvs)
 
-            x, (new_k, new_v) = lax.scan(
+            x, (new_k, new_v, nks, nvs) = lax.scan(
                 scan_fn, x,
                 (lp, cache.k, cache.v, cache.k_scale, cache.v_scale),
             )
+            if not xla_cached:
+                new_k_scale, new_v_scale = nks, nvs
         elif cache is not None:
             # On the xla_cached path the cache rides xs READ-ONLY and the
             # ys are just each layer's new [B,T,KVH,hd] projections —
@@ -567,7 +706,7 @@ def forward(
             # double-buffer copy per decode step inside scan/while.
             def scan_fn(carry, xs):
                 layer_params, ck, cv = xs
-                y, ck, cv = block(carry, layer_params, ck, cv)
+                y, ck, cv, _, _ = block(carry, layer_params, ck, cv)
                 return y, (ck, cv)
 
             x, (new_k, new_v) = lax.scan(scan_fn, x, (lp, cache.k, cache.v))
@@ -578,7 +717,7 @@ def forward(
 
             def scan_fn(carry, xs):
                 layer_params, rng_i = xs
-                y, _, _ = block(
+                y, *_ = block(
                     carry, layer_params, None, None, None, None, rng_i
                 )
                 return y, None
@@ -586,7 +725,7 @@ def forward(
             x, _ = lax.scan(scan_fn, x, (lp, layer_rngs))
         else:
             def scan_fn(carry, layer_params):
-                y, _, _ = block(carry, layer_params, None, None)
+                y, *_ = block(carry, layer_params, None, None)
                 return y, None
 
             x, _ = lax.scan(scan_fn, x, lp)
@@ -595,22 +734,27 @@ def forward(
             jax.random.split(layers_rng, config.n_layers)
             if layers_rng is not None else None
         )
-        new_ks, new_vs = [], []
+        new_ks, new_vs, new_kss, new_vss = [], [], [], []
         for i in range(config.n_layers):
             layer_params = jax.tree.map(lambda a: a[i], lp)
             ck = cache.k[i] if cache is not None else None
             cv = cache.v[i] if cache is not None else None
             cks = cache.k_scale[i] if cache is not None and cache.quantized else None
             cvs = cache.v_scale[i] if cache is not None and cache.quantized else None
-            x, ck, cv = block(
+            x, ck, cv, cks, cvs = block(
                 x, layer_params, ck, cv, cks, cvs,
                 unroll_rngs[i] if unroll_rngs is not None else None,
             )
             new_ks.append(ck)
             new_vs.append(cv)
+            new_kss.append(cks)
+            new_vss.append(cvs)
         if cache is not None:
             new_k = jnp.stack(new_ks)
             new_v = jnp.stack(new_vs)
+            if cache.quantized and not xla_cached:
+                new_k_scale = jnp.stack(new_kss)
+                new_v_scale = jnp.stack(new_vss)
     if cache is not None and xla_cached:
         # new_k/new_v hold the per-layer NEW projections [L, B, T, KVH, hd];
         # one in-place write (per array) lands them all in the cache —
@@ -655,19 +799,7 @@ def forward(
                 cache.v, new_v.astype(cache.v.dtype), (0, 0, cache.index, 0, 0)
             )
 
-    if compute_logits:
-        x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
-        if config.tie_word_embeddings:
-            kernel = params["embed"]["embedding"].T
-        else:
-            kernel = params["lm_head"]
-        logits = qeinsum(
-            x, kernel, "btd,dv->btv", adt,
-            preferred_element_type=jnp.dtype(config.logits_dtype),
-        ).astype(config.logits_dtype)
-        logits = constrain(logits, "data", "seq", "tensor")
-    else:
-        logits = None
+    logits = lm_head_logits(params, x, config) if compute_logits else None
 
     if cache is not None:
         new_cache = KVCache(
@@ -676,3 +808,107 @@ def forward(
         )
         return logits, new_cache
     return logits, None
+
+
+def paged_forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    config: LLaMAConfig,
+    cache: PagedKVCache,
+    attn_mask: Optional[jnp.ndarray] = None,
+    compute_logits: bool = True,
+) -> Tuple[Optional[jnp.ndarray], PagedKVCache]:
+    """One T=1 decode step over a paged block pool (continuous batching).
+
+    The Pallas paged-attention kernel chases ``cache.table`` inside its
+    BlockSpec index maps, so each layer's pool is read ONCE per step —
+    no gathered contiguous view exists (the pool bytes previously moved
+    three times per step: gather read, gather write, attention read).
+    The pool rides the layer scan immutably; the step's new K/V land via
+    one scatter per array afterwards, mirroring the xla_cached contract.
+
+    Rows with ``attn_mask`` False (or position -1) are inactive: they
+    attend nothing, their logits are garbage the host ignores, and their
+    scatter resolves to the sentinel block id and is dropped.
+    """
+    B, T = tokens.shape
+    if T != 1:
+        raise NotImplementedError(
+            "paged_forward is a T=1 decode step; multi-token forwards "
+            "(prefill, speculative verify) use the gathered-view path"
+        )
+    adt = config.activation_dtype
+    if attn_mask is None:
+        attn_mask = positions >= 0
+    q_positions = jnp.maximum(positions, 0)
+    NB, BLK = cache.pos.shape
+    MB = cache.table.shape[1]
+
+    max_positions = max(2 * config.max_seq_len, MB * BLK)
+    cos, sin = _rope_tables(
+        config.head_dim, max_positions, config.rope_theta,
+        config.use_scaled_rope,
+    )
+
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(adt)
+    q_pos_row = jnp.where(attn_mask[:, 0], positions[:, 0], -1).astype(
+        jnp.int32
+    )
+
+    block = functools.partial(
+        _block,
+        config=config,
+        positions=q_positions,
+        bias=None,
+        slot_pos=cache.pos,
+        cache_index=None,
+        cos=cos,
+        sin=sin,
+        impl="paged",
+        paged_pos=cache.pos,
+        paged_table=cache.table,
+        paged_qpos=q_pos_row,
+    )
+
+    lp = params["layers"]
+    if config.scan_layers:
+        def scan_fn(carry, xs):
+            layer_params, ck, cv = xs
+            y, ck, cv, _, _ = block(carry, layer_params, ck, cv)
+            return y, (ck, cv)
+
+        x, (new_k, new_v) = lax.scan(scan_fn, x, (lp, cache.k, cache.v))
+    else:
+        new_ks, new_vs = [], []
+        for i in range(config.n_layers):
+            layer_params = jax.tree.map(lambda a: a[i], lp)
+            x, ck, cv, _, _ = block(x, layer_params, cache.k[i], cache.v[i])
+            new_ks.append(ck)
+            new_vs.append(cv)
+        new_k, new_v = jnp.stack(new_ks), jnp.stack(new_vs)
+
+    logits = lm_head_logits(params, x, config) if compute_logits else None
+
+    # Land the step's projections via the shared write-back contract
+    # (paged_write_indices — same function serving's gathered-view
+    # scatter uses, so the two paths cannot drift).
+    active = attn_mask[:, 0]
+    blk_idx, off = paged_write_indices(
+        cache.table, cache.fill, active, 1, NB, BLK
+    )  # [B, 1] each
+    upd_k = jnp.moveaxis(new_k, 3, 1)  # [L, B, 1, KVH, hd] -> [L, KVH, B, 1, hd]
+    upd_v = jnp.moveaxis(new_v, 3, 1)
+    new_cache = dataclasses.replace(
+        cache,
+        k=cache.k.at[:, :, blk_idx, off].set(
+            upd_k.astype(cache.k.dtype), mode="drop"
+        ),
+        v=cache.v.at[:, :, blk_idx, off].set(
+            upd_v.astype(cache.v.dtype), mode="drop"
+        ),
+        pos=cache.pos.at[blk_idx, off].set(
+            jnp.where(active, positions[:, 0], -1)[:, None], mode="drop"
+        ),
+    )
+    return logits, new_cache

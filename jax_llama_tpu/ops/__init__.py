@@ -1,12 +1,13 @@
 from .norm import rms_norm
 from .rope import rope_table, apply_rope
 from .attention import sdpa, repeat_kv, attention_bias, NEG_INF
-from .flash_attention import flash_attention
+from .flash_attention import flash_attention, flash_attention_quantized
 from .sampling import sample, greedy, top_p_filter, top_k_filter
 from .quant import QuantizedTensor, quantize, quantize_params, is_quantized
 
 __all__ = [
     "flash_attention",
+    "flash_attention_quantized",
     "QuantizedTensor",
     "quantize",
     "quantize_params",

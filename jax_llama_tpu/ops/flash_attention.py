@@ -45,16 +45,23 @@ _SUBLANES = 8  # TPU sublane width (fp32/int32)
 
 
 def _flash_kernel(
+    kv_bound_ref,  # [B * nq] int32 scalar-prefetch: kv-block grid bound
     q_pos_ref,  # [1, bq, LANES] int32 (lane-replicated)
     kv_pos_ref,  # [1, SUBLANES, bk] int32 (sublane-replicated)
     q_ref,  # [1, 1, bq, d]
-    k_ref,  # [1, 1, bk, d]
-    v_ref,  # [1, 1, bk, d]
-    o_ref,  # [1, 1, bq, d]
-    *rest,  # (lse_ref,) when with_lse, then m/l/acc scratch
+    k_ref,  # [1, 1, bk, d] (int8 when quantized)
+    v_ref,  # [1, 1, bk, d] (int8 when quantized)
+    *rest,  # [k_scale_ref, v_scale_ref] when quantized; o_ref;
+    #         (lse_ref,) when with_lse; then m/l/acc scratch
     scale: float,
     with_lse: bool,
+    quantized: bool = False,
 ):
+    if quantized:
+        k_scale_ref, v_scale_ref, *rest = rest  # [1, 1, SUBLANES, bk] fp32
+    else:
+        k_scale_ref = v_scale_ref = None
+    o_ref, *rest = rest
     if with_lse:
         lse_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -73,6 +80,12 @@ def _flash_kernel(
     qp = q_pos_ref[0, :, :1]  # [bq, 1]
     kp = kv_pos_ref[0, :1, :]  # [1, bk]
 
+    # Grid-level dead-block skip: past this q block's kv bound the index
+    # maps clamp to the boundary block (already-fetched — no new DMA) and
+    # the tile must not be processed again.
+    in_bound = ki < kv_bound_ref[
+        pl.program_id(0) * pl.num_programs(2) + pl.program_id(2)
+    ]
     # Block-level causal skip: if the smallest *live* kv position in this
     # block exceeds every query position, no (q, kv) pair is attendable and
     # both dots + the softmax update can be skipped — for standard causal
@@ -80,18 +93,30 @@ def _flash_kernel(
     # Padding slots (-1) don't count as live; an all-padding block is
     # skipped too (the finalize guards l == 0 for rows that never attend).
     live_kp = jnp.where(kp >= 0, kp, jnp.iinfo(jnp.int32).max)
-    block_live = jnp.min(live_kp) <= jnp.max(qp)
+    block_live = in_bound & (jnp.min(live_kp) <= jnp.max(qp))
 
     @pl.when(block_live)
     def _compute():
         q = q_ref[0, 0]  # [bq, d]
-        k = k_ref[0, 0]  # [bk, d]
+        if quantized:
+            # int8 KV: cast the payload tile to the compute dtype in VMEM
+            # (int8 magnitudes <= 127 are exact in bf16) and fold the
+            # per-slot dequant scale into the SCORES — constant along d,
+            # it commutes with the contraction, so HBM only ever streams
+            # the int8 bytes (half the cache traffic of bf16).
+            k = k_ref[0, 0].astype(q.dtype)
+            ksc = k_scale_ref[0, 0, :1, :]  # [1, bk] fp32
+        else:
+            k = k_ref[0, 0]  # [bk, d]
+            ksc = None
         # NB: folding the scale into q outside the kernel was tried and
         # measured ~15% SLOWER on v5e (A/B, min-of-5 differencing) — the
         # fused multiply here rides the MXU output for free.
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
+        if quantized:
+            s = s * ksc
         allowed = (kp <= qp) & (kp >= 0)
         s = jnp.where(allowed, s, MASK_VALUE)
 
@@ -102,8 +127,16 @@ def _flash_kernel(
         p = jnp.exp(s - m_new)  # [bq, bk]
 
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        if quantized:
+            # v_scale folds into the (tiny) probabilities, mirroring
+            # sdpa_cached's weights-level folding on the XLA path.
+            pv = (p * v_scale_ref[0, 0, :1, :]).astype(q.dtype)
+            vb = v_ref[0, 0].astype(q.dtype)
+        else:
+            pv = p.astype(v_ref.dtype)
+            vb = v_ref[0, 0]
         acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            pv, vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -192,6 +225,61 @@ def flash_attention(
     return _flash(q, k, v, q_pos, kv_pos, block_q, block_k, interpret)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+)
+def flash_attention_quantized(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    kv_pos: jnp.ndarray,
+    block_q: int = 512,
+    block_k: int = 2048,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash attention over an int8 KV cache (inference-only, no VJP).
+
+    Same semantics as ``flash_attention`` with
+    ``k[b,s,h,:] * k_scale[b,s,h]`` / ``v * v_scale`` as the effective
+    keys/values — but the dequantization happens inside the kernel
+    (scores-level for K, probability-level for V, matching
+    ``ops.attention.sdpa_cached``'s folding), so HBM streams the int8
+    payload, never a dequantized copy.
+
+    Args:
+      q: [B, T, H, d] activation dtype.
+      k, v: [B, S, KVH, d] int8.
+      k_scale, v_scale: [B, S, KVH] fp32 per-slot-per-head scales.
+      q_pos, kv_pos, block_q, block_k: as in ``flash_attention``.
+    """
+    H, KVH = q.shape[2], k.shape[2]
+    assert H % KVH == 0, (H, KVH)
+    group = H // KVH
+    if group > 1:
+        # Same GQA query packing as flash_attention: scales are per KV
+        # head, so they need no relayout.
+        B, T = q.shape[:2]
+        qp = jnp.moveaxis(
+            q.reshape(B, T, KVH, group, -1), 3, 1
+        ).reshape(B, group * T, KVH, -1)
+        pos_p = jnp.tile(q_pos, (1, group))
+        out = _flash_forward(
+            qp, k, v, pos_p, kv_pos, block_q, block_k, interpret,
+            k_scale=k_scale, v_scale=v_scale,
+        )
+        out = jnp.moveaxis(
+            out.reshape(B, group, T, KVH, -1), 1, 3
+        ).reshape(B, T, H, -1)
+        return out
+    return _flash_forward(
+        q, k, v, q_pos, kv_pos, block_q, block_k, interpret,
+        k_scale=k_scale, v_scale=v_scale,
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def _flash(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
     return _flash_forward(q, k, v, q_pos, kv_pos, block_q, block_k, interpret)
@@ -243,12 +331,14 @@ def _clamp_blocks(T, S, block_q, block_k, interpret):
 
 
 def _flash_forward(
-    q, k, v, q_pos, kv_pos, block_q, block_k, interpret, need_lse=False
+    q, k, v, q_pos, kv_pos, block_q, block_k, interpret, need_lse=False,
+    k_scale=None, v_scale=None,
 ):
     B, T, H, d = q.shape
     S, KVH = k.shape[1], k.shape[2]
     assert H % KVH == 0, (H, KVH)
     group = H // KVH
+    quantized = k_scale is not None
     scale = 1.0 / (d ** 0.5)
     interpret = _resolve_interpret(interpret)
     block_q, block_k = _clamp_blocks(T, S, block_q, block_k, interpret)
@@ -268,10 +358,38 @@ def _flash_forward(
     kv_pos_r = jnp.broadcast_to(kv_pos_p[:, None, :], (B, _SUBLANES, Sp))
 
     grid = (B, H, nq, nk)
-    out_shape = jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype)
-    out_spec = pl.BlockSpec(
-        (1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)
+
+    # Per-(batch, q-block) kv grid bound: 1 + the last kv block holding any
+    # live slot some query in the q block may attend.  Blocks at/after the
+    # bound are clamped in the index maps below — consecutive grid steps
+    # then request the SAME tile, and the Pallas pipeline skips the DMA —
+    # and the kernel skips their compute via the prefetched bound.  For
+    # causal prefill this removes the dead upper-triangle K/V traffic that
+    # the in-kernel block_live check alone still paid bandwidth for.
+    qmax = jnp.max(q_pos_r[:, :, 0].reshape(B, nq, block_q), axis=2)
+    kmin = jnp.min(
+        jnp.where(
+            kv_pos_p >= 0, kv_pos_p, jnp.iinfo(jnp.int32).max
+        ).reshape(B, nk, block_k),
+        axis=2,
     )
+    attendable = kmin[:, None, :] <= qmax[:, :, None]  # [B, nq, nk]
+    kv_bound = 1 + jnp.max(
+        jnp.where(
+            attendable, jnp.arange(nk, dtype=jnp.int32)[None, None, :], -1
+        ),
+        axis=2,
+    )  # [B, nq], values in [0, nk]
+    kv_bound_flat = kv_bound.reshape(B * nq)
+
+    def _clamp_ki(b, qi, ki, bound):
+        return jnp.minimum(ki, jnp.maximum(bound[b * nq + qi] - 1, 0))
+
+    def q_row(b, h, qi, ki, bound):
+        return (b, h, qi, 0)
+
+    out_shape = jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype)
+    out_spec = pl.BlockSpec((1, 1, block_q, d), q_row)
     if need_lse:
         # Lane-replicated row logsumexp for the backward kernels.
         out_shape = (
@@ -280,39 +398,65 @@ def _flash_forward(
         )
         out_spec = (
             out_spec,
-            pl.BlockSpec(
-                (1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)
+            pl.BlockSpec((1, 1, block_q, _LANES), q_row),
+        )
+    in_specs = [
+        pl.BlockSpec(
+            (1, block_q, _LANES), lambda b, h, qi, ki, bound: (b, qi, 0)
+        ),
+        pl.BlockSpec(
+            (1, _SUBLANES, block_k),
+            lambda b, h, qi, ki, bound: (b, 0, _clamp_ki(b, qi, ki, bound)),
+        ),
+        pl.BlockSpec((1, 1, block_q, d), q_row),
+        pl.BlockSpec(
+            (1, 1, block_k, d),
+            lambda b, h, qi, ki, bound: (
+                b, h // group, _clamp_ki(b, qi, ki, bound), 0
+            ),
+        ),
+        pl.BlockSpec(
+            (1, 1, block_k, d),
+            lambda b, h, qi, ki, bound: (
+                b, h // group, _clamp_ki(b, qi, ki, bound), 0
+            ),
+        ),
+    ]
+    operands = [q_pos_r, kv_pos_r, qt, kt, vt]
+    if quantized:
+        # Sublane-replicated per-slot scale planes [B, KVH, SUBLANES, Sp],
+        # blocked along the kv axis like kv_pos.
+        def _scale_plane(s):
+            st = _pad_to(jnp.moveaxis(s, 2, 1).astype(jnp.float32), 2, block_k)
+            return jnp.broadcast_to(
+                st[:, :, None, :], (B, KVH, _SUBLANES, Sp)
+            )
+
+        scale_spec = pl.BlockSpec(
+            (1, 1, _SUBLANES, block_k),
+            lambda b, h, qi, ki, bound: (
+                b, h // group, 0, _clamp_ki(b, qi, ki, bound)
             ),
         )
+        in_specs += [scale_spec, scale_spec]
+        operands += [_scale_plane(k_scale), _scale_plane(v_scale)]
     out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, with_lse=need_lse),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(
-                (1, block_q, _LANES), lambda b, h, qi, ki: (b, qi, 0)
-            ),
-            pl.BlockSpec(
-                (1, _SUBLANES, block_k), lambda b, h, qi, ki: (b, 0, ki)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d),
-                lambda b, h, qi, ki: (b, h // group, ki, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d),
-                lambda b, h, qi, ki: (b, h // group, ki, 0),
-            ),
-        ],
-        out_specs=out_spec,
+        functools.partial(
+            _flash_kernel, scale=scale, with_lse=need_lse,
+            quantized=quantized,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_spec,
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+        ),
         out_shape=out_shape,
-        scratch_shapes=[
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
         # batch/head/q-block are independent ("parallel"); only the k sweep
         # carries state through scratch ("arbitrary").  Without this hint
         # Mosaic treats the whole grid as sequential and cannot pipeline
@@ -321,7 +465,7 @@ def _flash_forward(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q_pos_r, kv_pos_r, qt, kt, vt)
+    )(kv_bound_flat, *operands)
     if need_lse:
         out, lse = out
         return jnp.swapaxes(out[:, :, :T, :], 1, 2), lse

@@ -1,0 +1,232 @@
+"""Pallas TPU paged-attention decode kernel — walks the block table
+in-kernel.
+
+The serving pool stores KV in fixed-size physical blocks
+(``serving.BlockPool``); before this kernel, every decode step gathered
+each row's blocks into a virtually-contiguous cache view and ran
+``sdpa_cached`` over it — the pool bytes moved three times per step
+(gather read, gather write, attention read).  Here the kernel's index
+maps chase the block table directly via scalar prefetch, so the pool is
+read ONCE and nothing contiguous is ever materialized (the vLLM
+paged-attention idea, executed the Pallas way: the table lookup lives in
+the BlockSpec index_map, the DMA pipeline does the pointer-chasing).
+
+Layout contract: the pool is [KVH, NB, BLK, hd] per layer — KV-head
+major, so one (head, block) tile is a clean ``(BLK, hd)`` VMEM page.
+Grid is ``(B, KVH, MB)`` with the per-row block sweep innermost; online
+softmax state lives in VMEM scratch across the sweep, exactly like
+``ops.flash_attention``.  GQA: the ``group`` query heads of each KV head
+ride the sublane axis of a single q tile (padded to 8), so decode reads
+each KV block once per KV head — never per query head.
+
+The kernel attends the POOL only and emits a normalized output plus the
+row logsumexp; the caller merges the current step's own K/V (one slot,
+always attendable) at the scores level — the same two-source softmax
+split as ``ops.attention.sdpa_cached``, so the pool stays immutable
+through the layer scan and the decode step applies one scatter per step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import MASK_VALUE, _LANES, _SUBLANES, _resolve_interpret
+
+
+def _paged_kernel(
+    tbl_ref,    # [B * MB] int32 scalar-prefetch: physical block id (NB = dead)
+    qpos_ref,   # [B] int32 scalar-prefetch: query position (-1 = inactive row)
+    q_ref,      # [1, 1, G8, d]
+    k_ref,      # [1, 1, BLK, d]
+    v_ref,      # [1, 1, BLK, d]
+    pos_ref,    # [1, SUBLANES, BLK] int32 slot positions of the block
+    o_ref,      # [1, 1, G8, d]
+    lse_ref,    # [1, 1, G8, LANES] fp32
+    m_ref, l_ref, acc_ref,  # VMEM scratch
+    *,
+    scale: float,
+    n_blocks: int,
+):
+    b = pl.program_id(0)
+    mb = pl.program_id(2)
+    nmb = pl.num_programs(2)
+
+    @pl.when(mb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, MASK_VALUE)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    qp = qpos_ref[b]
+    kp = pos_ref[0, :1, :]  # [1, BLK]
+    live = (tbl_ref[b * nmb + mb] < n_blocks) & (qp >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0]  # [G8, d]
+        s = jax.lax.dot_general(
+            q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G8, BLK]
+        allowed = (kp >= 0) & (kp <= qp)
+        s = jnp.where(allowed, s, MASK_VALUE)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape,
+        )
+        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(mb == nmb - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype
+        )
+        # lse stays ~MASK_VALUE for rows that attended nothing, so the
+        # caller's merge weight exp(lse - m_tot) underflows to exactly 0.
+        lse_ref[0, 0] = m_ref[:] + jnp.log(
+            jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        )
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_pool_attention(
+    q: jnp.ndarray,        # [B, KVH, G, d]  (grouped queries)
+    k_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
+    v_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
+    pool_pos: jnp.ndarray,  # [NB, BLK] int32 (-1 = invalid slot)
+    table: jnp.ndarray,    # [B, MB] int32 physical block ids (NB = unused)
+    q_pos: jnp.ndarray,    # [B] int32 (-1 = inactive row)
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Attend each row's table-mapped pool blocks; no gather, pool read once.
+
+    Returns (out [B, KVH, G, d] normalized over the pool slots,
+    lse [B, KVH, G] fp32 row logsumexp) for the caller's new-token merge.
+    """
+    B, KVH, G, d = q.shape
+    NB, BLK = pool_pos.shape
+    MB = table.shape[1]
+    assert k_pool.shape == (KVH, NB, BLK, d), (k_pool.shape, (KVH, NB, BLK, d))
+    interpret = _resolve_interpret(interpret)
+    G8 = _round_up(G, _SUBLANES)
+    qg = jnp.pad(q, ((0, 0), (0, 0), (0, G8 - G), (0, 0)))
+    scale = 1.0 / (d ** 0.5)
+
+    # Sublane-replicated position planes (Mosaic last-two-dims tiling).
+    pos_r = jnp.broadcast_to(pool_pos[:, None, :], (NB, _SUBLANES, BLK))
+    tbl_flat = table.astype(jnp.int32).reshape(B * MB)
+
+    def kv_map(b, h, mb, tbl, qpos):
+        return (h, jnp.minimum(tbl[b * MB + mb], NB - 1), 0, 0)
+
+    def pos_map(b, h, mb, tbl, qpos):
+        return (jnp.minimum(tbl[b * MB + mb], NB - 1), 0, 0)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, n_blocks=NB),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, KVH, MB),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, G8, d), lambda b, h, mb, tbl, qpos: (b, h, 0, 0)
+                ),
+                pl.BlockSpec((1, 1, BLK, d), kv_map),
+                pl.BlockSpec((1, 1, BLK, d), kv_map),
+                pl.BlockSpec((1, _SUBLANES, BLK), pos_map),
+            ],
+            out_specs=(
+                pl.BlockSpec(
+                    (1, 1, G8, d), lambda b, h, mb, tbl, qpos: (b, h, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, G8, _LANES),
+                    lambda b, h, mb, tbl, qpos: (b, h, 0, 0),
+                ),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((G8, _LANES), jnp.float32),
+                pltpu.VMEM((G8, _LANES), jnp.float32),
+                pltpu.VMEM((G8, d), jnp.float32),
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, KVH, G8, d), q.dtype),
+            jax.ShapeDtypeStruct((B, KVH, G8, _LANES), jnp.float32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(tbl_flat, q_pos.astype(jnp.int32), qg, k_pool, v_pool, pos_r)
+    return out[:, :, :G, :], lse[:, :, :G, 0]
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,        # [B, 1, H, d] — this step's queries
+    k_new: jnp.ndarray,    # [B, 1, KVH, d] — this step's projections
+    v_new: jnp.ndarray,    # [B, 1, KVH, d]
+    k_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
+    v_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
+    pool_pos: jnp.ndarray,  # [NB, BLK]
+    table: jnp.ndarray,    # [B, MB]
+    q_pos: jnp.ndarray,    # [B] (-1 = inactive)
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """One decode step of attention over (pool blocks ∪ the new slot).
+
+    The pool pass runs in the Pallas kernel; the new token's single slot
+    (score ``q·k_new``, always attendable for an active row — a token may
+    attend itself) merges at the softmax level outside, keeping the pool
+    immutable through the layer scan (same append-free contract as
+    ``sdpa_cached``).  Returns [B, 1, H, d].
+    """
+    B, T, H, d = q.shape
+    assert T == 1, "paged decode attention is a T=1 step"
+    KVH = k_new.shape[2]
+    G = H // KVH
+    scale = 1.0 / (d ** 0.5)
+
+    # Head layout h = kvh * G + g (same contract as flash GQA packing).
+    qg = q[:, 0].reshape(B, KVH, G, d)
+    out_pool, lse = paged_pool_attention(
+        qg, k_pool, v_pool, pool_pos, table, q_pos, interpret=interpret
+    )
+
+    # New-slot scores [B, KVH, G]: the only same-step pair at T=1 is the
+    # token with itself, always allowed.
+    s_new = jnp.einsum(
+        "bkgd,bkd->bkg", qg, k_new[:, 0],
+        preferred_element_type=jnp.float32,
+    ) * scale
+    m_tot = jnp.maximum(lse, s_new)
+    w_pool = jnp.exp(lse - m_tot)
+    w_new = jnp.exp(s_new - m_tot)
+    denom = w_pool + w_new
+    out = (
+        out_pool.astype(jnp.float32) * (w_pool / denom)[..., None]
+        + v_new[:, 0, :, None, :].astype(jnp.float32)
+        * (w_new / denom)[..., None]
+    )
+    return out.reshape(B, 1, H, d).astype(q.dtype)

@@ -20,14 +20,14 @@ TPU-native mechanics:
     than n_slots × max_len (overcommit): requests whose reservation does
     not fit wait in the queue, giving natural backpressure instead of the
     per-slot contiguous regions + power-of-two bucketing this replaces.
-  * **Decode via a gathered view.**  Each step gathers the active block
-    tables into a per-row virtually-contiguous cache and runs the
-    model's per-row-offset forward unchanged; the one new KV entry per
-    row is scattered back to its physical block.  The gather costs one
-    extra KV read/write per step over a contiguous layout — acceptable
-    while decode is weights-bound; a Pallas paged-attention decode
-    kernel that walks the block table in-kernel is the planned
-    replacement.
+  * **Decode via the Pallas paged-attention kernel.**  Each step runs
+    ``models.paged_forward``: the kernel's BlockSpec index maps chase the
+    block table directly (scalar prefetch), so the pool is read ONCE per
+    step and no contiguous view is ever materialized.  A gathered-view
+    fallback (per-row virtually-contiguous cache + the model's
+    per-row-offset forward) remains for int8 pools, meshes, and
+    non-8-multiple block sizes, and serves the multi-token forwards
+    (speculative rounds).
   * **Per-request sampling.**  temperature/top-p/top-k and the PRNG
     chain are per-slot device arrays; each row samples with its own key
     (same warp math as ``ops.sampling.sample``, dynamic per-row), so a
@@ -51,7 +51,13 @@ import numpy as np
 
 from .config import LLaMAConfig
 from .engine import prompt_positions
-from .models.llama import KVCache, forward, init_cache
+from .models.llama import (
+    KVCache,
+    PagedKVCache,
+    forward,
+    init_cache,
+    paged_write_indices,
+)
 from .ops.attention import NEG_INF
 from .parallel.mesh import use_mesh
 
@@ -69,10 +75,12 @@ from .parallel.mesh import use_mesh
 class BlockPool:
     """Paged KV storage shared by all slots.
 
-    k, v: [L, n_blocks, block_size, KVH, hd] (activation dtype or int8).
+    k, v: [L, KVH, n_blocks, block_size, hd] (activation dtype or int8) —
+          KV-head-major, the Pallas paged-attention kernel's layout (one
+          (head, block) tile is a clean (block_size, hd) VMEM page).
     pos:  [n_blocks, block_size] int32 absolute position per cache slot;
           -1 marks invalid (free block / unwritten / rolled back).
-    k_scale, v_scale: [L, n_blocks, block_size, KVH] fp32 (int8 pool only).
+    k_scale, v_scale: [L, KVH, n_blocks, block_size] fp32 (int8 pool only).
     """
 
     k: jnp.ndarray
@@ -83,11 +91,11 @@ class BlockPool:
 
     @property
     def n_blocks(self) -> int:
-        return self.k.shape[1]
+        return self.k.shape[2]
 
     @property
     def block_size(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
     @property
     def quantized(self) -> bool:
@@ -101,7 +109,7 @@ def init_pool(
     int8_kv = config.kv_cache_dtype == "int8"
     dtype = jnp.int8 if int8_kv else config.activation_dtype
     shape = (
-        config.n_layers, n_blocks, block_size, config.kv_heads,
+        config.n_layers, config.kv_heads, n_blocks, block_size,
         config.head_dim,
     )
     return BlockPool(
@@ -125,22 +133,26 @@ def _gather_cache(
     positions are forced to -1 via n_alloc so the garbage is never
     attended.
     """
-    L, NB, BLK, KVH, hd = pool.k.shape
+    L, KVH, NB, BLK, hd = pool.k.shape
     B, MB = table.shape
     # mode="clip": sentinel (out-of-range) table entries gather a real
     # block's finite values — the default "fill" mode would inject NaN,
     # which survives the additive -inf mask (NaN + -inf = NaN) and poisons
     # the softmax.  Clipped garbage is masked via n_alloc below.
     take = functools.partial(jnp.take, mode="clip")
-    kg = take(pool.k, table, axis=1).reshape(L, B, MB * BLK, KVH, hd)
-    vg = take(pool.v, table, axis=1).reshape(L, B, MB * BLK, KVH, hd)
+
+    def g(a):  # [L, KVH, NB, BLK, ...] -> [L, B, MB*BLK, KVH, ...]
+        out = take(a, table, axis=2)  # [L, KVH, B, MB, BLK, ...]
+        out = out.reshape(a.shape[:2] + (B, MB * BLK) + a.shape[4:])
+        return jnp.moveaxis(out, 1, 3)
+
+    kg, vg = g(pool.k), g(pool.v)
     posg = take(pool.pos, table, axis=0).reshape(B, MB * BLK)
     valid = jnp.arange(MB, dtype=jnp.int32)[None, :] < n_alloc[:, None]
     posg = jnp.where(jnp.repeat(valid, BLK, axis=1), posg, -1)
     ks = vs = None
     if pool.quantized:
-        ks = take(pool.k_scale, table, axis=1).reshape(L, B, MB * BLK, KVH)
-        vs = take(pool.v_scale, table, axis=1).reshape(L, B, MB * BLK, KVH)
+        ks, vs = g(pool.k_scale), g(pool.v_scale)
     return KVCache(k=kg, v=vg, pos=posg, index=fill, k_scale=ks, v_scale=vs)
 
 
@@ -158,30 +170,32 @@ def _scatter_back(
     NB, BLK = pool.pos.shape
     B, MB = table.shape
     rows = jnp.arange(B, dtype=jnp.int32)[:, None]
-    cols = fill[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
-    safe_cols = jnp.minimum(cols, MB * BLK - 1)
-    blk = jnp.take_along_axis(table, safe_cols // BLK, axis=1)      # [B, T]
-    blk = jnp.where(
-        active[:, None] & (cols < MB * BLK), blk, NB
+    # Shared write-back contract (same function paged_forward uses).
+    blk, off = paged_write_indices(table, fill, active, T, NB, BLK)
+    safe_cols = jnp.minimum(
+        fill[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :],
+        MB * BLK - 1,
     )
-    off = safe_cols % BLK
-    nk = view.k[:, rows, safe_cols]        # [L, B, T, KVH, hd]
-    nv = view.v[:, rows, safe_cols]
+    # view slices are [L, B, T, KVH, ...]; the pool wants KVH-major.
+    nk = jnp.moveaxis(view.k[:, rows, safe_cols], 3, 1)   # [L, KVH, B, T, hd]
+    nv = jnp.moveaxis(view.v[:, rows, safe_cols], 3, 1)
     npos = view.pos[rows, safe_cols]       # [B, T]
     new = dataclasses.replace(
         pool,
-        k=pool.k.at[:, blk, off].set(nk, mode="drop"),
-        v=pool.v.at[:, blk, off].set(nv, mode="drop"),
+        k=pool.k.at[:, :, blk, off].set(nk, mode="drop"),
+        v=pool.v.at[:, :, blk, off].set(nv, mode="drop"),
         pos=pool.pos.at[blk, off].set(npos, mode="drop"),
     )
     if pool.quantized:
         new = dataclasses.replace(
             new,
-            k_scale=pool.k_scale.at[:, blk, off].set(
-                view.k_scale[:, rows, safe_cols], mode="drop"
+            k_scale=pool.k_scale.at[:, :, blk, off].set(
+                jnp.moveaxis(view.k_scale[:, rows, safe_cols], 3, 1),
+                mode="drop",
             ),
-            v_scale=pool.v_scale.at[:, blk, off].set(
-                view.v_scale[:, rows, safe_cols], mode="drop"
+            v_scale=pool.v_scale.at[:, :, blk, off].set(
+                jnp.moveaxis(view.v_scale[:, rows, safe_cols], 3, 1),
+                mode="drop",
             ),
         )
     return new
@@ -266,15 +280,38 @@ def _paged_decode_step(
     path (the host flips to the sampling variant the moment a sampled
     request is admitted; greedy rows' key chains are never consumed, so
     skipping the split here is unobservable).
+
+    Attention path: the Pallas paged kernel walks the block table
+    in-kernel (pool read once per step).  Fallbacks to the gathered
+    contiguous view: int8 pools (the kernel is dense-only), meshes
+    (a pallas_call inside pjit is not auto-partitioned), and block sizes
+    that break Mosaic's 8-sublane tiling.
     """
     with use_mesh(mesh):
-        view = _gather_cache(pool, table, n_alloc, fill)
         positions = jnp.where(active, pos, -1)[:, None]
-        logits, view = forward(
-            params, tau[:, None], positions, config, cache=view,
-            attn_mask=active[:, None],
+        use_kernel = (
+            not pool.quantized and mesh is None
+            and pool.block_size % 8 == 0
         )
-        pool = _scatter_back(pool, view, table, fill, active, T=1)
+        if use_kernel:
+            pcache = PagedKVCache(
+                k=pool.k, v=pool.v, pos=pool.pos,
+                table=table, fill=fill,
+            )
+            logits, pcache = forward(
+                params, tau[:, None], positions, config, cache=pcache,
+                attn_mask=active[:, None],
+            )
+            pool = dataclasses.replace(
+                pool, k=pcache.k, v=pcache.v, pos=pcache.pos
+            )
+        else:
+            view = _gather_cache(pool, table, n_alloc, fill)
+            logits, view = forward(
+                params, tau[:, None], positions, config, cache=view,
+                attn_mask=active[:, None],
+            )
+            pool = _scatter_back(pool, view, table, fill, active, T=1)
         if all_greedy:
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         else:
@@ -323,26 +360,28 @@ def _paged_insert(
         )[0]
         plen = jnp.sum(prompt_mask.astype(jnp.int32))
 
-        L, _, _, KVH, hd = pool.k.shape
+        L, KVH, _, _, hd = pool.k.shape
         nb = P // BLK
+
+        def to_blocks(a):  # [L, 1, P, KVH, ...] -> [L, KVH, nb, BLK, ...]
+            return jnp.moveaxis(a[:, 0], 2, 1).reshape(
+                (L, KVH, nb, BLK) + a.shape[4:]
+            )
+
         pool = dataclasses.replace(
             pool,
-            k=pool.k.at[:, block_ids].set(
-                sub.k[:, 0].reshape(L, nb, BLK, KVH, hd)
-            ),
-            v=pool.v.at[:, block_ids].set(
-                sub.v[:, 0].reshape(L, nb, BLK, KVH, hd)
-            ),
+            k=pool.k.at[:, :, block_ids].set(to_blocks(sub.k)),
+            v=pool.v.at[:, :, block_ids].set(to_blocks(sub.v)),
             pos=pool.pos.at[block_ids].set(sub.pos[0].reshape(nb, BLK)),
         )
         if pool.quantized:
             pool = dataclasses.replace(
                 pool,
-                k_scale=pool.k_scale.at[:, block_ids].set(
-                    sub.k_scale[:, 0].reshape(L, nb, BLK, KVH)
+                k_scale=pool.k_scale.at[:, :, block_ids].set(
+                    to_blocks(sub.k_scale)
                 ),
-                v_scale=pool.v_scale.at[:, block_ids].set(
-                    sub.v_scale[:, 0].reshape(L, nb, BLK, KVH)
+                v_scale=pool.v_scale.at[:, :, block_ids].set(
+                    to_blocks(sub.v_scale)
                 ),
             )
         return tau, plen, key, pool

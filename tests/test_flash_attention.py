@@ -97,6 +97,32 @@ def test_flash_single_query_decode_shape():
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
+def test_flash_quantized_matches_dequantized_reference():
+    """flash_attention_quantized's in-kernel scale folding must equal
+    dense attention over the explicitly dequantized K/V (scales are
+    constant along head_dim, so the folding is exact up to fp order)."""
+    from jax_llama_tpu.models.llama import quantize_kv
+    from jax_llama_tpu.ops import flash_attention_quantized
+
+    B, T, S, H, KVH, D = 2, 12, 24, 4, 2, 16
+    q, k, v = _rand(B, T, S, H, KVH, D)
+    kq, ks = quantize_kv(jnp.asarray(k))
+    vq, vs = quantize_kv(jnp.asarray(v))
+    kv_pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    kv_pos[:, 20:] = -1  # unwritten tail
+    q_pos = np.tile(np.arange(S - T - 4, S - 4, dtype=np.int32), (B, 1))
+    got = np.asarray(
+        flash_attention_quantized(
+            jnp.asarray(q), kq, vq, ks, vs,
+            jnp.asarray(q_pos), jnp.asarray(kv_pos), block_q=8, block_k=8,
+        )
+    )
+    k_deq = np.asarray(kq, np.float32) * np.asarray(ks)[..., None]
+    v_deq = np.asarray(vq, np.float32) * np.asarray(vs)[..., None]
+    want = _ref(q, k_deq, v_deq, q_pos, kv_pos)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
 def test_model_forward_flash_matches_xla():
     import jax
 

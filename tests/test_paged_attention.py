@@ -1,0 +1,187 @@
+"""Paged-attention decode kernel: parity vs the gathered-view reference.
+
+The kernel (ops/paged_attention.py) walks the serving block table inside
+its BlockSpec index maps; these tests pin its numerics against dense
+attention over an explicitly gathered contiguous view — the path it
+replaced — including dead table entries, partially-filled blocks,
+inactive rows, and the model-level ``paged_forward`` step.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.models import forward
+from jax_llama_tpu.models.llama import PagedKVCache
+from jax_llama_tpu.ops import attention_bias, sdpa
+from jax_llama_tpu.ops.paged_attention import paged_decode_attention
+from jax_llama_tpu.serving import _gather_cache, init_pool
+
+
+def _random_pool_state(rng, B, KVH, d, NB, BLK, MB, fills):
+    kp = rng.randn(KVH, NB, BLK, d).astype(np.float32)
+    vp = rng.randn(KVH, NB, BLK, d).astype(np.float32)
+    pool_pos = np.full((NB, BLK), -1, np.int32)
+    table = np.full((B, MB), NB, np.int32)
+    free = list(range(NB))
+    for b, fill in enumerate(fills):
+        n = -(-fill // BLK) if fill else 0
+        blocks = [free.pop(0) for _ in range(n)]
+        table[b, :n] = blocks
+        for j, blk in enumerate(blocks):
+            m = min(BLK, fill - j * BLK)
+            pool_pos[blk, :m] = np.arange(j * BLK, j * BLK + m)
+    return kp, vp, pool_pos, table
+
+
+def _reference(q, kn, vn, kp, vp, pool_pos, table, qpos, b):
+    """Dense attention over row b's gathered blocks + the new slot."""
+    NB = kp.shape[1]
+    ks, vs, ps = [], [], []
+    for t in table[b]:
+        if t < NB:
+            ks.append(kp[:, t])
+            vs.append(vp[:, t])
+            ps.append(pool_pos[t])
+    kcat = np.concatenate(
+        ks + [kn[b].transpose(1, 0, 2)], axis=1
+    ).transpose(1, 0, 2)[None]
+    vcat = np.concatenate(
+        vs + [vn[b].transpose(1, 0, 2)], axis=1
+    ).transpose(1, 0, 2)[None]
+    pcat = np.concatenate(ps + [np.array([qpos[b]])])
+    bias = attention_bias(
+        jnp.asarray([[qpos[b]]], jnp.int32), jnp.asarray(pcat[None]),
+        jnp.asarray((pcat >= 0)[None]),
+    )
+    return np.asarray(
+        sdpa(jnp.asarray(q[b:b + 1]), jnp.asarray(kcat), jnp.asarray(vcat),
+             bias)
+    )[0]
+
+
+def test_paged_kernel_matches_gathered_dense():
+    rng = np.random.RandomState(0)
+    B, H, KVH, d = 4, 8, 2, 32
+    NB, BLK, MB = 12, 16, 5
+    # row fills: multi-block, empty (inactive), one block, partial block
+    fills = [40, 0, 16, 7]
+    qpos = np.array([40, -1, 16, 7], np.int32)
+    kp, vp, pool_pos, table = _random_pool_state(
+        rng, B, KVH, d, NB, BLK, MB, fills
+    )
+    q = rng.randn(B, 1, H, d).astype(np.float32)
+    kn = rng.randn(B, 1, KVH, d).astype(np.float32)
+    vn = rng.randn(B, 1, KVH, d).astype(np.float32)
+
+    got = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kn), jnp.asarray(vn),
+        jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(pool_pos),
+        jnp.asarray(table), jnp.asarray(qpos),
+    ))
+    assert np.isfinite(got).all()
+    for b in range(B):
+        if qpos[b] < 0:
+            continue  # inactive row: output is ignored by the host
+        want = _reference(q, kn, vn, kp, vp, pool_pos, table, qpos, b)
+        np.testing.assert_allclose(got[b], want, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_kernel_gqa_head_order():
+    """Query head h must read KV head h // group (the model's layout)."""
+    rng = np.random.RandomState(1)
+    B, H, KVH, d = 1, 4, 2, 16
+    NB, BLK, MB = 4, 8, 2
+    fills = [12]
+    qpos = np.array([12], np.int32)
+    kp, vp, pool_pos, table = _random_pool_state(
+        rng, B, KVH, d, NB, BLK, MB, fills
+    )
+    q = rng.randn(B, 1, H, d).astype(np.float32)
+    kn = rng.randn(B, 1, KVH, d).astype(np.float32)
+    vn = rng.randn(B, 1, KVH, d).astype(np.float32)
+    got = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kn), jnp.asarray(vn),
+        jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(pool_pos),
+        jnp.asarray(table), jnp.asarray(qpos),
+    ))
+    want = _reference(q, kn, vn, kp, vp, pool_pos, table, qpos, 0)
+    np.testing.assert_allclose(got[0], want, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_forward_matches_gathered_view_forward():
+    """A full model step via paged_forward (Pallas kernel + scatter) must
+    match the gathered-view forward (per-row-offset KVCache) it replaced:
+    same logits, and the pool ends in the same state."""
+    import dataclasses
+
+    from jax_llama_tpu.serving import _scatter_back
+
+    config = get_config(
+        "tiny", vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    B, NB, BLK, MB = 3, 8, 8, 3
+    pool = init_pool(config, NB, BLK)
+    rng = np.random.RandomState(2)
+    # Fill pools with random content + consistent positions.
+    pool = dataclasses.replace(
+        pool,
+        k=jnp.asarray(rng.randn(*pool.k.shape), pool.k.dtype),
+        v=jnp.asarray(rng.randn(*pool.v.shape), pool.v.dtype),
+    )
+    fills = [10, 0, 17]
+    qpos = np.array([10, -1, 17], np.int32)
+    pool_pos = np.full((NB, BLK), -1, np.int32)
+    table = np.full((B, MB), NB, np.int32)
+    free = list(range(NB))
+    n_alloc = np.zeros((B,), np.int32)
+    for b, fill in enumerate(fills):
+        n = -(-fill // BLK) if fill else 0
+        blocks = [free.pop(0) for _ in range(n)]
+        table[b, :n] = blocks
+        n_alloc[b] = n
+        for j, blk in enumerate(blocks):
+            m = min(BLK, fill - j * BLK)
+            pool_pos[blk, :m] = np.arange(j * BLK, j * BLK + m)
+    pool = dataclasses.replace(pool, pos=jnp.asarray(pool_pos))
+
+    tau = jnp.asarray(rng.randint(0, 128, (B,)), jnp.int32)
+    active = jnp.asarray(qpos >= 0)
+    positions = jnp.asarray(qpos, jnp.int32)[:, None]
+    fill_arr = jnp.asarray(fills, jnp.int32)
+    tbl = jnp.asarray(table)
+
+    # Gathered-view path.
+    view = _gather_cache(pool, tbl, jnp.asarray(n_alloc), fill_arr)
+    want_logits, view = forward(
+        params, tau[:, None], positions, config, cache=view,
+        attn_mask=active[:, None],
+    )
+    want_pool = _scatter_back(pool, view, tbl, fill_arr, active, T=1)
+
+    # Paged kernel path.
+    pcache = PagedKVCache(
+        k=pool.k, v=pool.v, pos=pool.pos, table=tbl, fill=fill_arr
+    )
+    got_logits, pcache = forward(
+        params, tau[:, None], positions, config, cache=pcache,
+        attn_mask=active[:, None],
+    )
+
+    act = np.asarray(active)
+    np.testing.assert_allclose(
+        np.asarray(got_logits)[act], np.asarray(want_logits)[act],
+        atol=1e-4, rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pcache.k), np.asarray(want_pool.k), atol=1e-5, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(pcache.v), np.asarray(want_pool.v), atol=1e-5, rtol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pcache.pos), np.asarray(want_pool.pos)
+    )
