@@ -150,7 +150,7 @@ def paged_write_indices(
     T: int,
     n_blocks: int,
     block_size: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Physical (block, offset) pairs for landing T new per-row entries.
 
     THE paged write-back contract, shared by ``paged_forward`` and
@@ -159,7 +159,11 @@ def paged_write_indices(
     ``(fill[b]+j) % BLK``; inactive rows and columns past the row's
     reserved capacity resolve to the sentinel block id ``n_blocks``
     (callers scatter with ``mode="drop"``).
-    Returns (blk [B, T], off [B, T]) int32.
+
+    Returns (blk [B, T], off [B, T], cols [B, T]) int32 — ``cols`` is
+    the clamped per-row view column each (blk, off) pair corresponds to,
+    so callers that read values out of a virtually-contiguous view use
+    the same clamping as the slot derivation.
     """
     MB = table.shape[1]
     cols = fill[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
@@ -168,7 +172,7 @@ def paged_write_indices(
     blk = jnp.where(
         active[:, None] & (cols < MB * block_size), blk, n_blocks
     )
-    return blk, safe % block_size
+    return blk, safe % block_size, safe
 
 
 def lm_head_logits(
@@ -894,7 +898,7 @@ def paged_forward(
     # (paged_write_indices — same function serving's gathered-view
     # scatter uses, so the two paths cannot drift).
     active = attn_mask[:, 0]
-    blk_idx, off = paged_write_indices(
+    blk_idx, off, _ = paged_write_indices(
         cache.table, cache.fill, active, 1, NB, BLK
     )  # [B, 1] each
     upd_k = jnp.moveaxis(new_k, 3, 1)  # [L, B, 1, KVH, hd] -> [L, KVH, B, 1, hd]

@@ -43,6 +43,7 @@ from .flash_attention import MASK_VALUE, _LANES, _SUBLANES, _resolve_interpret
 def _paged_kernel(
     tbl_ref,    # [B * MB] int32 scalar-prefetch: physical block id (NB = dead)
     qpos_ref,   # [B] int32 scalar-prefetch: query position (-1 = inactive row)
+    bound_ref,  # [B] int32 scalar-prefetch: live-block grid bound per row
     q_ref,      # [1, 1, G8, d]
     k_ref,      # [1, 1, BLK, d]
     v_ref,      # [1, 1, BLK, d]
@@ -66,7 +67,20 @@ def _paged_kernel(
 
     qp = qpos_ref[b]
     kp = pos_ref[0, :1, :]  # [1, BLK]
-    live = (tbl_ref[b * nmb + mb] < n_blocks) & (qp >= 0)
+    # Three dead-block guards, all mandatory:
+    #   * mb >= bound: past the row's last attendable block — the index
+    #     maps clamped the fetch (no new DMA); the tile is a repeat.
+    #   * table sentinel / inactive row.
+    #   * all-masked tile (min live kp > qp): processing it would add
+    #     p = exp(MASK - MASK) = 1 garbage into l/acc — the block must be
+    #     SKIPPED, not merely masked (same invariant as flash block_live).
+    live_kp = jnp.where(kp >= 0, kp, jnp.iinfo(jnp.int32).max)
+    live = (
+        (mb < bound_ref[b])
+        & (tbl_ref[b * nmb + mb] < n_blocks)
+        & (qp >= 0)
+        & (jnp.min(live_kp) <= qp)
+    )
 
     @pl.when(live)
     def _compute():
@@ -136,34 +150,56 @@ def paged_pool_attention(
     # Sublane-replicated position planes (Mosaic last-two-dims tiling).
     pos_r = jnp.broadcast_to(pool_pos[:, None, :], (NB, _SUBLANES, BLK))
     tbl_flat = table.astype(jnp.int32).reshape(B * MB)
+    q_pos = q_pos.astype(jnp.int32)
 
-    def kv_map(b, h, mb, tbl, qpos):
-        return (h, jnp.minimum(tbl[b * MB + mb], NB - 1), 0, 0)
+    # Per-row live-block grid bound: 1 + the last table slot whose block
+    # holds any slot this row's query may attend.  Blocks at/after the
+    # bound (reserved-but-unwritten tail, sentinel entries) are clamped
+    # in the index maps — consecutive grid steps fetch the SAME tile, so
+    # the pipeline skips the DMA — and the kernel skips their compute.
+    blk_min = jnp.min(
+        jnp.where(pool_pos >= 0, pool_pos, jnp.iinfo(jnp.int32).max),
+        axis=1,
+    )  # [NB] min live position per physical block
+    blk_min = jnp.concatenate(
+        [blk_min, jnp.full((1,), jnp.iinfo(jnp.int32).max, jnp.int32)]
+    )  # sentinel id NB -> never attendable
+    row_min = blk_min[jnp.minimum(table, NB)]  # [B, MB]
+    attendable = row_min <= q_pos[:, None]
+    bound = 1 + jnp.max(
+        jnp.where(
+            attendable, jnp.arange(MB, dtype=jnp.int32)[None, :], -1
+        ),
+        axis=1,
+    )  # [B] in [0, MB]
 
-    def pos_map(b, h, mb, tbl, qpos):
-        return (jnp.minimum(tbl[b * MB + mb], NB - 1), 0, 0)
+    def _clamp_mb(b, mb, tbl, bound):
+        mb = jnp.minimum(mb, jnp.maximum(bound[b] - 1, 0))
+        return jnp.minimum(tbl[b * MB + mb], NB - 1)
+
+    def kv_map(b, h, mb, tbl, qpos, bound):
+        return (h, _clamp_mb(b, mb, tbl, bound), 0, 0)
+
+    def pos_map(b, h, mb, tbl, qpos, bound):
+        return (_clamp_mb(b, mb, tbl, bound), 0, 0)
+
+    def q_map(b, h, mb, tbl, qpos, bound):
+        return (b, h, 0, 0)
 
     out, lse = pl.pallas_call(
         functools.partial(_paged_kernel, scale=scale, n_blocks=NB),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(B, KVH, MB),
             in_specs=[
-                pl.BlockSpec(
-                    (1, 1, G8, d), lambda b, h, mb, tbl, qpos: (b, h, 0, 0)
-                ),
+                pl.BlockSpec((1, 1, G8, d), q_map),
                 pl.BlockSpec((1, 1, BLK, d), kv_map),
                 pl.BlockSpec((1, 1, BLK, d), kv_map),
                 pl.BlockSpec((1, _SUBLANES, BLK), pos_map),
             ],
             out_specs=(
-                pl.BlockSpec(
-                    (1, 1, G8, d), lambda b, h, mb, tbl, qpos: (b, h, 0, 0)
-                ),
-                pl.BlockSpec(
-                    (1, 1, G8, _LANES),
-                    lambda b, h, mb, tbl, qpos: (b, h, 0, 0),
-                ),
+                pl.BlockSpec((1, 1, G8, d), q_map),
+                pl.BlockSpec((1, 1, G8, _LANES), q_map),
             ),
             scratch_shapes=[
                 pltpu.VMEM((G8, _LANES), jnp.float32),
@@ -179,7 +215,7 @@ def paged_pool_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(tbl_flat, q_pos.astype(jnp.int32), qg, k_pool, v_pool, pos_r)
+    )(tbl_flat, q_pos, bound, qg, k_pool, v_pool, pos_r)
     return out[:, :, :G, :], lse[:, :, :G, 0]
 
 

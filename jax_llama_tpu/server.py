@@ -8,7 +8,11 @@ parity.  Design constraints, in order:
     single serving loop thread; HTTP handler threads only enqueue work
     and wait.  This keeps the jitted step/insert programs free of locking
     and the device queue deep (the loop calls ``step()`` back-to-back
-    while any slot is active).
+    while any slot is active).  Cancellation follows the same rule: a
+    handler thread never touches the batcher — it only flips the
+    request's ``disconnected`` flag (or the deadline expires), and the
+    loop's ``_reap`` scan calls ``batcher.cancel`` at the next step
+    boundary.
   * **Stdlib only.**  ``http.server.ThreadingHTTPServer`` + ``json`` — no
     web framework to vendor or pin.
   * **Observability.**  ``GET /metrics`` exposes the batcher counters
@@ -18,8 +22,18 @@ parity.  Design constraints, in order:
 Endpoints:
   POST /generate   {"prompt": [ids]} or {"text": "..."} (needs tokenizer),
                    optional max_new_tokens / temperature / top_p / top_k /
-                   seed / stop_tokens.  Blocks until the request finishes;
-                   returns {"request_id", "tokens", "text"?}.
+                   seed / stop_tokens / timeout_s / stream.
+                   Default: blocks until the request finishes; returns
+                   {"request_id", "tokens", "text"?}.
+                   "stream": true streams NDJSON, one line per token
+                   ({"token": id, "text"?}), then a final
+                   {"done": true, "tokens": [...]} line (close-delimited
+                   body).  A client disconnect mid-stream cancels the
+                   request and frees its slot and blocks.
+                   "timeout_s" bounds the generation: on expiry the
+                   request is cancelled server-side and (non-stream)
+                   answered 504 / (stream) finished with
+                   {"done": true, "timeout": true, ...}.
   GET  /metrics    Prometheus text exposition of ``ContinuousBatcher.stats()``.
   GET  /healthz    {"ok": true}
 """
@@ -27,13 +41,17 @@ Endpoints:
 from __future__ import annotations
 
 import json
+import math
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from .serving import ContinuousBatcher
+
+_DONE = object()  # stream sentinel
 
 
 @dataclass
@@ -44,11 +62,26 @@ class _Pending:
     error: Optional[str] = None
     error_code: int = 400  # 400 = rejected payload, 503 = server-side
     request_id: Optional[int] = None
+    # Streaming: the loop feeds token ids (then _DONE) into ``chunks``;
+    # the handler thread drains it onto the socket.
+    stream: bool = False
+    chunks: "queue.Queue[Any]" = field(default_factory=queue.Queue)
+    # Absolute deadline (time.monotonic()); enforced by the loop.
+    deadline: Optional[float] = None
+    timed_out: bool = False
+    # Set by the handler when the client socket dies mid-stream; the loop
+    # cancels the request at the next step boundary.
+    disconnected: bool = False
 
     def fail(self, message: str, code: int) -> None:
         self.error = message
         self.error_code = code
         self.done.set()
+        self.chunks.put(_DONE)
+
+    def finish(self) -> None:
+        self.done.set()
+        self.chunks.put(_DONE)
 
 
 class LLMServer:
@@ -125,14 +158,47 @@ class LLMServer:
                         503, {"error": "server overloaded; retry later"}
                     )
                     return
-                pending = _Pending(payload=payload)
+                pending = _Pending(
+                    payload=payload, stream=bool(payload.get("stream"))
+                )
+                timeout_s = payload.get("timeout_s")
+                if timeout_s is not None:
+                    # NaN would make every deadline comparison False and
+                    # silently disable the bound; inf is equally useless.
+                    try:
+                        t = float(timeout_s)
+                        if not math.isfinite(t):
+                            raise ValueError(timeout_s)
+                    except (TypeError, ValueError):
+                        self._reply_json(
+                            400,
+                            {"error": "timeout_s must be a finite number"},
+                        )
+                        return
+                    pending.deadline = time.monotonic() + t
                 server._inbox.put(pending)
+                if pending.stream:
+                    self._stream_reply(pending)
+                else:
+                    self._blocking_reply(pending)
+
+            def _blocking_reply(self, pending: "_Pending"):
                 # Poll _closed so a request enqueued just as the loop dies
                 # (put racing the final drain) still unblocks.
                 while not pending.done.wait(timeout=1.0):
                     if server._closed.is_set() and not pending.done.is_set():
                         pending.fail("server shutting down", 503)
                         break
+                if pending.timed_out:
+                    self._reply_json(
+                        504,
+                        {
+                            "error": "generation timed out",
+                            "request_id": pending.request_id,
+                            "tokens": pending.tokens,
+                        },
+                    )
+                    return
                 if pending.error is not None:
                     self._reply_json(
                         pending.error_code, {"error": pending.error}
@@ -145,6 +211,54 @@ class LLMServer:
                 if server.tokenizer is not None:
                     out["text"] = server.tokenizer.decode(pending.tokens)
                 self._reply_json(200, out)
+
+            def _stream_reply(self, pending: "_Pending"):
+                """NDJSON token stream; body is close-delimited (no
+                Content-Length).  A failed socket write marks the request
+                disconnected; the loop cancels it at the next step."""
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/x-ndjson"
+                )
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+                def emit(obj: Dict[str, Any]) -> bool:
+                    try:
+                        self.wfile.write(json.dumps(obj).encode() + b"\n")
+                        self.wfile.flush()
+                        return True
+                    except OSError:
+                        pending.disconnected = True
+                        return False
+
+                while True:
+                    try:
+                        ev = pending.chunks.get(timeout=1.0)
+                    except queue.Empty:
+                        if server._closed.is_set():
+                            pending.fail("server shutting down", 503)
+                            ev = _DONE
+                        else:
+                            continue
+                    if ev is _DONE:
+                        break
+                    line: Dict[str, Any] = {"token": ev}
+                    if server.tokenizer is not None:
+                        line["text"] = server.tokenizer.decode([ev])
+                    if not emit(line):
+                        return  # client gone; the loop reaps the request
+                final: Dict[str, Any] = {
+                    "done": True,
+                    "request_id": pending.request_id,
+                    "tokens": pending.tokens,
+                }
+                if pending.timed_out:
+                    final["timeout"] = True
+                if pending.error is not None:
+                    final["error"] = pending.error
+                emit(final)
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(
@@ -207,6 +321,25 @@ class LLMServer:
         p.request_id = rid
         self._active[rid] = p
 
+    def _reap(self) -> None:
+        """Cancel expired and disconnected requests (loop thread only —
+        the batcher has a single owner)."""
+        now = time.monotonic()
+        for rid, p in list(self._active.items()):
+            expired = p.deadline is not None and now >= p.deadline
+            if not (expired or p.disconnected):
+                continue
+            self.batcher.cancel(rid)
+            del self._active[rid]
+            if p.disconnected:
+                p.finish()  # nobody is reading; just release state
+            elif p.stream:
+                p.timed_out = True
+                p.finish()
+            else:
+                p.timed_out = True
+                p.fail("generation timed out", 504)
+
     def _loop(self) -> None:
         # The finally-drain guarantees no client blocks forever: whether
         # the loop exits via stop() or an unexpected device/runtime error,
@@ -221,6 +354,12 @@ class LLMServer:
                     while True:
                         p = self._inbox.get(block=block, timeout=0.05)
                         block = False
+                        if p.deadline is not None and (
+                            time.monotonic() >= p.deadline
+                        ):
+                            p.timed_out = True
+                            p.fail("generation timed out", 504)
+                            continue
                         try:
                             self._submit(p)
                         except (ValueError, TypeError, KeyError) as e:
@@ -229,6 +368,7 @@ class LLMServer:
                             p.fail(str(e), 400)
                 except queue.Empty:
                     pass
+                self._reap()
                 if not self.batcher.pending():
                     continue
                 for rid, tok, done in self.batcher.step():
@@ -236,9 +376,11 @@ class LLMServer:
                     if p is None:
                         continue
                     p.tokens.append(tok)
+                    if p.stream:
+                        p.chunks.put(tok)
                     if done:
                         del self._active[rid]
-                        p.done.set()
+                        p.finish()
         except Exception as e:  # device/runtime failure: fail loudly
             reason = f"serving loop crashed: {e!r}"
             raise

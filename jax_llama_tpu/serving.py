@@ -13,7 +13,8 @@ TPU-native mechanics:
     cache holds O(max_len / block_size) prefill programs + 1 decode
     program.
   * **Paged KV.**  KV lives in a pool of fixed-size blocks
-    ([L, n_blocks, block_size, KVH, hd]); each slot holds a block table
+    ([L, KVH, n_blocks, block_size, hd], KV-head-major — the paged
+    kernel's layout); each slot holds a block table
     (physical block ids in sequence order).  Admission *reserves* the
     blocks a request can ever need (ceil((prompt_padded + max_new) /
     block_size)); completion frees them.  The pool may be sized smaller
@@ -170,11 +171,10 @@ def _scatter_back(
     NB, BLK = pool.pos.shape
     B, MB = table.shape
     rows = jnp.arange(B, dtype=jnp.int32)[:, None]
-    # Shared write-back contract (same function paged_forward uses).
-    blk, off = paged_write_indices(table, fill, active, T, NB, BLK)
-    safe_cols = jnp.minimum(
-        fill[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :],
-        MB * BLK - 1,
+    # Shared write-back contract (same function paged_forward uses);
+    # safe_cols is the matching clamped view column for each slot.
+    blk, off, safe_cols = paged_write_indices(
+        table, fill, active, T, NB, BLK
     )
     # view slices are [L, B, T, KVH, ...]; the pool wants KVH-major.
     nk = jnp.moveaxis(view.k[:, rows, safe_cols], 3, 1)   # [L, KVH, B, T, hd]
@@ -691,6 +691,26 @@ class ContinuousBatcher:
         return bool(self.queue) or any(
             s is not None for s in self.slots.values()
         )
+
+    def cancel(self, request_id: int) -> bool:
+        """Abort a request: dequeue it, or free its slot and blocks
+        mid-generation.  Returns False if the id is unknown (already
+        finished or never submitted).
+
+        Like every batcher method, this must be called from the thread
+        that owns the batcher (the serving loop); the HTTP server's
+        handler threads never call it directly — they set a flag the
+        loop's reap scan acts on.
+        """
+        for i, req in enumerate(self.queue):
+            if req.rid == request_id:
+                del self.queue[i]
+                return True
+        for b, slot in self.slots.items():
+            if slot is not None and slot.request_id == request_id:
+                self._free_slot(b)
+                return True
+        return False
 
     def acceptance_rate(self) -> float:
         """Fraction of proposed draft tokens accepted (speculative mode)."""

@@ -185,3 +185,43 @@ def test_paged_forward_matches_gathered_view_forward():
     np.testing.assert_array_equal(
         np.asarray(pcache.pos), np.asarray(want_pool.pos)
     )
+
+
+def test_paged_kernel_all_dead_block_contributes_nothing():
+    """A table entry whose block holds only pos=-1 slots (e.g. a
+    reserved-but-unwritten block, or a hole) must be SKIPPED — processing
+    it would add p = exp(MASK - MASK) = 1 garbage into the softmax
+    state.  Construct a row whose FIRST block is all-dead so the guard,
+    not a lucky earlier live block, is what protects the output."""
+    rng = np.random.RandomState(4)
+    KVH, d = 2, 16
+    NB, BLK, MB = 6, 8, 3
+    kp = rng.randn(KVH, NB, BLK, d).astype(np.float32)
+    vp = rng.randn(KVH, NB, BLK, d).astype(np.float32)
+    pool_pos = np.full((NB, BLK), -1, np.int32)
+    # Row 0: table [deadblk, liveblk, sentinel] — block 0 all-dead,
+    # block 1 holds positions 8..15 (as if the hole were rolled back).
+    pool_pos[1, :] = np.arange(8, 16)
+    table = np.array([[0, 1, NB]], np.int32)
+    qpos = np.array([16], np.int32)
+    q = rng.randn(1, 1, 4, d).astype(np.float32)
+    kn = rng.randn(1, 1, KVH, d).astype(np.float32)
+    vn = rng.randn(1, 1, KVH, d).astype(np.float32)
+    got = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kn), jnp.asarray(vn),
+        jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(pool_pos),
+        jnp.asarray(table), jnp.asarray(qpos),
+    ))
+    # Reference: only block 1's slots + the new token.
+    ks = np.concatenate([kp[:, 1], kn[0].transpose(1, 0, 2)], axis=1)
+    vs = np.concatenate([vp[:, 1], vn[0].transpose(1, 0, 2)], axis=1)
+    ps = np.concatenate([pool_pos[1], [16]])
+    bias = attention_bias(
+        jnp.asarray([[16]], jnp.int32), jnp.asarray(ps[None]),
+        jnp.asarray((ps >= 0)[None]),
+    )
+    want = np.asarray(sdpa(
+        jnp.asarray(q), jnp.asarray(ks.transpose(1, 0, 2)[None]),
+        jnp.asarray(vs.transpose(1, 0, 2)[None]), bias,
+    ))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)

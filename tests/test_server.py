@@ -115,3 +115,137 @@ def test_http_error_paths(model):
         )
         assert status == 200
         assert len(body["tokens"]) == 4
+
+
+def _stream_lines(url, payload, timeout=300):
+    """POST with stream=true; return the parsed NDJSON lines."""
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "application/x-ndjson"
+        return [json.loads(line) for line in r.read().splitlines()]
+
+
+def test_http_streaming_matches_blocking(model):
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    with LLMServer(cb) as srv:
+        status, body = _post(
+            srv.address, {"prompt": [5, 9, 13], "max_new_tokens": 6}
+        )
+        assert status == 200
+        lines = _stream_lines(
+            srv.address,
+            {"prompt": [5, 9, 13], "max_new_tokens": 6, "stream": True},
+        )
+        # one line per token, then the final summary line
+        assert lines[-1]["done"] is True
+        per_token = [ln["token"] for ln in lines[:-1]]
+        assert per_token == body["tokens"]
+        assert lines[-1]["tokens"] == body["tokens"]
+        assert "timeout" not in lines[-1]
+
+
+def test_http_timeout_cancels_and_frees_blocks(model):
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64)
+    total_blocks = cb.n_blocks
+    with LLMServer(cb) as srv:
+        try:
+            _post(
+                srv.address,
+                {"prompt": [1, 2, 3], "max_new_tokens": 40,
+                 "timeout_s": 0.0},
+            )
+            assert False, "expected HTTP 504"
+        except urllib.error.HTTPError as e:
+            assert e.code == 504
+            assert "timed out" in json.loads(e.read())["error"]
+        # The cancelled request released its slot and blocks: a fresh
+        # request gets full capacity and completes.
+        status, body = _post(
+            srv.address, {"prompt": [4, 5, 6], "max_new_tokens": 4}
+        )
+        assert status == 200 and len(body["tokens"]) == 4
+        assert len(cb.free_blocks) == total_blocks
+        assert all(s is None for s in cb.slots.values())
+
+
+def test_http_client_disconnect_cancels_stream(model):
+    import socket
+    import time as _time
+
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64)
+    total_blocks = cb.n_blocks
+    with LLMServer(cb) as srv:
+        host, port = srv.httpd.server_address[:2]
+        # Small enough to be ADMITTED (the point is reaping an active,
+        # generating request), big enough that the client disconnects
+        # long before it finishes.
+        payload = json.dumps(
+            {"prompt": [7, 8, 9], "max_new_tokens": 40, "stream": True}
+        ).encode()
+        s = socket.create_connection((host, port), timeout=30)
+        s.sendall(
+            b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            + f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+        )
+        s.recv(1024)  # read the status line + first bytes, then vanish
+        s.close()
+        # The loop notices the dead socket at the next failed write and
+        # frees the slot; other requests then proceed normally.
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            if (
+                len(cb.free_blocks) == total_blocks
+                and all(sl is None for sl in cb.slots.values())
+                and not cb.queue
+            ):
+                break
+            _time.sleep(0.2)
+        else:
+            assert False, "disconnected stream request was never reaped"
+        status, body = _post(
+            srv.address, {"prompt": [1, 2], "max_new_tokens": 3}
+        )
+        assert status == 200 and len(body["tokens"]) == 3
+
+
+def test_batcher_cancel_queued_and_active(model):
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64)
+    total = cb.n_blocks
+    r1 = cb.submit([1, 2, 3], max_new_tokens=8)   # admitted to the slot
+    r2 = cb.submit([4, 5, 6], max_new_tokens=8)   # waits in the queue
+    assert cb.cancel(r2) is True                  # dequeue
+    assert cb.cancel(r2) is False                 # already gone
+    assert cb.cancel(r1) is True                  # frees the active slot
+    assert not cb.pending()
+    assert len(cb.free_blocks) == total
+
+
+def test_http_non_finite_timeout_rejected(model):
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=32)
+    with LLMServer(cb) as srv:
+        for bad in ("NaN", "Infinity"):
+            req = urllib.request.Request(
+                srv.address + "/generate",
+                # raw JSON so the non-finite literal reaches the server
+                data=(
+                    b'{"prompt": [1, 2], "max_new_tokens": 4, '
+                    b'"timeout_s": ' + bad.encode() + b"}"
+                ),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=60)
+                assert False, f"expected HTTP 400 for timeout_s={bad}"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert "finite" in json.loads(e.read())["error"]
