@@ -12,12 +12,15 @@ paged-attention idea, executed the Pallas way: the table lookup lives in
 the BlockSpec index_map, the DMA pipeline does the pointer-chasing).
 
 Layout contract: the pool is [KVH, NB, BLK, hd] per layer — KV-head
-major, so one (head, block) tile is a clean ``(BLK, hd)`` VMEM page.
-Grid is ``(B, KVH, MB)`` with the per-row block sweep innermost; online
-softmax state lives in VMEM scratch across the sweep, exactly like
-``ops.flash_attention``.  GQA: the ``group`` query heads of each KV head
-ride the sublane axis of a single q tile (padded to 8), so decode reads
-each KV block once per KV head — never per query head.
+major, so a block's tile is a clean ``(KVH, BLK, hd)`` VMEM page.
+Grid is ``(B, MB)`` with the per-row block sweep innermost; ONE grid
+cell covers all KV heads of a block via a statically-unrolled in-kernel
+loop (a finer (B, KVH, MB) grid was measured SLOWER than the gathered
+view it replaces — per-cell overhead beat the bandwidth saving).
+Online softmax state lives in VMEM scratch across the sweep, exactly
+like ``ops.flash_attention``.  GQA: the ``group`` query heads of each
+KV head ride the sublane axis of that head's q rows (padded to 8), so
+decode reads each KV block once — never per query head.
 
 The kernel attends the POOL only and emits a normalized output plus the
 row logsumexp; the caller merges the current step's own K/V (one slot,
@@ -44,20 +47,22 @@ def _paged_kernel(
     tbl_ref,    # [B * MB] int32 scalar-prefetch: physical block id (NB = dead)
     qpos_ref,   # [B] int32 scalar-prefetch: query position (-1 = inactive row)
     bound_ref,  # [B] int32 scalar-prefetch: live-block grid bound per row
-    q_ref,      # [1, 1, G8, d]
-    k_ref,      # [1, 1, BLK, d]
-    v_ref,      # [1, 1, BLK, d]
+    q_ref,      # [1, KVH, G8, d]
+    k_ref,      # [KVH, 1, BLK, d]
+    v_ref,      # [KVH, 1, BLK, d]
     pos_ref,    # [1, SUBLANES, BLK] int32 slot positions of the block
-    o_ref,      # [1, 1, G8, d]
-    lse_ref,    # [1, 1, G8, LANES] fp32
-    m_ref, l_ref, acc_ref,  # VMEM scratch
+    o_ref,      # [1, KVH, G8, d]
+    lse_ref,    # [1, KVH, G8, LANES] fp32
+    m_ref, l_ref, acc_ref,  # VMEM scratch, [KVH*G8, ...]
     *,
     scale: float,
     n_blocks: int,
+    kvh: int,
+    g8: int,
 ):
     b = pl.program_id(0)
-    mb = pl.program_id(2)
-    nmb = pl.num_programs(2)
+    mb = pl.program_id(1)
+    nmb = pl.num_programs(1)
 
     @pl.when(mb == 0)
     def _init():
@@ -84,39 +89,45 @@ def _paged_kernel(
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0, 0]  # [G8, d]
-        s = jax.lax.dot_general(
-            q, k_ref[0, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [G8, BLK]
         allowed = (kp >= 0) & (kp <= qp)
-        s = jnp.where(allowed, s, MASK_VALUE)
-
-        m_prev = m_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_ref[:] = jnp.broadcast_to(
-            alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True),
-            l_ref.shape,
-        )
-        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        # One grid cell covers ALL KV heads of the block (the loop
+        # unrolls statically): grid cells are B × MB, not B × KVH × MB —
+        # measured ~1 µs of per-cell overhead made the finer grid SLOWER
+        # than the gathered-view fallback it replaces.
+        for h in range(kvh):
+            sl = slice(h * g8, (h + 1) * g8)
+            s = jax.lax.dot_general(
+                q_ref[0, h], k_ref[h, 0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G8, BLK]
+            s = jnp.where(allowed, s, MASK_VALUE)
+            m_prev = m_ref[sl, :1]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(s, axis=-1, keepdims=True)
+            )
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_ref[sl] = jnp.broadcast_to(
+                alpha * l_ref[sl, :1] + jnp.sum(p, axis=-1, keepdims=True),
+                (g8, l_ref.shape[1]),
+            )
+            acc_ref[sl] = alpha * acc_ref[sl] + jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[h, 0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[sl] = jnp.broadcast_to(m_new, (g8, m_ref.shape[1]))
 
     @pl.when(mb == nmb - 1)
     def _finalize():
         l = l_ref[:, :1]
-        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
-            o_ref.dtype
-        )
+        o_ref[0] = (
+            acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+        ).reshape(kvh, g8, -1).astype(o_ref.dtype)
         # lse stays ~MASK_VALUE for rows that attended nothing, so the
         # caller's merge weight exp(lse - m_tot) underflows to exactly 0.
-        lse_ref[0, 0] = m_ref[:] + jnp.log(
-            jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
-        )
+        lse_ref[0] = (
+            m_ref[:] + jnp.log(jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:]))
+        ).reshape(kvh, g8, -1)
 
 
 def _round_up(n: int, m: int) -> int:
@@ -177,34 +188,36 @@ def paged_pool_attention(
         mb = jnp.minimum(mb, jnp.maximum(bound[b] - 1, 0))
         return jnp.minimum(tbl[b * MB + mb], NB - 1)
 
-    def kv_map(b, h, mb, tbl, qpos, bound):
-        return (h, _clamp_mb(b, mb, tbl, bound), 0, 0)
+    def kv_map(b, mb, tbl, qpos, bound):
+        return (0, _clamp_mb(b, mb, tbl, bound), 0, 0)
 
-    def pos_map(b, h, mb, tbl, qpos, bound):
+    def pos_map(b, mb, tbl, qpos, bound):
         return (_clamp_mb(b, mb, tbl, bound), 0, 0)
 
-    def q_map(b, h, mb, tbl, qpos, bound):
-        return (b, h, 0, 0)
+    def q_map(b, mb, tbl, qpos, bound):
+        return (b, 0, 0, 0)
 
     out, lse = pl.pallas_call(
-        functools.partial(_paged_kernel, scale=scale, n_blocks=NB),
+        functools.partial(
+            _paged_kernel, scale=scale, n_blocks=NB, kvh=KVH, g8=G8
+        ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
-            grid=(B, KVH, MB),
+            grid=(B, MB),
             in_specs=[
-                pl.BlockSpec((1, 1, G8, d), q_map),
-                pl.BlockSpec((1, 1, BLK, d), kv_map),
-                pl.BlockSpec((1, 1, BLK, d), kv_map),
+                pl.BlockSpec((1, KVH, G8, d), q_map),
+                pl.BlockSpec((KVH, 1, BLK, d), kv_map),
+                pl.BlockSpec((KVH, 1, BLK, d), kv_map),
                 pl.BlockSpec((1, _SUBLANES, BLK), pos_map),
             ],
             out_specs=(
-                pl.BlockSpec((1, 1, G8, d), q_map),
-                pl.BlockSpec((1, 1, G8, _LANES), q_map),
+                pl.BlockSpec((1, KVH, G8, d), q_map),
+                pl.BlockSpec((1, KVH, G8, _LANES), q_map),
             ),
             scratch_shapes=[
-                pltpu.VMEM((G8, _LANES), jnp.float32),
-                pltpu.VMEM((G8, _LANES), jnp.float32),
-                pltpu.VMEM((G8, d), jnp.float32),
+                pltpu.VMEM((KVH * G8, _LANES), jnp.float32),
+                pltpu.VMEM((KVH * G8, _LANES), jnp.float32),
+                pltpu.VMEM((KVH * G8, d), jnp.float32),
             ],
         ),
         out_shape=(
@@ -212,7 +225,7 @@ def paged_pool_attention(
             jax.ShapeDtypeStruct((B, KVH, G8, _LANES), jnp.float32),
         ),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(tbl_flat, q_pos, bound, qg, k_pool, v_pool, pos_r)

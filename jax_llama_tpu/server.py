@@ -43,6 +43,8 @@ from __future__ import annotations
 import json
 import math
 import queue
+import select
+import socket
 import threading
 import time
 from dataclasses import dataclass, field
@@ -233,6 +235,23 @@ class LLMServer:
                         pending.disconnected = True
                         return False
 
+                def client_gone() -> bool:
+                    # Readable-EOF probe: a closed client socket selects
+                    # readable and MSG_PEEK returns b"".  Without this, a
+                    # client that disconnects while its request is still
+                    # QUEUED (no tokens flowing, so no write ever fails)
+                    # would keep its queue position and be admitted,
+                    # prefilled, and decoded for a dead socket.
+                    try:
+                        r, _, _ = select.select([self.connection], [], [], 0)
+                        if not r:
+                            return False
+                        return (
+                            self.connection.recv(1, socket.MSG_PEEK) == b""
+                        )
+                    except (OSError, ValueError):
+                        return True
+
                 while True:
                     try:
                         ev = pending.chunks.get(timeout=1.0)
@@ -240,6 +259,9 @@ class LLMServer:
                         if server._closed.is_set():
                             pending.fail("server shutting down", 503)
                             ev = _DONE
+                        elif client_gone():
+                            pending.disconnected = True
+                            return  # the loop reaps the request
                         else:
                             continue
                     if ev is _DONE:
@@ -354,6 +376,9 @@ class LLMServer:
                     while True:
                         p = self._inbox.get(block=block, timeout=0.05)
                         block = False
+                        if p.disconnected:
+                            p.finish()  # client vanished before admission
+                            continue
                         if p.deadline is not None and (
                             time.monotonic() >= p.deadline
                         ):

@@ -154,6 +154,8 @@ def test_http_timeout_cancels_and_frees_blocks(model):
     cb = ContinuousBatcher(params, config, n_slots=1, max_len=64)
     total_blocks = cb.n_blocks
     with LLMServer(cb) as srv:
+        # timeout_s=0: already expired when the loop pops the inbox —
+        # rejected BEFORE admission (no slot was ever taken).
         try:
             _post(
                 srv.address,
@@ -164,6 +166,14 @@ def test_http_timeout_cancels_and_frees_blocks(model):
         except urllib.error.HTTPError as e:
             assert e.code == 504
             assert "timed out" in json.loads(e.read())["error"]
+
+        # Warm the compile caches so the next request's budget is spent
+        # generating, not compiling.
+        status, _ = _post(
+            srv.address, {"prompt": [4, 5, 6], "max_new_tokens": 2}
+        )
+        assert status == 200
+
         # The cancelled request released its slot and blocks: a fresh
         # request gets full capacity and completes.
         status, body = _post(
@@ -172,6 +182,40 @@ def test_http_timeout_cancels_and_frees_blocks(model):
         assert status == 200 and len(body["tokens"]) == 4
         assert len(cb.free_blocks) == total_blocks
         assert all(s is None for s in cb.slots.values())
+
+
+def test_http_mid_generation_timeout_reaps_active_request(model):
+    """Exercise _reap's expired-ACTIVE branch (distinct from the
+    pre-admission rejection above): the request must be admitted, emit
+    some tokens, hit its deadline mid-generation, and be cancelled with
+    partial tokens in the 504 body and its slot/blocks released."""
+    params, config = model
+    # A generation budget far larger than 2s of CPU steps can finish.
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=4096)
+    total_blocks = cb.n_blocks
+    with LLMServer(cb) as srv:
+        # Warm the compile caches so the timed request spends its budget
+        # generating, not compiling.
+        status, _ = _post(
+            srv.address, {"prompt": [4, 5, 6], "max_new_tokens": 2}
+        )
+        assert status == 200
+        try:
+            _post(
+                srv.address,
+                {"prompt": [1, 2, 3], "max_new_tokens": 3000,
+                 "timeout_s": 2.0},
+            )
+            assert False, "expected HTTP 504"
+        except urllib.error.HTTPError as e:
+            assert e.code == 504
+            body = json.loads(e.read())
+            assert "timed out" in body["error"]
+            # It was admitted and generated until the reap.
+            assert 0 < len(body["tokens"]) < 3000
+        assert len(cb.free_blocks) == total_blocks
+        assert all(s is None for s in cb.slots.values())
+        assert not cb.pending()
 
 
 def test_http_client_disconnect_cancels_stream(model):
