@@ -107,7 +107,7 @@ class KVCache:
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=["k", "v", "pos", "table", "fill"],
+    data_fields=["k", "v", "pos", "table", "fill", "k_scale", "v_scale"],
     meta_fields=[],
 )
 @dataclasses.dataclass
@@ -120,12 +120,15 @@ class PagedKVCache:
     is ever materialized.
 
     k, v:  [L, KVH, NB, BLK, head_dim] — KV-head-major so one
-           (head, block) tile is a clean (BLK, head_dim) VMEM page.
+           (head, block) tile is a clean (BLK, head_dim) VMEM page;
+           int8 when the pool is quantized.
     pos:   [NB, BLK] int32 absolute position per slot; -1 invalid.
     table: [B, MB] int32 physical block ids in sequence order; NB marks
            an unused entry.
     fill:  [B] int32 per-row next write offset in tokens (the host
            advances it after each step, like the gathered-view path).
+    k_scale, v_scale: [L, KVH, NB, BLK] fp32 per-slot-per-head dequant
+           scales (int8 pool only; None otherwise) — folded in-kernel.
     """
 
     k: jnp.ndarray
@@ -133,6 +136,8 @@ class PagedKVCache:
     pos: jnp.ndarray
     table: jnp.ndarray
     fill: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
 
     @property
     def n_blocks(self) -> int:
@@ -141,6 +146,10 @@ class PagedKVCache:
     @property
     def block_size(self) -> int:
         return self.k.shape[3]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 def paged_write_indices(
@@ -363,11 +372,18 @@ def _block(
         # its index maps (pool read once, no gathered view) and the new
         # token's slot merges at the softmax level.  Pool stays immutable
         # through the scan — paged_forward scatters the ys once per step.
+        # int8 pools fold their scales in-kernel; the step's projections
+        # get quantized for the scatter but merge at full precision
+        # (matching sdpa_cached's treatment of same-step tokens).
         from ..ops.paged_attention import paged_decode_attention
 
         attn = paged_decode_attention(
-            q, k, v, cache_k, cache_v, paged_pos, paged_table, paged_qpos
+            q, k, v, cache_k, cache_v, paged_pos, paged_table, paged_qpos,
+            k_scale=cache_k_scale, v_scale=cache_v_scale,
         )
+        if cache_k_scale is not None:
+            k, cache_k_scale = quantize_kv(k)
+            v, cache_v_scale = quantize_kv(v)
         cache_k, cache_v = k, v
     elif cache_k is not None and cache_k_scale is not None:
         # int8 cache on the flash path: quantize this chunk's projections,
@@ -876,7 +892,18 @@ def paged_forward(
     )
 
     lp = params["layers"]
-    if config.scan_layers:
+    nks = nvs = None
+    if config.scan_layers and cache.quantized:
+        def scan_fn(carry, xs):
+            layer_params, ck, cv, cks, cvs = xs
+            y, ck, cv, cks, cvs = block(carry, layer_params, ck, cv, cks, cvs)
+            return y, (ck, cv, cks, cvs)
+
+        x, (new_k, new_v, nks, nvs) = lax.scan(
+            scan_fn, x,
+            (lp, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        )
+    elif config.scan_layers:
         def scan_fn(carry, xs):
             layer_params, ck, cv = xs
             y, ck, cv, _, _ = block(carry, layer_params, ck, cv)
@@ -884,13 +911,21 @@ def paged_forward(
 
         x, (new_k, new_v) = lax.scan(scan_fn, x, (lp, cache.k, cache.v))
     else:
-        new_ks, new_vs = [], []
+        new_ks, new_vs, sks, svs = [], [], [], []
         for i in range(config.n_layers):
             layer_params = jax.tree.map(lambda a: a[i], lp)
-            x, ck, cv, _, _ = block(x, layer_params, cache.k[i], cache.v[i])
+            x, ck, cv, cks, cvs = block(
+                x, layer_params, cache.k[i], cache.v[i],
+                cache.k_scale[i] if cache.quantized else None,
+                cache.v_scale[i] if cache.quantized else None,
+            )
             new_ks.append(ck)
             new_vs.append(cv)
+            sks.append(cks)
+            svs.append(cvs)
         new_k, new_v = jnp.stack(new_ks), jnp.stack(new_vs)
+        if cache.quantized:
+            nks, nvs = jnp.stack(sks), jnp.stack(svs)
 
     logits = lm_head_logits(params, x, config) if compute_logits else None
 
@@ -915,4 +950,15 @@ def paged_forward(
             jnp.where(active, positions[:, 0], -1)[:, None], mode="drop"
         ),
     )
+    if cache.quantized:
+        # ys carried each layer's new int8 payload + its scales.
+        new_cache = dataclasses.replace(
+            new_cache,
+            k_scale=cache.k_scale.at[:, :, blk_idx, off].set(
+                jnp.moveaxis(nks, 3, 1), mode="drop"
+            ),
+            v_scale=cache.v_scale.at[:, :, blk_idx, off].set(
+                jnp.moveaxis(nvs, 3, 1), mode="drop"
+            ),
+        )
     return logits, new_cache

@@ -48,18 +48,22 @@ def _paged_kernel(
     qpos_ref,   # [B] int32 scalar-prefetch: query position (-1 = inactive row)
     bound_ref,  # [B] int32 scalar-prefetch: live-block grid bound per row
     q_ref,      # [1, KVH, G8, d]
-    k_ref,      # [KVH, 1, BLK, d]
-    v_ref,      # [KVH, 1, BLK, d]
-    pos_ref,    # [1, SUBLANES, BLK] int32 slot positions of the block
-    o_ref,      # [1, KVH, G8, d]
-    lse_ref,    # [1, KVH, G8, LANES] fp32
-    m_ref, l_ref, acc_ref,  # VMEM scratch, [KVH*G8, ...]
-    *,
+    k_ref,      # [KVH, 1, BLK, d] (int8 when quantized)
+    v_ref,      # [KVH, 1, BLK, d] (int8 when quantized)
+    pos_ref,    # [1, 1, BLK] int32 slot positions of the block
+    *rest,      # [k_scale_ref, v_scale_ref] when quantized
+    #             ([KVH, 1, 1, BLK] fp32); o_ref; lse_ref; scratch
     scale: float,
     n_blocks: int,
     kvh: int,
     g8: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        k_scale_ref, v_scale_ref, *rest = rest
+    else:
+        k_scale_ref = v_scale_ref = None
+    o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     mb = pl.program_id(1)
     nmb = pl.num_programs(1)
@@ -96,10 +100,24 @@ def _paged_kernel(
         # than the gathered-view fallback it replaces.
         for h in range(kvh):
             sl = slice(h * g8, (h + 1) * g8)
+            q = q_ref[0, h]
+            if quantized:
+                # int8 pool: cast the tile in VMEM (int8 magnitudes are
+                # exact in bf16) and fold the per-slot dequant scales at
+                # the scores / probability level — the same commuting
+                # trick as flash_attention_quantized, so HBM streams the
+                # int8 bytes.
+                k = k_ref[h, 0].astype(q.dtype)
+                ksc = k_scale_ref[h, 0, :1, :]  # [1, BLK] fp32
+            else:
+                k = k_ref[h, 0]
+                ksc = None
             s = jax.lax.dot_general(
-                q_ref[0, h], k_ref[h, 0], (((1,), (1,)), ((), ())),
+                q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale  # [G8, BLK]
+            if quantized:
+                s = s * ksc
             s = jnp.where(allowed, s, MASK_VALUE)
             m_prev = m_ref[sl, :1]
             m_new = jnp.maximum(
@@ -111,8 +129,14 @@ def _paged_kernel(
                 alpha * l_ref[sl, :1] + jnp.sum(p, axis=-1, keepdims=True),
                 (g8, l_ref.shape[1]),
             )
+            if quantized:
+                pv = (p * v_scale_ref[h, 0, :1, :]).astype(q.dtype)
+                vb = v_ref[h, 0].astype(q.dtype)
+            else:
+                pv = p.astype(v_ref.dtype)
+                vb = v_ref[h, 0]
             acc_ref[sl] = alpha * acc_ref[sl] + jax.lax.dot_general(
-                p.astype(v_ref.dtype), v_ref[h, 0], (((1,), (0,)), ((), ())),
+                pv, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             m_ref[sl] = jnp.broadcast_to(m_new, (g8, m_ref.shape[1]))
@@ -142,9 +166,15 @@ def paged_pool_attention(
     pool_pos: jnp.ndarray,  # [NB, BLK] int32 (-1 = invalid slot)
     table: jnp.ndarray,    # [B, MB] int32 physical block ids (NB = unused)
     q_pos: jnp.ndarray,    # [B] int32 (-1 = inactive row)
+    k_scale: Optional[jnp.ndarray] = None,  # [KVH, NB, BLK] fp32 (int8 pool)
+    v_scale: Optional[jnp.ndarray] = None,
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Attend each row's table-mapped pool blocks; no gather, pool read once.
+
+    With ``k_scale``/``v_scale`` the pool is int8 and the per-slot
+    dequant scales fold in-kernel (scores-level for K, probability-level
+    for V) — the pool streams at one byte per element plus fp32 scales.
 
     Returns (out [B, KVH, G, d] normalized over the pool slots,
     lse [B, KVH, G] fp32 row logsumexp) for the caller's new-token merge.
@@ -153,13 +183,16 @@ def paged_pool_attention(
     NB, BLK = pool_pos.shape
     MB = table.shape[1]
     assert k_pool.shape == (KVH, NB, BLK, d), (k_pool.shape, (KVH, NB, BLK, d))
+    quantized = k_scale is not None
     interpret = _resolve_interpret(interpret)
     G8 = _round_up(G, _SUBLANES)
     qg = jnp.pad(q, ((0, 0), (0, 0), (0, G8 - G), (0, 0)))
     scale = 1.0 / (d ** 0.5)
 
-    # Sublane-replicated position planes (Mosaic last-two-dims tiling).
-    pos_r = jnp.broadcast_to(pool_pos[:, None, :], (NB, _SUBLANES, BLK))
+    # Narrow-sublane position plane [NB, 1, BLK]: a free expand_dims
+    # view — Mosaic accepts 1-row tiles here (verified compiled), so no
+    # sublane replication and no per-step materialization is needed.
+    pos_r = pool_pos[:, None, :]
     tbl_flat = table.astype(jnp.int32).reshape(B * MB)
     q_pos = q_pos.astype(jnp.int32)
 
@@ -197,19 +230,38 @@ def paged_pool_attention(
     def q_map(b, mb, tbl, qpos, bound):
         return (b, 0, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, KVH, G8, d), q_map),
+        pl.BlockSpec((KVH, 1, BLK, d), kv_map),
+        pl.BlockSpec((KVH, 1, BLK, d), kv_map),
+        pl.BlockSpec((1, 1, BLK), pos_map),
+    ]
+    operands = [qg, k_pool, v_pool, pos_r]
+    if quantized:
+        # Narrow-sublane scale planes [KVH, NB, 1, BLK]: free expand_dims
+        # views of the long-lived pool scales — NOT sublane-replicated
+        # copies, which would re-materialize (and stream) 8x the scale
+        # bytes per layer per step on the path this kernel exists to
+        # make bandwidth-lean.
+        def scale_map(b, mb, tbl, qpos, bound):
+            return (0, _clamp_mb(b, mb, tbl, bound), 0, 0)
+
+        scale_spec = pl.BlockSpec((KVH, 1, 1, BLK), scale_map)
+        in_specs += [scale_spec, scale_spec]
+        operands += [
+            k_scale.astype(jnp.float32)[:, :, None, :],
+            v_scale.astype(jnp.float32)[:, :, None, :],
+        ]
+
     out, lse = pl.pallas_call(
         functools.partial(
-            _paged_kernel, scale=scale, n_blocks=NB, kvh=KVH, g8=G8
+            _paged_kernel, scale=scale, n_blocks=NB, kvh=KVH, g8=G8,
+            quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(B, MB),
-            in_specs=[
-                pl.BlockSpec((1, KVH, G8, d), q_map),
-                pl.BlockSpec((KVH, 1, BLK, d), kv_map),
-                pl.BlockSpec((KVH, 1, BLK, d), kv_map),
-                pl.BlockSpec((1, _SUBLANES, BLK), pos_map),
-            ],
+            in_specs=in_specs,
             out_specs=(
                 pl.BlockSpec((1, KVH, G8, d), q_map),
                 pl.BlockSpec((1, KVH, G8, _LANES), q_map),
@@ -228,7 +280,7 @@ def paged_pool_attention(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(tbl_flat, q_pos, bound, qg, k_pool, v_pool, pos_r)
+    )(tbl_flat, q_pos, bound, *operands)
     return out[:, :, :G, :], lse[:, :, :G, 0]
 
 
@@ -241,6 +293,8 @@ def paged_decode_attention(
     pool_pos: jnp.ndarray,  # [NB, BLK]
     table: jnp.ndarray,    # [B, MB]
     q_pos: jnp.ndarray,    # [B] (-1 = inactive)
+    k_scale: Optional[jnp.ndarray] = None,  # [KVH, NB, BLK] (int8 pool)
+    v_scale: Optional[jnp.ndarray] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """One decode step of attention over (pool blocks ∪ the new slot).
@@ -249,7 +303,9 @@ def paged_decode_attention(
     (score ``q·k_new``, always attendable for an active row — a token may
     attend itself) merges at the softmax level outside, keeping the pool
     immutable through the layer scan (same append-free contract as
-    ``sdpa_cached``).  Returns [B, 1, H, d].
+    ``sdpa_cached``; the new token's K/V enter the merge at full
+    precision, also matching sdpa_cached — only POOL reads see int8).
+    Returns [B, 1, H, d].
     """
     B, T, H, d = q.shape
     assert T == 1, "paged decode attention is a T=1 step"
@@ -260,7 +316,8 @@ def paged_decode_attention(
     # Head layout h = kvh * G + g (same contract as flash GQA packing).
     qg = q[:, 0].reshape(B, KVH, G, d)
     out_pool, lse = paged_pool_attention(
-        qg, k_pool, v_pool, pool_pos, table, q_pos, interpret=interpret
+        qg, k_pool, v_pool, pool_pos, table, q_pos,
+        k_scale=k_scale, v_scale=v_scale, interpret=interpret,
     )
 
     # New-slot scores [B, KVH, G]: the only same-step pair at T=1 is the

@@ -282,28 +282,27 @@ def _paged_decode_step(
     skipping the split here is unobservable).
 
     Attention path: the Pallas paged kernel walks the block table
-    in-kernel (pool read once per step).  Fallbacks to the gathered
-    contiguous view: int8 pools (the kernel is dense-only), meshes
-    (a pallas_call inside pjit is not auto-partitioned), and block sizes
-    that break Mosaic's 8-sublane tiling.
+    in-kernel (pool read once per step; int8 pools fold their dequant
+    scales in-kernel).  Fallbacks to the gathered contiguous view:
+    meshes (a pallas_call inside pjit is not auto-partitioned) and block
+    sizes that break Mosaic's 8-sublane tiling.
     """
     with use_mesh(mesh):
         positions = jnp.where(active, pos, -1)[:, None]
-        use_kernel = (
-            not pool.quantized and mesh is None
-            and pool.block_size % 8 == 0
-        )
+        use_kernel = mesh is None and pool.block_size % 8 == 0
         if use_kernel:
             pcache = PagedKVCache(
                 k=pool.k, v=pool.v, pos=pool.pos,
                 table=table, fill=fill,
+                k_scale=pool.k_scale, v_scale=pool.v_scale,
             )
             logits, pcache = forward(
                 params, tau[:, None], positions, config, cache=pcache,
                 attn_mask=active[:, None],
             )
             pool = dataclasses.replace(
-                pool, k=pcache.k, v=pcache.v, pos=pcache.pos
+                pool, k=pcache.k, v=pcache.v, pos=pcache.pos,
+                k_scale=pcache.k_scale, v_scale=pcache.v_scale,
             )
         else:
             view = _gather_cache(pool, table, n_alloc, fill)
