@@ -46,8 +46,8 @@ _SUBLANES = 8  # TPU sublane width (fp32/int32)
 
 def _flash_kernel(
     kv_bound_ref,  # [B * nq] int32 scalar-prefetch: kv-block grid bound
-    q_pos_ref,  # [1, bq, LANES] int32 (lane-replicated)
-    kv_pos_ref,  # [1, SUBLANES, bk] int32 (sublane-replicated)
+    q_pos_ref,  # [1, bq, 1] int32 (narrow-lane view)
+    kv_pos_ref,  # [1, 1, bk] int32 (narrow-sublane view)
     q_ref,  # [1, 1, bq, d]
     k_ref,  # [1, 1, bk, d] (int8 when quantized)
     v_ref,  # [1, 1, bk, d] (int8 when quantized)
@@ -75,8 +75,8 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Positions arrive replicated across lanes/sublanes (Mosaic's last-two-
-    # dims tiling rules reject narrow int vectors); slice one copy each.
+    # Narrow-sublane/lane position views (1-row tiles compile fine on
+    # Mosaic — no replicated copies, no extra HBM traffic).
     qp = q_pos_ref[0, :, :1]  # [bq, 1]
     kp = kv_pos_ref[0, :1, :]  # [1, bk]
 
@@ -153,9 +153,10 @@ def _flash_kernel(
         if with_lse:
             # Row logsumexp of the (scaled, masked) scores — the backward
             # kernels rebuild P = exp(s - lse) from it without storing
-            # any S×S tensor.  Lane-replicated like m/l (tiling rules).
-            lse_ref[0, 0] = m_ref[:] + jnp.log(
-                jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+            # any S×S tensor.  Narrow-lane [bq, 1] (the lane-replicated
+            # form cost 128x the lse bytes at long context).
+            lse_ref[0, 0] = m_ref[:, :1] + jnp.log(
+                jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
             )
 
 
@@ -353,9 +354,9 @@ def _flash_forward(
     kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), 1, block_k, value=-1)
     Tp, Sp = qt.shape[2], kt.shape[2]
     nq, nk = Tp // block_q, Sp // block_k
-    # Lane/sublane-replicated position planes (see kernel docstring).
-    q_pos_r = jnp.broadcast_to(q_pos_p[:, :, None], (B, Tp, _LANES))
-    kv_pos_r = jnp.broadcast_to(kv_pos_p[:, None, :], (B, _SUBLANES, Sp))
+    # Narrow-lane/sublane position views (free expand_dims, no copies).
+    q_pos_r = q_pos_p[:, :, None]
+    kv_pos_r = kv_pos_p[:, None, :]
 
     grid = (B, H, nq, nk)
 
@@ -366,7 +367,7 @@ def _flash_forward(
     # and the kernel skips their compute via the prefetched bound.  For
     # causal prefill this removes the dead upper-triangle K/V traffic that
     # the in-kernel block_live check alone still paid bandwidth for.
-    qmax = jnp.max(q_pos_r[:, :, 0].reshape(B, nq, block_q), axis=2)
+    qmax = jnp.max(q_pos_p.reshape(B, nq, block_q), axis=2)
     kmin = jnp.min(
         jnp.where(
             kv_pos_p >= 0, kv_pos_p, jnp.iinfo(jnp.int32).max
@@ -394,18 +395,18 @@ def _flash_forward(
         # Lane-replicated row logsumexp for the backward kernels.
         out_shape = (
             out_shape,
-            jax.ShapeDtypeStruct((B, H, Tp, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tp, 1), jnp.float32),
         )
         out_spec = (
             out_spec,
-            pl.BlockSpec((1, 1, block_q, _LANES), q_row),
+            pl.BlockSpec((1, 1, block_q, 1), q_row),
         )
     in_specs = [
         pl.BlockSpec(
-            (1, block_q, _LANES), lambda b, h, qi, ki, bound: (b, qi, 0)
+            (1, block_q, 1), lambda b, h, qi, ki, bound: (b, qi, 0)
         ),
         pl.BlockSpec(
-            (1, _SUBLANES, block_k),
+            (1, 1, block_k),
             lambda b, h, qi, ki, bound: (b, 0, _clamp_ki(b, qi, ki, bound)),
         ),
         pl.BlockSpec((1, 1, block_q, d), q_row),
@@ -424,16 +425,14 @@ def _flash_forward(
     ]
     operands = [q_pos_r, kv_pos_r, qt, kt, vt]
     if quantized:
-        # Sublane-replicated per-slot scale planes [B, KVH, SUBLANES, Sp],
-        # blocked along the kv axis like kv_pos.
+        # Narrow-sublane per-slot scale views [B, KVH, 1, Sp] — free
+        # expand_dims, blocked along the kv axis like kv_pos.
         def _scale_plane(s):
             st = _pad_to(jnp.moveaxis(s, 2, 1).astype(jnp.float32), 2, block_k)
-            return jnp.broadcast_to(
-                st[:, :, None, :], (B, KVH, _SUBLANES, Sp)
-            )
+            return st[:, :, None, :]
 
         scale_spec = pl.BlockSpec(
-            (1, 1, _SUBLANES, block_k),
+            (1, 1, 1, block_k),
             lambda b, h, qi, ki, bound: (
                 b, h // group, 0, _clamp_ki(b, qi, ki, bound)
             ),
@@ -496,6 +495,7 @@ def _flash_dq_kernel(
     q_pos_ref, kv_pos_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     dq_ref, dq_acc, *, scale: float,
 ):
+    # lse_ref/delta_ref are narrow-lane [1, 1, bq, 1] rows.
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -606,17 +606,14 @@ def _flash_backward(
     kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), 1, block_k, value=-1)
     Tp, Sp = qt.shape[2], kt.shape[2]
     nq, nk = Tp // block_q, Sp // block_k
-    q_pos_r = jnp.broadcast_to(q_pos_p[:, :, None], (B, Tp, _LANES))
-    kv_pos_r = jnp.broadcast_to(kv_pos_p[:, None, :], (B, _SUBLANES, Sp))
-    delta_r = jnp.broadcast_to(
-        _pad_to(jnp.moveaxis(delta, 2, 1), 2, block_q)[..., None],
-        (B, H, Tp, _LANES),
-    )
-    # lse comes from the forward already padded/replicated [B, H, Tp, LANES].
+    q_pos_r = q_pos_p[:, :, None]
+    kv_pos_r = kv_pos_p[:, None, :]
+    delta_r = _pad_to(jnp.moveaxis(delta, 2, 1), 2, block_q)[..., None]
+    # lse comes from the forward already padded, narrow-lane [B, H, Tp, 1].
 
     pos_specs = [
-        pl.BlockSpec((1, block_q, _LANES), lambda b, h, qi, ki: (b, qi, 0)),
-        pl.BlockSpec((1, _SUBLANES, block_k), lambda b, h, qi, ki: (b, 0, ki)),
+        pl.BlockSpec((1, block_q, 1), lambda b, h, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, 1, block_k), lambda b, h, qi, ki: (b, 0, ki)),
     ]
     q_row_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -627,10 +624,10 @@ def _flash_backward(
     ]
     row_aux_specs = [
         pl.BlockSpec(
-            (1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)
+            (1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)
         ),
         pl.BlockSpec(
-            (1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)
+            (1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)
         ),
     ]
 
@@ -658,14 +655,14 @@ def _flash_backward(
         return (b, h, ki, 0)
 
     dkv_specs = [
-        pl.BlockSpec((1, block_q, _LANES), lambda b, h, ki, qi: (b, qi, 0)),
-        pl.BlockSpec((1, _SUBLANES, block_k), lambda b, h, ki, qi: (b, 0, ki)),
+        pl.BlockSpec((1, block_q, 1), lambda b, h, ki, qi: (b, qi, 0)),
+        pl.BlockSpec((1, 1, block_k), lambda b, h, ki, qi: (b, 0, ki)),
         pl.BlockSpec((1, 1, block_q, d), qrow),
         pl.BlockSpec((1, 1, block_k, d), kvrow),
         pl.BlockSpec((1, 1, block_k, d), kvrow),
         pl.BlockSpec((1, 1, block_q, d), qrow),
-        pl.BlockSpec((1, 1, block_q, _LANES), qrow),
-        pl.BlockSpec((1, 1, block_q, _LANES), qrow),
+        pl.BlockSpec((1, 1, block_q, 1), qrow),
+        pl.BlockSpec((1, 1, block_q, 1), qrow),
     ]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, scale=scale),
