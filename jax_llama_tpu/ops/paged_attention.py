@@ -310,6 +310,78 @@ def paged_decode_attention(
     B, T, H, d = q.shape
     assert T == 1, "paged decode attention is a T=1 step"
     KVH = k_new.shape[2]
+
+    # Tensor/data-parallel serving: a pallas_call is not partitioned by
+    # GSPMD, so under an active mesh the whole op runs per-shard inside
+    # shard_map — KV heads split over "tensor" (the head layout
+    # h = kvh*G + g makes contiguous H chunks == contiguous KVH chunks),
+    # rows over the batch axes ("data", "fsdp") — the same pair the
+    # model's `constrain` shards batch over, so an fsdp-only mesh also
+    # routes through shard_map rather than leaving a GSPMD-sharded
+    # pallas_call.  The pool shards on its leading KVH axis; the table
+    # and q_pos shard with the rows; only pool_pos is replicated.  No
+    # collectives are needed: every (row, kv head) pair is independent;
+    # the caller's o-projection all-reduce (GSPMD) recombines heads
+    # exactly as on the xla path.
+    from ..parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        tp = mesh.shape.get("tensor", 1)
+        row_axes = tuple(
+            a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1
+        )
+        rp = int(np.prod([mesh.shape[a] for a in row_axes])) if row_axes else 1
+        if tp > 1 or rp > 1:
+            if KVH % tp != 0 or B % rp != 0:
+                raise NotImplementedError(
+                    f"paged kernel sharding needs kv_heads % tensor == 0 "
+                    f"and n_slots % (data*fsdp) == 0 (got KVH={KVH}, "
+                    f"tp={tp}, B={B}, rows={rp}); use a compatible mesh "
+                    f"or the gathered-view path"
+                )
+            rows = row_axes if row_axes else None
+            tens = "tensor" if tp > 1 else None
+            head4 = P(rows, None, tens, None)
+            pool4 = P(tens, None, None, None)
+            args = [q, k_new, v_new, k_pool, v_pool, pool_pos, table, q_pos]
+            in_specs = [
+                head4, head4, head4, pool4, pool4, P(None, None),
+                P(rows, None), P(rows),
+            ]
+            if k_scale is not None:
+                args += [k_scale, v_scale]
+                in_specs += [P(tens, None, None), P(tens, None, None)]
+
+            def body(q, k_new, v_new, k_pool, v_pool, pool_pos, table,
+                     q_pos, k_scale=None, v_scale=None):
+                return _paged_decode_local(
+                    q, k_new, v_new, k_pool, v_pool, pool_pos, table,
+                    q_pos, k_scale, v_scale, interpret,
+                )
+
+            fn = jax.shard_map(
+                body, mesh=mesh, in_specs=tuple(in_specs),
+                out_specs=head4, check_vma=False,
+            )
+            return fn(*args)
+
+    return _paged_decode_local(
+        q, k_new, v_new, k_pool, v_pool, pool_pos, table, q_pos,
+        k_scale, v_scale, interpret,
+    )
+
+
+def _paged_decode_local(
+    q, k_new, v_new, k_pool, v_pool, pool_pos, table, q_pos,
+    k_scale, v_scale, interpret,
+):
+    """Single-shard body of ``paged_decode_attention`` (also the whole op
+    when no mesh is active)."""
+    B, T, H, d = q.shape
+    KVH = k_new.shape[2]
     G = H // KVH
     scale = 1.0 / (d ** 0.5)
 

@@ -283,13 +283,23 @@ def _paged_decode_step(
 
     Attention path: the Pallas paged kernel walks the block table
     in-kernel (pool read once per step; int8 pools fold their dequant
-    scales in-kernel).  Fallbacks to the gathered contiguous view:
-    meshes (a pallas_call inside pjit is not auto-partitioned) and block
-    sizes that break Mosaic's 8-sublane tiling.
+    scales in-kernel).  Under a mesh the op itself shard_maps over the
+    tensor (KV heads) and data (rows) axes.  Fallbacks to the gathered
+    contiguous view: block sizes that break Mosaic's 8-sublane tiling,
+    and meshes the kernel sharding cannot cover (kv_heads % tensor != 0,
+    n_slots % data != 0, or active seq/stage axes).
     """
     with use_mesh(mesh):
         positions = jnp.where(active, pos, -1)[:, None]
-        use_kernel = mesh is None and pool.block_size % 8 == 0
+        use_kernel = pool.block_size % 8 == 0
+        if mesh is not None:
+            rows = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+            use_kernel &= (
+                config.kv_heads % mesh.shape.get("tensor", 1) == 0
+                and tau.shape[0] % rows == 0
+                and mesh.shape.get("seq", 1) == 1
+                and mesh.shape.get("stage", 1) == 1
+            )
         if use_kernel:
             pcache = PagedKVCache(
                 k=pool.k, v=pool.v, pos=pool.pos,

@@ -333,3 +333,32 @@ def test_int8_batcher_kernel_path_runs_and_matches_fp_closely():
     # int8 rounding may flip late near-ties; prefixes should agree.
     for g, w in zip(got, want):
         assert g[:3] == w[:3]
+
+
+def test_batcher_on_tensor_data_mesh_matches_unsharded():
+    """Continuous batching on a data x tensor mesh runs the paged kernel
+    per-shard via shard_map (KV heads over tensor, rows over data) and
+    must reproduce the unsharded batcher's greedy output."""
+    from jax_llama_tpu.parallel import make_mesh, shard_params
+    from jax_llama_tpu.serving import ContinuousBatcher
+
+    config = get_config(
+        "tiny", vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=128,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(1, 128, n)) for n in (6, 23, 41)]
+
+    def run(mesh, p):
+        cb = ContinuousBatcher(
+            p, config, n_slots=2, max_len=128, block_size=16, mesh=mesh,
+        )
+        rids = [cb.submit(x, max_new_tokens=8) for x in prompts]
+        res = cb.run_to_completion()
+        return [res[r] for r in rids]
+
+    want = run(None, params)
+    mesh = make_mesh(data=2, fsdp=2, tensor=2)
+    got = run(mesh, shard_params(params, mesh, config))
+    assert got == want
