@@ -55,7 +55,14 @@ def main() -> None:
                          "line) from stdin, stream completions as they "
                          "finish; requests share a slot pool")
     ap.add_argument("--slots", type=int, default=4,
-                    help="slot-pool size for --serve")
+                    help="slot-pool size for --serve / --http")
+    ap.add_argument("--http", type=int, default=None, metavar="PORT",
+                    help="serve over HTTP on this port (POST /generate "
+                         "with blocking or NDJSON-streaming responses, "
+                         "GET /metrics, /healthz) instead of the stdin "
+                         "loop; 0 picks a free port")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address for --http")
     args = ap.parse_args()
 
     import jax
@@ -102,6 +109,9 @@ def main() -> None:
             params = quantize_params(params, donate=True)
     print(f"restored {args.ckpt_dir} onto {mesh.shape} in {load_t.elapsed_s:.1f}s")
 
+    if args.http is not None:
+        _serve_http(params, config, tokenizer, mesh, args)
+        return
     if args.serve:
         _serve(params, config, tokenizer, mesh, args)
         return
@@ -125,6 +135,42 @@ def main() -> None:
     for p, o in zip(prompts, outs):
         print(f"\n=== {p!r}\n{o}")
     print(f"\n[{stats.summary()}] (incl. compile)")
+
+
+def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
+    """HTTP front-end: LLMServer over the batcher until interrupted.
+
+    ``_test_hook(srv)``, when given, runs once the server is up and then
+    the function returns instead of blocking (tests drive requests
+    against the live server without a second process).
+    """
+    import time
+
+    from .server import LLMServer
+    from .serving import ContinuousBatcher
+
+    stops = tuple(
+        int(s) for s in getattr(tokenizer, "stop_tokens", [tokenizer.eos_id])
+    )
+    cb = ContinuousBatcher(
+        params, config, n_slots=args.slots,
+        max_len=config.max_seq_len, stop_tokens=stops,
+        temperature=args.temperature, top_p=args.top_p,
+        seed=args.seed, mesh=mesh,
+    )
+    with LLMServer(
+        cb, tokenizer=tokenizer, host=args.host, port=args.http
+    ) as srv:
+        print(f"serving on {srv.address} "
+              f"(POST /generate, GET /metrics, /healthz)", flush=True)
+        if _test_hook is not None:
+            _test_hook(srv)
+            return
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            print("\nshutting down", flush=True)
 
 
 def _serve(params, config, tokenizer, mesh, args) -> None:

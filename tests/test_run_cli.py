@@ -65,3 +65,50 @@ def test_run_cli_serve_mode(tmp_path, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert "'hello'" in out and "'world'" in out
     assert "served 2 request(s)" in out
+
+
+def test_run_cli_http_mode(tmp_path, capsys, monkeypatch):
+    """--http starts LLMServer over the batcher; requests served live
+    (driven in-process via the test hook instead of the blocking loop)."""
+    import json
+    import urllib.request
+
+    config = get_config(
+        "tiny", vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(str(ckpt), params, config)
+
+    hits = {}
+
+    def hook(srv):
+        req = urllib.request.Request(
+            srv.address + "/generate",
+            data=json.dumps(
+                {"text": "hi", "max_new_tokens": 4, "temperature": 0.0}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            hits["gen"] = json.loads(r.read())
+        with urllib.request.urlopen(srv.address + "/healthz", timeout=60) as r:
+            hits["health"] = json.loads(r.read())
+
+    orig = run_cli._serve_http
+    monkeypatch.setattr(
+        run_cli, "_serve_http",
+        lambda *a, **kw: orig(*a, **kw, _test_hook=hook),
+    )
+    monkeypatch.setattr(
+        sys, "argv",
+        ["run", "--ckpt-dir", str(ckpt), "--byte-tokenizer",
+         "--tensor", "2", "--http", "0", "--max-gen-len", "8",
+         "--temperature", "0.0"],
+    )
+    run_cli.main()
+    out = capsys.readouterr().out
+    assert "serving on http://" in out
+    assert len(hits["gen"]["tokens"]) == 4 and "text" in hits["gen"]
+    assert hits["health"]["ok"] is True
