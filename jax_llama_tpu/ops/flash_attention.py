@@ -392,7 +392,7 @@ def _flash_forward(
     out_shape = jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype)
     out_spec = pl.BlockSpec((1, 1, block_q, d), q_row)
     if need_lse:
-        # Lane-replicated row logsumexp for the backward kernels.
+        # Narrow-lane row logsumexp for the backward kernels.
         out_shape = (
             out_shape,
             jax.ShapeDtypeStruct((B, H, Tp, 1), jnp.float32),
@@ -582,7 +582,7 @@ def _flash_dkv_kernel(
 def _flash_backward(
     q, k, v, q_pos, kv_pos, out, lse, g, block_q, block_k, interpret
 ):
-    """Blockwise VJP.  Memory is O(S·d) per head (plus the lane-replicated
+    """Blockwise VJP.  Memory is O(S·d) per head (plus narrow-lane
     lse/Δ rows) — replacing the r1 dense-recompute fallback whose backward
     materialized the full [B, H, T, S] score matrix."""
     B, T, H, d = q.shape
