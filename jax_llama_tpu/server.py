@@ -184,6 +184,29 @@ class LLMServer:
                 else:
                     self._blocking_reply(pending)
 
+            def _client_gone(self) -> bool:
+                # Readable-EOF probe: a closed client socket selects
+                # readable and MSG_PEEK returns b"".  Without this, a
+                # client that disconnects while its request is QUEUED or
+                # mid-generation (no tokens flowing to a blocking caller,
+                # so no write ever fails) would keep its slot, blocks,
+                # and decode work until natural completion.
+                # Known trade-off: a client that half-closes
+                # (shutdown(SHUT_WR)) after POSTing and then waits to
+                # read is indistinguishable from a vanished one at this
+                # layer and gets cancelled; HTTP/1.1 clients that
+                # half-close are rare and widely treated as aborts
+                # (nginx/gunicorn behave the same way).
+                try:
+                    r, _, _ = select.select([self.connection], [], [], 0)
+                    if not r:
+                        return False
+                    return (
+                        self.connection.recv(1, socket.MSG_PEEK) == b""
+                    )
+                except (OSError, ValueError):
+                    return True
+
             def _blocking_reply(self, pending: "_Pending"):
                 # Poll _closed so a request enqueued just as the loop dies
                 # (put racing the final drain) still unblocks.
@@ -191,6 +214,9 @@ class LLMServer:
                     if server._closed.is_set() and not pending.done.is_set():
                         pending.fail("server shutting down", 503)
                         break
+                    if self._client_gone():
+                        pending.disconnected = True
+                        return  # the loop reaps the request
                 if pending.timed_out:
                     self._reply_json(
                         504,
@@ -235,23 +261,6 @@ class LLMServer:
                         pending.disconnected = True
                         return False
 
-                def client_gone() -> bool:
-                    # Readable-EOF probe: a closed client socket selects
-                    # readable and MSG_PEEK returns b"".  Without this, a
-                    # client that disconnects while its request is still
-                    # QUEUED (no tokens flowing, so no write ever fails)
-                    # would keep its queue position and be admitted,
-                    # prefilled, and decoded for a dead socket.
-                    try:
-                        r, _, _ = select.select([self.connection], [], [], 0)
-                        if not r:
-                            return False
-                        return (
-                            self.connection.recv(1, socket.MSG_PEEK) == b""
-                        )
-                    except (OSError, ValueError):
-                        return True
-
                 while True:
                     try:
                         ev = pending.chunks.get(timeout=1.0)
@@ -259,7 +268,7 @@ class LLMServer:
                         if server._closed.is_set():
                             pending.fail("server shutting down", 503)
                             ev = _DONE
-                        elif client_gone():
+                        elif self._client_gone():
                             pending.disconnected = True
                             return  # the loop reaps the request
                         else:

@@ -24,9 +24,11 @@ TPU-native mechanics:
   * **Decode via the Pallas paged-attention kernel.**  Each step runs
     ``models.paged_forward``: the kernel's BlockSpec index maps chase the
     block table directly (scalar prefetch), so the pool is read ONCE per
-    step and no contiguous view is ever materialized.  A gathered-view
-    fallback (per-row virtually-contiguous cache + the model's
-    per-row-offset forward) remains for int8 pools, meshes, and
+    step and no contiguous view is ever materialized (int8 pools fold
+    their dequant scales in-kernel).  A gathered-view fallback (per-row
+    virtually-contiguous cache + the model's per-row-offset forward)
+    remains for kernel-incompatible meshes (kv_heads % tensor != 0,
+    n_slots % (data*fsdp) != 0, or active seq/stage axes) and
     non-8-multiple block sizes, and serves the multi-token forwards
     (speculative rounds).
   * **Per-request sampling.**  temperature/top-p/top-k and the PRNG
@@ -291,6 +293,10 @@ def _paged_decode_step(
     """
     with use_mesh(mesh):
         positions = jnp.where(active, pos, -1)[:, None]
+        # %8: Mosaic's sublane tiling.  Sub-128 (narrow-lane) block sizes
+        # are verified compiled on hardware — bf16 and int8 kernels match
+        # interpret mode exactly at BLK 8/16/32/64/128 on a v5e chip
+        # (regression-tested in tests/test_tpu_compiled.py).
         use_kernel = pool.block_size % 8 == 0
         if mesh is not None:
             rows = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)

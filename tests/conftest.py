@@ -3,9 +3,14 @@
 Mirrors the survey's test-plan recommendation (SURVEY.md §4): DP/TP/FSDP
 paths must be testable without TPU hardware via
 ``--xla_force_host_platform_device_count``.
+
+Exception: ``pytest -m tpu`` (exactly that mark expression) keeps the
+real TPU backend so tests/test_tpu_compiled.py can compile the Pallas
+kernels on the chip; those tests skip themselves on any other backend.
 """
 
 import os
+import sys
 
 # NOTE: this image's sitecustomize registers the axon TPU backend and forces
 # JAX_PLATFORMS=axon before conftest runs, so a plain env var is not enough —
@@ -18,10 +23,33 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+
+def _tpu_marker_run() -> bool:
+    # The platform must be pinned before any test module touches a device,
+    # which is earlier than pytest_configure reliably exposes options
+    # across plugin orderings — parse argv directly.
+    argv = sys.argv
+    for i, a in enumerate(argv):
+        if a == "-m" and i + 1 < len(argv):
+            return argv[i + 1].strip() == "tpu"
+        if a.startswith("-m="):
+            return a[3:].strip() == "tpu"
+    return False
+
+
+if not _tpu_marker_run():
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: compiled-on-chip kernel regression tests (run: pytest -m tpu "
+        "on a TPU host; forced-CPU otherwise and the tests self-skip)",
+    )
 
 
 @pytest.fixture(autouse=True)

@@ -260,6 +260,55 @@ def test_http_client_disconnect_cancels_stream(model):
         assert status == 200 and len(body["tokens"]) == 3
 
 
+def test_http_client_disconnect_cancels_blocking(model):
+    """A NON-streaming /generate whose client vanishes must also be
+    reaped: nothing ever writes to the socket until completion, so the
+    _blocking_reply wait loop's readable-EOF probe is the only signal."""
+    import socket
+    import time as _time
+
+    params, config = model
+    # A generation budget far larger than the reap window can finish, so
+    # the only way the slot frees is the disconnect probe + _reap.
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=4096)
+    total_blocks = cb.n_blocks
+    with LLMServer(cb) as srv:
+        host, port = srv.httpd.server_address[:2]
+        # Warm the compile caches first.
+        status, _ = _post(
+            srv.address, {"prompt": [4, 5, 6], "max_new_tokens": 2}
+        )
+        assert status == 200
+        payload = json.dumps(
+            {"prompt": [7, 8, 9], "max_new_tokens": 3000}
+        ).encode()
+        s = socket.create_connection((host, port), timeout=30)
+        s.sendall(
+            b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            + f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+        )
+        _time.sleep(0.5)  # let the handler enqueue + the loop admit it
+        s.close()
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            if (
+                len(cb.free_blocks) == total_blocks
+                and all(sl is None for sl in cb.slots.values())
+                and not cb.queue
+            ):
+                break
+            _time.sleep(0.2)
+        else:
+            assert False, "disconnected blocking request was never reaped"
+        # Reaped by cancellation, not by finishing the 3000 tokens.
+        assert cb.emitted_total < 3000
+        status, body = _post(
+            srv.address, {"prompt": [1, 2], "max_new_tokens": 3}
+        )
+        assert status == 200 and len(body["tokens"]) == 3
+
+
 def test_batcher_cancel_queued_and_active(model):
     params, config = model
     cb = ContinuousBatcher(params, config, n_slots=1, max_len=64)

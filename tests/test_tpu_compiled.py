@@ -1,0 +1,194 @@
+"""On-chip (compiled Mosaic) kernel regression tests — ``pytest -m tpu``.
+
+Every other test in this suite runs the Pallas kernels in interpret mode
+on CPU (tests/conftest.py forces the CPU backend).  This file is the
+complement: it compiles the flash forward/backward, flash-quantized, and
+paged-attention kernels on the real TPU chip and asserts parity against
+the XLA reference paths — turning the round-2 prose claims
+("compiled-vs-interpret parity ~7e-5", "int8 flash vs dequantized sdpa
+rel ~4e-3", ROADMAP.md) into runnable regressions.
+
+Run with ``python -m pytest tests/ -m tpu`` ON A TPU HOST: the conftest
+leaves the real backend in place only when the marker expression is
+exactly ``tpu`` (any other invocation forces CPU and these tests
+auto-skip).  The reference's analogue is its CUDA-gated tier-3 harness
+(``/root/reference/jax_test.py:428-429``); here the on-chip tier is a
+first-class pytest marker instead of a manual script.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.tpu
+
+requires_tpu = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="needs the real TPU chip (run: pytest -m tpu)",
+)
+
+
+def _rel(a, b):
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    return np.abs(a - b).max() / max(np.abs(b).max(), 1e-9)
+
+
+@requires_tpu
+@pytest.mark.parametrize("blk", [8, 16, 32, 64, 128])
+@pytest.mark.parametrize("quantized", [False, True])
+def test_paged_kernel_block_sizes_compiled(blk, quantized):
+    """The serving eligibility gate is block_size % 8 == 0; this is the
+    hardware evidence behind it (ADVICE r2): every narrow-lane block size
+    compiles under Mosaic and matches interpret mode, bf16 and int8."""
+    from jax_llama_tpu.ops.paged_attention import paged_pool_attention
+
+    B, KVH, G, d = 4, 4, 2, 128
+    NB, MB = 16, 4
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, KVH, G, d), jnp.bfloat16)
+    table = jnp.asarray(
+        np.arange(B * MB, dtype=np.int32).reshape(B, MB) % NB
+    )
+    pos = jnp.asarray(np.tile(np.arange(blk, dtype=np.int32), (NB, 1)))
+    qpos = jnp.asarray(np.full((B,), blk - 1, np.int32))
+    if quantized:
+        kp = jnp.asarray(rng.randint(-127, 128, (KVH, NB, blk, d)), jnp.int8)
+        vp = jnp.asarray(rng.randint(-127, 128, (KVH, NB, blk, d)), jnp.int8)
+        ks = jnp.asarray(rng.rand(KVH, NB, blk) * 0.02, jnp.float32)
+        vs = jnp.asarray(rng.rand(KVH, NB, blk) * 0.02, jnp.float32)
+        scales = dict(k_scale=ks, v_scale=vs)
+    else:
+        kp = jnp.asarray(rng.randn(KVH, NB, blk, d), jnp.bfloat16)
+        vp = jnp.asarray(rng.randn(KVH, NB, blk, d), jnp.bfloat16)
+        scales = {}
+    out_c, lse_c = paged_pool_attention(
+        q, kp, vp, pos, table, qpos, interpret=False, **scales
+    )
+    out_i, lse_i = paged_pool_attention(
+        q, kp, vp, pos, table, qpos, interpret=True, **scales
+    )
+    assert np.isfinite(np.asarray(out_c, np.float32)).all()
+    assert _rel(out_c, out_i) < 1e-5
+    assert np.abs(np.asarray(lse_c) - np.asarray(lse_i)).max() < 1e-4
+
+
+@requires_tpu
+@pytest.mark.parametrize("S", [1024, 4096])
+def test_flash_forward_compiled_parity(S):
+    """Compiled flash forward vs (a) interpret mode and (b) the dense XLA
+    sdpa path, at prefill shapes."""
+    from jax_llama_tpu.ops.attention import attention_bias, sdpa
+    from jax_llama_tpu.ops.flash_attention import flash_attention
+
+    B, H, KVH, d = 1, 8, 4, 128
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, S, H, d) * 0.3, jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, KVH, d) * 0.3, jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, KVH, d) * 0.3, jnp.bfloat16)
+    pos = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+
+    out_c = flash_attention(q, k, v, pos, pos, interpret=False)
+    out_i = flash_attention(q, k, v, pos, pos, interpret=True)
+    # Same blockwise arithmetic, compiled vs emulated: tight.
+    assert _rel(out_c, out_i) < 5e-4
+    bias = attention_bias(pos, pos, pos >= 0)
+    ref = sdpa(q, k, v, bias)
+    # Different reduction orders in bf16: loose.
+    assert _rel(out_c, ref) < 2e-2
+
+
+@requires_tpu
+def test_flash_backward_compiled_parity():
+    """Compiled flash VJP (dq/dk/dv) vs the dense sdpa VJP on chip."""
+    from jax_llama_tpu.ops.attention import attention_bias, sdpa
+    from jax_llama_tpu.ops.flash_attention import flash_attention
+
+    B, S, H, KVH, d = 1, 1024, 8, 4, 128
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(B, S, H, d) * 0.3, jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, KVH, d) * 0.3, jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, KVH, d) * 0.3, jnp.bfloat16)
+    pos = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+    g = jnp.asarray(rng.randn(B, S, H, d) * 0.3, jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, pos, pos, interpret=False)
+            .astype(jnp.float32) * g.astype(jnp.float32)
+        )
+
+    def loss_ref(q, k, v):
+        bias = attention_bias(pos, pos, pos >= 0)
+        return jnp.sum(
+            sdpa(q, k, v, bias).astype(jnp.float32)
+            * g.astype(jnp.float32)
+        )
+
+    gq, gk, gv = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    rq, rk, rv = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    assert _rel(gq, rq) < 3e-2
+    assert _rel(gk, rk) < 3e-2
+    assert _rel(gv, rv) < 3e-2
+
+
+@requires_tpu
+def test_flash_quantized_compiled_parity():
+    """Compiled int8-KV flash kernel vs sdpa over the dequantized cache
+    (the r2 claim: rel ~4e-3 — int8-rounding noise level in bf16)."""
+    from jax_llama_tpu.models.llama import quantize_kv
+    from jax_llama_tpu.ops.attention import attention_bias, sdpa
+    from jax_llama_tpu.ops.flash_attention import flash_attention_quantized
+
+    B, S, H, KVH, d = 2, 512, 8, 4, 128
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, S, H, d) * 0.3, jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, KVH, d) * 0.3, jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, KVH, d) * 0.3, jnp.bfloat16)
+    pos = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    out_c = flash_attention_quantized(
+        q, kq, vq, ks, vs, pos, pos, interpret=False
+    )
+    kd = (kq.astype(jnp.float32) * ks[..., None]).astype(jnp.bfloat16)
+    vd = (vq.astype(jnp.float32) * vs[..., None]).astype(jnp.bfloat16)
+    bias = attention_bias(pos, pos, pos >= 0)
+    ref = sdpa(q, kd, vd, bias)
+    assert _rel(out_c, ref) < 2e-2
+
+
+@requires_tpu
+def test_model_decode_on_chip_flash_vs_xla():
+    """Model-level canary: short greedy decode on the chip must agree
+    between attn_impl='auto' (flash prefill + xla decode) and pure 'xla',
+    and produce finite logits."""
+    import jax_llama_tpu as jlt
+    from jax_llama_tpu.engine import GenerationConfig, generate
+
+    rng = np.random.RandomState(4)
+    kw = dict(
+        vocab_size=512, dim=256, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=256, dtype="bfloat16",
+        param_dtype="bfloat16",
+    )
+    cfg_auto = jlt.get_config("tiny", **kw)
+    params = jlt.init_params(jax.random.PRNGKey(0), cfg_auto)
+    tokens = jnp.asarray(rng.randint(1, 512, (2, 32)), jnp.int32)
+    mask = jnp.ones((2, 32), bool)
+    gc = GenerationConfig(max_new_tokens=8, temperature=0.0, stop_tokens=())
+    out_auto = np.asarray(generate(
+        params, tokens, mask, jax.random.PRNGKey(0), config=cfg_auto,
+        gen_config=gc,
+    ))
+    cfg_xla = cfg_auto.replace(attn_impl="xla")
+    out_xla = np.asarray(generate(
+        params, tokens, mask, jax.random.PRNGKey(0), config=cfg_xla,
+        gen_config=gc,
+    ))
+    # bf16 near-ties can legitimately flip a late token; require the
+    # first half of the generations to agree exactly.
+    assert (out_auto[:, : 32 + 4] == out_xla[:, : 32 + 4]).all()
