@@ -171,7 +171,8 @@ def main() -> None:
     # Continuous-batching serving throughput through the Pallas
     # paged-attention decode kernel (block-table pool, 8 slots, ~1k-token
     # contexts).  Wall-clock includes the per-step host dispatch of this
-    # environment; min-of-3 full drains.
+    # environment; min-of-3 full drains.  The 8 submits are admitted as
+    # ONE batched prefill dispatch (burst admission).
     # ------------------------------------------------------------------
     from jax_llama_tpu.serving import ContinuousBatcher
 
@@ -185,14 +186,130 @@ def main() -> None:
             cb.submit(list(srng.randint(1, config.vocab_size, 850)),
                       max_new_tokens=48)
         t0 = time.time()
+        first = cb.step()          # burst admission + first decode step
+        admit_s = time.time() - t0
+        emitted = len(first)
+        while cb.pending():
+            emitted += len(cb.step())
+        return time.time() - t0, emitted, admit_s
+
+    serve_run()  # compile warmup (insert + step programs)
+    serve_best, serve_toks, admit_s = min(serve_run() for _ in range(3))
+    paged_serving_toks_per_s = serve_toks / serve_best
+
+    # ------------------------------------------------------------------
+    # Speculative serving (target as its own draft => 100% acceptance):
+    # isolates the speculative round's mechanics.  Kernel path (T=1 draft
+    # steps + one multi-token verify kernel pass, pools never gathered)
+    # vs the gathered-view fallback (forced via a non-8-multiple block
+    # size), same workload — the delta is the gather traffic.
+    # ------------------------------------------------------------------
+    def spec_run(block_size):
+        cb = ContinuousBatcher(
+            params, config, n_slots=4, max_len=1024,
+            block_size=block_size,
+            draft_params=params, draft_config=config, n_draft=3,
+        )
+        srng = np.random.RandomState(2)
+        for _ in range(4):
+            cb.submit(list(srng.randint(1, config.vocab_size, 500)),
+                      max_new_tokens=48)
+        t0 = time.time()
         emitted = 0
         while cb.pending():
             emitted += len(cb.step())
         return time.time() - t0, emitted
 
-    serve_run()  # compile warmup (insert + step programs)
-    serve_best, serve_toks = min(serve_run() for _ in range(3))
-    paged_serving_toks_per_s = serve_toks / serve_best
+    spec_run(128)  # warmup
+    sk_t, sk_n = min(spec_run(128) for _ in range(3))
+    spec_kernel_toks_per_s = sk_n / sk_t
+    spec_run(100)  # warmup (100 % 8 != 0 -> gathered fallback)
+    sg_t, sg_n = min(spec_run(100) for _ in range(3))
+    spec_gathered_toks_per_s = sg_n / sg_t
+
+    # Larger serving batch (B=16): decode is weight-bandwidth-bound, so
+    # tokens/sec/chip scales with rows — extra evidence beyond the
+    # fixed-B=8 headline (kept at 8 for r1/r2 comparability).
+    tokens16 = jnp.asarray(
+        rng.randint(0, config.vocab_size, (16, P)), jnp.int32
+    )
+    mask16 = jnp.ones((16, P), dtype=bool)
+
+    def run16(max_new):
+        gc = GenerationConfig(
+            max_new_tokens=max_new, temperature=0.0, stop_tokens=()
+        )
+        t0 = time.time()
+        out = generate(
+            params, tokens16, mask16, key, config=config, gen_config=gc
+        )
+        np.asarray(out)
+        return time.time() - t0
+
+    run16(N)
+    run16(1)
+    full16 = min(run16(N) for _ in range(5))
+    short16 = min(run16(1) for _ in range(5))
+    b16_toks_per_s = 16 * (N - 1) / max(full16 - short16, 1e-9)
+
+    # ------------------------------------------------------------------
+    # Decode step breakdown from an xplane trace (device-op time per
+    # decode step, bucketed by HLO source attribution).  Optional: if the
+    # profiler/proto stack is unavailable the bench still emits its line.
+    # ------------------------------------------------------------------
+    step_breakdown = None
+    try:
+        import collections
+        import glob
+        import re
+        import tempfile
+
+        tmpdir = tempfile.mkdtemp(prefix="bench_xplane_")
+        gc32 = GenerationConfig(
+            max_new_tokens=32, temperature=0.0, stop_tokens=()
+        )
+        np.asarray(generate(
+            params, tokens, mask, key, config=config, gen_config=gc32
+        ))
+        jax.profiler.start_trace(tmpdir)
+        np.asarray(generate(
+            params, tokens, mask, key, config=config, gen_config=gc32
+        ))
+        jax.profiler.stop_trace()
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+        xp = glob.glob(f"{tmpdir}/**/*.xplane.pb", recursive=True)[0]
+        xs = xplane_pb2.XSpace()
+        with open(xp, "rb") as f:
+            xs.ParseFromString(f.read())
+        plane = next(p for p in xs.planes if "TPU" in p.name)
+        sm = {k: v.name for k, v in plane.stat_metadata.items()}
+        md_name, md_src = {}, {}
+        for k, v in plane.event_metadata.items():
+            md_name[k] = v.name
+            src = next(
+                (
+                    st.str_value
+                    for st in v.stats
+                    if sm.get(st.metadata_id) == "source"
+                ),
+                "",
+            )
+            m = re.search(r"/(\w+\.py):", src)
+            md_src[k] = m.group(1) if m else "other"
+        line = next(ln for ln in plane.lines if ln.name == "XLA Ops")
+        agg = collections.Counter()
+        for e in line.events:
+            if md_name[e.metadata_id].startswith("%while"):
+                continue  # outer loops double-count their bodies
+            agg[md_src[e.metadata_id]] += e.duration_ps
+        steps = 32
+        step_breakdown = {
+            src: round(ps / 1e6 / steps, 1)  # us per decode step
+            for src, ps in agg.most_common(8)
+        }
+    except Exception:
+        step_breakdown = None
 
     # BASELINE.json's 50 tok/s/chip target is stated for Llama-3-70B on
     # v5p; decode is HBM-bandwidth-bound, so scale the per-chip target by
@@ -239,6 +356,26 @@ def main() -> None:
             "paged_serving_tokens_per_s": round(
                 paged_serving_toks_per_s, 2
             ),
+            # 8 submits -> ONE batched prefill dispatch + first decode.
+            "burst_admission_s": round(admit_s, 3),
+            # Speculative serving (self-draft, n_draft=3): Pallas path
+            # (T=1 draft steps + multi-token verify kernel) vs the
+            # gathered-view fallback on the same workload.
+            "spec_serving_kernel_tokens_per_s": round(
+                spec_kernel_toks_per_s, 2
+            ),
+            "spec_serving_gathered_tokens_per_s": round(
+                spec_gathered_toks_per_s, 2
+            ),
+            # Batch-16 steady-state decode (headline stays B=8 for
+            # round-over-round comparability).
+            "decode_tokens_per_s_b16": round(b16_toks_per_s, 2),
+            # Device-op µs per decode step bucketed by HLO source file
+            # (quant.py = the projection/MLP matmul fusions, attention.py
+            # = the decode attention chain, llama.py = cache/update ops,
+            # rope.py = rotation).  Includes prefill amortized over 32
+            # steps; None when the profiler stack is unavailable.
+            "step_breakdown_us": step_breakdown,
         },
     }
     print(json.dumps(result))

@@ -69,6 +69,9 @@ class LLaMAConfig:
     dtype: str = "bfloat16"               # activation/compute dtype
     param_dtype: str = "float32"          # parameter storage dtype
     scan_layers: bool = True              # lax.scan over stacked layers
+    scan_unroll: int = 1                  # lax.scan unroll factor (layers
+                                          # per scan iteration; lets XLA
+                                          # pipeline DMAs across layers)
     remat: bool = False                   # jax.checkpoint each block
     attn_impl: str = "xla"                # "xla" | "flash" (Pallas) | "ring"
                                           #   (seq-parallel ring attention) |

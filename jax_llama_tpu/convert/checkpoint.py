@@ -94,8 +94,57 @@ def load_checkpoint(
     else:
         abstract = shapes
     ckptr = ocp.StandardCheckpointer()
-    params = ckptr.restore(path / "params", abstract)
+    try:
+        params = ckptr.restore(path / "params", abstract)
+    except Exception:
+        params = _restore_old_layout(
+            ckptr, path, config, quantized, mesh, fsdp
+        )
     return params, config
+
+
+def _old_layout_shapes(config: LLaMAConfig) -> Any:
+    """Abstract param tree in the pre-fused layout (separate q/k/v and
+    gate/up — rounds 1-2 checkpoints)."""
+    from ..models.llama import split_qkv
+
+    def build():
+        params = init_params(jax.random.PRNGKey(0), config)
+        lp = dict(params["layers"])
+        q, k, v = split_qkv(lp.pop("qkv"))
+        gate_up = lp.pop("gate_up")
+        lp.update(
+            q=q, k=k, v=v, gate=gate_up[:, :, 0], up=gate_up[:, :, 1]
+        )
+        out = dict(params)
+        out["layers"] = lp
+        return out
+
+    return jax.eval_shape(build)
+
+
+def _restore_old_layout(ckptr, path, config, quantized, mesh, fsdp):
+    """Fallback for checkpoints saved before the fused qkv/gate_up layout:
+    restore the old tree on host, migrate with ``fuse_params``, then shard
+    onto the mesh if one was given.  Quantized old checkpoints cannot be
+    migrated (int8 scales do not concatenate) — re-quantize from the
+    full-precision source instead."""
+    from ..models.llama import fuse_params
+
+    if quantized:
+        raise ValueError(
+            f"{path} is an int8-quantized checkpoint in the old (separate "
+            "q/k/v) layout; per-channel scales cannot be fused — "
+            "re-quantize from the full-precision checkpoint with "
+            "quantize_params and save again"
+        )
+    old = ckptr.restore(path / "params", _old_layout_shapes(config))
+    params = fuse_params(old)
+    if mesh is not None:
+        from ..parallel.partition import shard_params
+
+        params = shard_params(params, mesh, config, fsdp=fsdp)
+    return params
 
 
 # ---------------------------------------------------------------------------

@@ -6,12 +6,15 @@ convert_weights.py:52-92``), same tensor mapping contract:
   Meta tensor (torch [out, in])        shard axis  →  this framework
   ----------------------------------   ----------     ------------------------
   tok_embeddings.weight  [V, D]        1 (D)          embed.embedding [V, D]
-  layers.N.attention.wq  [H*hd, D]     0              layers.q  [L, D, H, hd]
-  layers.N.attention.wk  [KVH*hd, D]   0              layers.k  [L, D, KVH, hd]
-  layers.N.attention.wv  [KVH*hd, D]   0              layers.v  [L, D, KVH, hd]
+  layers.N.attention.wq  [H*hd, D]     0              layers.qkv[..., :G, :]
+  layers.N.attention.wk  [KVH*hd, D]   0              layers.qkv[..., G, :]
+  layers.N.attention.wv  [KVH*hd, D]   0              layers.qkv[..., G+1, :]
+                                       (qkv is the fused [L, D, KVH, G+2, hd]
+                                        decode layout, G = H // KVH; see
+                                        models.llama.fuse_qkv)
   layers.N.attention.wo  [D, H*hd]     1              layers.o  [L, H, hd, D]
-  layers.N.feed_forward.w1 [F, D]      0              layers.gate [L, D, F]
-  layers.N.feed_forward.w3 [F, D]      0              layers.up   [L, D, F]
+  layers.N.feed_forward.w1 [F, D]      0              layers.gate_up[:, :, 0]
+  layers.N.feed_forward.w3 [F, D]      0              layers.gate_up[:, :, 1]
   layers.N.feed_forward.w2 [D, F]      1              layers.down [L, F, D]
   layers.N.attention_norm / ffn_norm   replicated     layers.attn_norm/mlp_norm
   norm.weight                          replicated     final_norm
@@ -165,23 +168,32 @@ def convert_meta_checkpoint(
     def row(key: str) -> np.ndarray:  # [D, out] shards -> [out, D]
         return _take(shards, key, 1).T
 
+    G = H // KVH
     layer_acc: Dict[str, list] = {
-        k: [] for k in ("attn_norm", "q", "k", "v", "o", "mlp_norm",
-                        "gate", "up", "down")
+        k: [] for k in ("attn_norm", "qkv", "o", "mlp_norm",
+                        "gate_up", "down")
     }
     for i in range(config.n_layers):
         pre = f"layers.{i}."
         layer_acc["attn_norm"].append(
             _take(shards, pre + "attention_norm.weight", None).astype(od)
         )
-        layer_acc["q"].append(
-            col(pre + "attention.wq.weight").reshape(D, H, hd).astype(od)
-        )
-        layer_acc["k"].append(
-            col(pre + "attention.wk.weight").reshape(D, KVH, hd).astype(od)
-        )
-        layer_acc["v"].append(
-            col(pre + "attention.wv.weight").reshape(D, KVH, hd).astype(od)
+        # Fused decode layout: per KV head, slots [q_0..q_{G-1}, k, v]
+        # (models.llama.fuse_qkv's contract; query head h = kvh*G + g is
+        # Meta's own head order, so no HEAD permutation happens — but the
+        # q/k head_dim FEATURES are permuted to the runtime half-split
+        # RoPE order, see ops.rope / models.llama.rope_permute).
+        from ..models.llama import rope_permute
+
+        q_i = rope_permute(
+            col(pre + "attention.wq.weight").reshape(D, H, hd)
+        ).reshape(D, KVH, G, hd)
+        k_i = rope_permute(
+            col(pre + "attention.wk.weight").reshape(D, KVH, hd)
+        ).reshape(D, KVH, 1, hd)
+        v_i = col(pre + "attention.wv.weight").reshape(D, KVH, 1, hd)
+        layer_acc["qkv"].append(
+            np.concatenate([q_i, k_i, v_i], axis=2).astype(od)
         )
         layer_acc["o"].append(
             row(pre + "attention.wo.weight").reshape(H, hd, D).astype(od)
@@ -189,9 +201,13 @@ def convert_meta_checkpoint(
         layer_acc["mlp_norm"].append(
             _take(shards, pre + "ffn_norm.weight", None).astype(od)
         )
-        layer_acc["gate"].append(col(pre + "feed_forward.w1.weight").astype(od))
+        layer_acc["gate_up"].append(
+            np.stack(
+                [col(pre + "feed_forward.w1.weight"),
+                 col(pre + "feed_forward.w3.weight")], axis=1
+            ).astype(od)
+        )
         layer_acc["down"].append(row(pre + "feed_forward.w2.weight").astype(od))
-        layer_acc["up"].append(col(pre + "feed_forward.w3.weight").astype(od))
 
     # Embedding shard layout differs by family: Llama-2 splits the model dim
     # (ParallelEmbedding, concat axis 1); Llama-3 splits the vocab dim

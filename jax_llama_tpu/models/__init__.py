@@ -1,9 +1,15 @@
 from .llama import (
     KVCache,
     forward,
+    fuse_params,
+    fuse_qkv,
     init_cache,
     init_params,
     param_count,
+    split_qkv,
 )
 
-__all__ = ["KVCache", "forward", "init_cache", "init_params", "param_count"]
+__all__ = [
+    "KVCache", "forward", "fuse_params", "fuse_qkv", "init_cache",
+    "init_params", "param_count", "split_qkv",
+]

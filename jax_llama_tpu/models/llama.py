@@ -27,12 +27,26 @@ Param tree layout (all layers stacked on a leading L axis):
 
     {"embed":  {"embedding": [V, D]},
      "layers": {"attn_norm": [L, D],
-                "q": [L, D, H, hd], "k": [L, D, KVH, hd],
-                "v": [L, D, KVH, hd], "o": [L, H, hd, D],
+                "qkv": [L, D, KVH, G+2, hd],   # G = H // KVH (GQA group)
+                "o": [L, H, hd, D],
                 "mlp_norm": [L, D],
-                "gate": [L, D, F], "up": [L, D, F], "down": [L, F, D]},
+                "gate_up": [L, D, 2, F], "down": [L, F, D]},
      "final_norm": [D],
      "lm_head": [D, V]}            # absent when tie_word_embeddings
+
+The q/k/v projections are stored FUSED as one weight (and gate/up as
+another): decode is HBM-bandwidth-bound, and one [D, KVH*(G+2)*hd]
+matmul streams the same bytes as three separate ones but pays one
+fusion's fixed cost instead of three and keeps the DMA pipeline in a
+single long burst (xplane-measured: the three separate projections ran
+at ~80% of the bandwidth roofline vs ~90%+ for the large MLP matmuls —
+the reference also runs them separately,
+``/root/reference/jax_llama/model.py:210-214``).  Slot layout along
+axis 3 of ``qkv``: [q_0..q_{G-1}, k, v] per KV head, so the merged
+query-head order is h = kvh*G + g — identical to the GQA packing
+contract the flash/paged kernels already use, and tensor-parallelism
+shards the KVH axis exactly like the separate layout did.
+``fuse_params`` migrates an old-layout (separate q/k/v/gate/up) tree.
 """
 
 from __future__ import annotations
@@ -258,6 +272,7 @@ def init_params(rng: jax.Array, config: LLaMAConfig) -> Params:
     def stacked(key, shape, fan_in):
         return dense(key, (L,) + shape, fan_in)
 
+    G = H // KVH
     params: Params = {
         "embed": {
             "embedding": (
@@ -266,13 +281,10 @@ def init_params(rng: jax.Array, config: LLaMAConfig) -> Params:
         },
         "layers": {
             "attn_norm": jnp.ones((L, D), dtype=wd),
-            "q": stacked(keys[1], (D, H, hd), D),
-            "k": stacked(keys[2], (D, KVH, hd), D),
-            "v": stacked(keys[3], (D, KVH, hd), D),
+            "qkv": stacked(keys[1], (D, KVH, G + 2, hd), D),
             "o": stacked(keys[4], (H, hd, D), D),
             "mlp_norm": jnp.ones((L, D), dtype=wd),
-            "gate": stacked(keys[5], (D, F), D),
-            "up": stacked(keys[6], (D, F), D),
+            "gate_up": stacked(keys[5], (D, 2, F), D),
             "down": stacked(keys[7], (F, D), F),
         },
         "final_norm": jnp.ones((D,), dtype=wd),
@@ -280,6 +292,71 @@ def init_params(rng: jax.Array, config: LLaMAConfig) -> Params:
     if not config.tie_word_embeddings:
         params["lm_head"] = dense(keys[8], (D, V), D)
     return params
+
+
+def rope_permute(w: jnp.ndarray, inverse: bool = False) -> jnp.ndarray:
+    """Permute a projection weight's trailing head_dim axis between Meta's
+    interleaved RoPE feature order and the runtime half-split order
+    (``ops.rope`` module docstring): forward maps Meta feature 2i -> i and
+    2i+1 -> i + hd/2, so ``apply_rope``'s contiguous-half rotation equals
+    the reference's interleaved complex rotation exactly.  Works on any
+    array whose LAST axis is head_dim (numpy or jax)."""
+    *lead, hd = w.shape
+    if inverse:
+        # [.., hd] viewed [.., 2, hd/2] -> swap -> [.., hd/2, 2] -> flat
+        return w.reshape(*lead, 2, hd // 2).swapaxes(-1, -2).reshape(w.shape)
+    return w.reshape(*lead, hd // 2, 2).swapaxes(-1, -2).reshape(w.shape)
+
+
+def fuse_qkv(
+    q: jnp.ndarray,  # [L, D, H, hd] (or [D, H, hd]), Meta feature order
+    k: jnp.ndarray,  # [L, D, KVH, hd]
+    v: jnp.ndarray,  # [L, D, KVH, hd]
+) -> jnp.ndarray:
+    """Pack separate q/k/v projection weights (Meta interleaved-RoPE
+    feature order) into the fused [..., D, KVH, G+2, hd] runtime layout:
+    slots [q_0..q_{G-1}, k, v] per KV head (query head order h = kvh*G +
+    g, the kernels' GQA contract), with q/k head_dim features permuted to
+    the half-split RoPE order (``rope_permute``; v is not rotated and
+    keeps Meta order)."""
+    *lead, D, H, hd = q.shape
+    KVH = k.shape[-2]
+    G = H // KVH
+    qg = rope_permute(q).reshape(*lead, D, KVH, G, hd)
+    return jnp.concatenate(
+        [qg, rope_permute(k)[..., :, :, None, :], v[..., :, :, None, :]],
+        axis=-2,
+    )
+
+
+def split_qkv(
+    qkv: jnp.ndarray,  # [..., D, KVH, G+2, hd]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Inverse of ``fuse_qkv``: (q [..., D, H, hd], k, v [..., D, KVH, hd])
+    in Meta interleaved-RoPE feature order."""
+    *lead, D, KVH, g2, hd = qkv.shape
+    G = g2 - 2
+    q = qkv[..., :G, :].reshape(*lead, D, KVH * G, hd)
+    return (
+        rope_permute(q, inverse=True),
+        rope_permute(qkv[..., G, :], inverse=True),
+        qkv[..., G + 1, :],
+    )
+
+
+def fuse_params(params: Params) -> Params:
+    """Migrate an old-layout param tree (separate q/k/v + gate/up, rounds
+    1-2 and older Orbax checkpoints) to the fused layout.  No-op when the
+    tree is already fused.  Quantized trees must be re-quantized from the
+    full-precision source instead (scales do not concatenate)."""
+    lp = dict(params["layers"])
+    if "qkv" in lp:
+        return params
+    lp["qkv"] = fuse_qkv(lp.pop("q"), lp.pop("k"), lp.pop("v"))
+    lp["gate_up"] = jnp.stack([lp.pop("gate"), lp.pop("up")], axis=-2)
+    out = dict(params)
+    out["layers"] = lp
+    return out
 
 
 def param_count(params: Params) -> int:
@@ -316,6 +393,7 @@ def _block(
     paged_pos: Optional[jnp.ndarray] = None,
     paged_table: Optional[jnp.ndarray] = None,
     paged_qpos: Optional[jnp.ndarray] = None,
+    ring_new_pos: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, ...]:
     """One pre-norm transformer block. x: [B, T, D].  ``impl`` is the
     RESOLVED attention implementation (forward maps "auto" to "flash" or
@@ -331,9 +409,15 @@ def _block(
 
     # --- attention ---
     h = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
-    q = qeinsum(h, lp["q"], "btd,dhk->bthk", adt)
-    k = qeinsum(h, lp["k"], "btd,dhk->bthk", adt)
-    v = qeinsum(h, lp["v"], "btd,dhk->bthk", adt)
+    # One fused QKV matmul (see module docstring): [B,T,KVH,G+2,hd],
+    # slots [q_0..q_{G-1}, k, v] per KV head.  Sharded over KVH on
+    # "tensor", so the slice/reshape below are shard-local.
+    G = config.n_heads // config.kv_heads
+    qkv = qeinsum(h, lp["qkv"], "btd,dcgk->btcgk", adt)
+    qkv = constrain(qkv, "data", "seq", "tensor", None, None)
+    q = qkv[..., :G, :].reshape(B, T, config.n_heads, config.head_dim)
+    k = qkv[..., G, :]
+    v = qkv[..., G + 1, :]
     q = constrain(q, "data", "seq", "tensor", None)
     k = constrain(k, "data", "seq", "tensor", None)
     v = constrain(v, "data", "seq", "tensor", None)
@@ -342,7 +426,21 @@ def _block(
     k = apply_rope(k, cos, sin, positions)
 
     softmax_dtype = jnp.dtype(config.attn_softmax_dtype)
-    if cache_k is not None and impl == "xla":
+    if cache_k is not None and impl == "ring_decode":
+        # Seq-sharded cached decode: the cache never moves (each seq
+        # shard reduces its own slots; one pmax + two psums combine) and
+        # stays immutable through the layer scan — same append-free
+        # contract as the xla path below.  ``slot_pos`` here is the
+        # PRE-step cache positions; the step's own tokens merge at the
+        # softmax level inside ring_decode via ``ring_new_pos``.
+        from ..parallel.ring import ring_decode
+
+        attn = ring_decode(
+            q, cache_k.astype(adt), cache_v.astype(adt), slot_pos,
+            k, v, positions, ring_new_pos, softmax_dtype=softmax_dtype,
+        )
+        cache_k, cache_v = k, v
+    elif cache_k is not None and impl == "xla":
         # Append-free decode: the cache stays immutable through the layer
         # scan; sdpa_cached softmaxes jointly over (cache slots, new
         # tokens) at the scores level, and the caller applies ONE in-place
@@ -448,13 +546,13 @@ def _block(
         )
     x = x + attn_out
 
-    # --- SwiGLU MLP ---
+    # --- SwiGLU MLP (fused gate+up matmul: one weight stream, one
+    # fusion — the F axis stays "tensor"-sharded like the separate
+    # layout) ---
     h = rms_norm(x, lp["mlp_norm"], config.rms_norm_eps)
-    gate = qeinsum(h, lp["gate"], "btd,df->btf", adt)
-    up = qeinsum(h, lp["up"], "btd,df->btf", adt)
-    gate = constrain(gate, "data", "seq", "tensor")
-    up = constrain(up, "data", "seq", "tensor")
-    hidden = jax.nn.silu(gate) * up
+    gate_up = qeinsum(h, lp["gate_up"], "btd,dcf->btcf", adt)
+    gate_up = constrain(gate_up, "data", "seq", None, "tensor")
+    hidden = jax.nn.silu(gate_up[..., 0, :]) * gate_up[..., 1, :]
     down = qeinsum(hidden, lp["down"], "btf,fd->btd", adt)
     down = constrain(down, "data", "seq", None)
     if dropout_rng is not None and config.resid_pdrop > 0.0:
@@ -512,20 +610,6 @@ def forward(
         )
     B, T = tokens.shape
     adt = config.activation_dtype
-    if cache is not None and config.attn_impl == "ring":
-        # Decode-over-cache under a real seq axis would need a seq-sharded
-        # KV cache; refuse loudly rather than silently gathering the full
-        # cache per device (cache-free ring forward is the supported
-        # sequence-parallel path).
-        from ..parallel.mesh import current_mesh
-
-        mesh = current_mesh()
-        if mesh is not None and mesh.shape.get("seq", 1) > 1:
-            raise NotImplementedError(
-                "attn_impl='ring' does not support KV-cache decode on a "
-                "mesh with seq > 1; use a seq=1 mesh for generation or "
-                "the cache-free forward for sequence-parallel scoring"
-            )
     if dropout_rng is not None and not (
         config.embd_pdrop > 0.0 or config.resid_pdrop > 0.0
         or config.attn_pdrop > 0.0
@@ -586,6 +670,30 @@ def forward(
             "attn_impl='xla'/'auto' for dropout training or attn_pdrop=0"
         )
     bias_new = None
+    ring_cached = False
+    if cache is not None and impl == "ring":
+        from ..parallel.mesh import current_mesh as _cm
+
+        _m = _cm()
+        if _m is not None and _m.shape.get("seq", 1) > 1:
+            # Seq-sharded cached decode (ring_decode): the cache shards
+            # stay put and partial softmax stats combine over `seq` —
+            # context is bounded by the mesh's combined HBM, not one
+            # chip's.  Long prompts should prefill in chunks
+            # (GenerationConfig.prefill_chunk): the step's own-token
+            # merge is O(T_chunk²).
+            if cache.per_row_index:
+                raise NotImplementedError(
+                    "seq-sharded decode needs a lockstep (scalar) cache "
+                    "index; continuous batching uses seq == 1 meshes"
+                )
+            if cache.quantized:
+                raise NotImplementedError(
+                    "int8 KV + seq-sharded decode is not implemented "
+                    "(the ring decode body does not fold dequant scales)"
+                )
+            ring_cached = True
+            impl = "ring_decode"
     xla_cached = cache is not None and impl == "xla"
 
     # Slot positions / masking state are layer-independent: compute once,
@@ -610,8 +718,8 @@ def forward(
         )
     else:
         slot_pos = new_slot_pos
-    if impl in ("flash", "ring"):
-        bias = None
+    if impl in ("flash", "ring", "ring_decode"):
+        bias = None  # positional masks are built inside the kernels/bodies
     elif xla_cached:
         # Append-free decode (see _block): the cache bias masks the OLD
         # cache contents (unwritten slots hold pos -1), the new tokens get
@@ -626,12 +734,16 @@ def forward(
         config=config,
         positions=q_positions,
         bias=bias,
-        slot_pos=slot_pos,
+        # ring_decode attends the PRE-step cache (its own tokens merge at
+        # the softmax level via ring_new_pos); every other cached path
+        # sees the updated slot positions.
+        slot_pos=cache.pos if ring_cached else slot_pos,
         cache_index=cache.index if cache is not None else None,
         cos=cos,
         sin=sin,
         bias_new=bias_new,
         impl=impl,
+        ring_new_pos=new_slot_pos if ring_cached else None,
     )
     if config.remat:
         block = jax.checkpoint(block)
@@ -716,6 +828,7 @@ def forward(
             x, (new_k, new_v, nks, nvs) = lax.scan(
                 scan_fn, x,
                 (lp, cache.k, cache.v, cache.k_scale, cache.v_scale),
+                unroll=config.scan_unroll,
             )
             if not xla_cached:
                 new_k_scale, new_v_scale = nks, nvs
@@ -729,7 +842,10 @@ def forward(
                 y, ck, cv, _, _ = block(carry, layer_params, ck, cv)
                 return y, (ck, cv)
 
-            x, (new_k, new_v) = lax.scan(scan_fn, x, (lp, cache.k, cache.v))
+            x, (new_k, new_v) = lax.scan(
+                scan_fn, x, (lp, cache.k, cache.v),
+                unroll=config.scan_unroll,
+            )
         elif layers_rng is not None:
             # Per-layer dropout keys ride the scan as xs alongside the
             # stacked weights.
@@ -742,13 +858,15 @@ def forward(
                 )
                 return y, None
 
-            x, _ = lax.scan(scan_fn, x, (lp, layer_rngs))
+            x, _ = lax.scan(
+                scan_fn, x, (lp, layer_rngs), unroll=config.scan_unroll
+            )
         else:
             def scan_fn(carry, layer_params):
                 y, *_ = block(carry, layer_params, None, None)
                 return y, None
 
-            x, _ = lax.scan(scan_fn, x, lp)
+            x, _ = lax.scan(scan_fn, x, lp, unroll=config.scan_unroll)
     elif pp_stages <= 1:
         unroll_rngs = (
             jax.random.split(layers_rng, config.n_layers)
@@ -775,7 +893,7 @@ def forward(
             if cache.quantized and not xla_cached:
                 new_k_scale = jnp.stack(new_kss)
                 new_v_scale = jnp.stack(new_vss)
-    if cache is not None and xla_cached:
+    if cache is not None and (xla_cached or ring_cached):
         # new_k/new_v hold the per-layer NEW projections [L, B, T, KVH, hd];
         # one in-place write (per array) lands them all in the cache —
         # quantizing first when the cache is int8.  Scalar index: a
@@ -818,6 +936,13 @@ def forward(
             new_v = lax.dynamic_update_slice(
                 cache.v, new_v.astype(cache.v.dtype), (0, 0, cache.index, 0, 0)
             )
+    if ring_cached:
+        # Keep the cache sharded along S over `seq` across steps (GSPMD
+        # applies the tiny T-token update per shard; no gather).  S must
+        # be divisible by the seq axis size.
+        new_k = constrain(new_k, None, "data", "seq", "tensor", None)
+        new_v = constrain(new_v, None, "data", "seq", "tensor", None)
+        slot_pos = constrain(slot_pos, "data", "seq")
 
     logits = lm_head_logits(params, x, config) if compute_logits else None
 
@@ -839,25 +964,29 @@ def paged_forward(
     attn_mask: Optional[jnp.ndarray] = None,
     compute_logits: bool = True,
 ) -> Tuple[Optional[jnp.ndarray], PagedKVCache]:
-    """One T=1 decode step over a paged block pool (continuous batching).
+    """One decode step of T tokens per row over a paged block pool
+    (continuous batching; T=1 is plain decode, T=G+1 is speculative
+    verify).
 
     The Pallas paged-attention kernel chases ``cache.table`` inside its
     BlockSpec index maps, so each layer's pool is read ONCE per step —
-    no gathered contiguous view exists (the pool bytes previously moved
-    three times per step: gather read, gather write, attention read).
-    The pool rides the layer scan immutably; the step's new K/V land via
-    one scatter per array afterwards, mirroring the xla_cached contract.
+    for ALL T tokens of a row — and no gathered contiguous view exists
+    (the pool bytes previously moved three times per step: gather read,
+    gather write, attention read).  The pool rides the layer scan
+    immutably; the step's new K/V land via one scatter per array
+    afterwards, mirroring the xla_cached contract.
+
+    Contract for T > 1 (the kernel derives per-token masks from a
+    sublane iota): each active row's positions are CONSECUTIVE —
+    ``positions[:, t] == positions[:, 0] + t`` — and a row is active or
+    inactive as a whole (``attn_mask`` constant along T).  Speculative
+    rounds satisfy both by construction.
 
     Rows with ``attn_mask`` False (or position -1) are inactive: they
     attend nothing, their logits are garbage the host ignores, and their
     scatter resolves to the sentinel block id and is dropped.
     """
     B, T = tokens.shape
-    if T != 1:
-        raise NotImplementedError(
-            "paged_forward is a T=1 decode step; multi-token forwards "
-            "(prefill, speculative verify) use the gathered-view path"
-        )
     adt = config.activation_dtype
     if attn_mask is None:
         attn_mask = positions >= 0
@@ -902,6 +1031,7 @@ def paged_forward(
         x, (new_k, new_v, nks, nvs) = lax.scan(
             scan_fn, x,
             (lp, cache.k, cache.v, cache.k_scale, cache.v_scale),
+            unroll=config.scan_unroll,
         )
     elif config.scan_layers:
         def scan_fn(carry, xs):
@@ -909,7 +1039,9 @@ def paged_forward(
             y, ck, cv, _, _ = block(carry, layer_params, ck, cv)
             return y, (ck, cv)
 
-        x, (new_k, new_v) = lax.scan(scan_fn, x, (lp, cache.k, cache.v))
+        x, (new_k, new_v) = lax.scan(
+            scan_fn, x, (lp, cache.k, cache.v), unroll=config.scan_unroll
+        )
     else:
         new_ks, new_vs, sks, svs = [], [], [], []
         for i in range(config.n_layers):
@@ -934,9 +1066,9 @@ def paged_forward(
     # scatter uses, so the two paths cannot drift).
     active = attn_mask[:, 0]
     blk_idx, off, _ = paged_write_indices(
-        cache.table, cache.fill, active, 1, NB, BLK
-    )  # [B, 1] each
-    upd_k = jnp.moveaxis(new_k, 3, 1)  # [L, B, 1, KVH, hd] -> [L, KVH, B, 1, hd]
+        cache.table, cache.fill, active, T, NB, BLK
+    )  # [B, T] each
+    upd_k = jnp.moveaxis(new_k, 3, 1)  # [L, B, T, KVH, hd] -> [L, KVH, B, T, hd]
     upd_v = jnp.moveaxis(new_v, 3, 1)
     new_cache = dataclasses.replace(
         cache,
@@ -947,7 +1079,7 @@ def paged_forward(
             upd_v.astype(cache.v.dtype), mode="drop"
         ),
         pos=cache.pos.at[blk_idx, off].set(
-            jnp.where(active, positions[:, 0], -1)[:, None], mode="drop"
+            jnp.where(active[:, None], positions, -1), mode="drop"
         ),
     )
     if cache.quantized:

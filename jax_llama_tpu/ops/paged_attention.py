@@ -45,9 +45,10 @@ from .flash_attention import MASK_VALUE, _LANES, _SUBLANES, _resolve_interpret
 
 def _paged_kernel(
     tbl_ref,    # [B * MB] int32 scalar-prefetch: physical block id (NB = dead)
-    qpos_ref,   # [B] int32 scalar-prefetch: query position (-1 = inactive row)
+    qpos_ref,   # [B] int32 scalar-prefetch: FIRST token's query position
+    #             (-1 = inactive row; token t sits at qpos + t)
     bound_ref,  # [B] int32 scalar-prefetch: live-block grid bound per row
-    q_ref,      # [1, KVH, G8, d]
+    q_ref,      # [1, KVH, TG8, d] — sublane row r = t*group + g
     k_ref,      # [KVH, 1, BLK, d] (int8 when quantized)
     v_ref,      # [KVH, 1, BLK, d] (int8 when quantized)
     pos_ref,    # [1, 1, BLK] int32 slot positions of the block
@@ -56,9 +57,22 @@ def _paged_kernel(
     scale: float,
     n_blocks: int,
     kvh: int,
-    g8: int,
+    tg8: int,
+    t_tokens: int,
+    group: int,
     quantized: bool = False,
 ):
+    """Online-softmax sweep of one row's pool blocks.
+
+    ``t_tokens`` queries per (row, query head) ride the sublane axis
+    (row r = t*group + g); their positions are CONSECUTIVE — token t at
+    ``qpos + t`` — so per-token masks derive from a sublane iota and no
+    per-token position plane is needed.  T=1 keeps the original
+    whole-tile skip for fully-masked tiles; T>1 additionally zeroes
+    masked probabilities explicitly, because one tile can be live for a
+    late token but fully masked for an early one (the skip guard is
+    per-tile, not per-sublane).
+    """
     if quantized:
         k_scale_ref, v_scale_ref, *rest = rest
     else:
@@ -75,31 +89,42 @@ def _paged_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     qp = qpos_ref[b]
+    qp_last = qp + t_tokens - 1
     kp = pos_ref[0, :1, :]  # [1, BLK]
     # Three dead-block guards, all mandatory:
     #   * mb >= bound: past the row's last attendable block — the index
     #     maps clamped the fetch (no new DMA); the tile is a repeat.
     #   * table sentinel / inactive row.
-    #   * all-masked tile (min live kp > qp): processing it would add
-    #     p = exp(MASK - MASK) = 1 garbage into l/acc — the block must be
-    #     SKIPPED, not merely masked (same invariant as flash block_live).
+    #   * all-masked tile (min live kp > last token's position):
+    #     processing it would add p = exp(MASK - MASK) = 1 garbage into
+    #     l/acc — the block must be SKIPPED, not merely masked (same
+    #     invariant as flash block_live).
     live_kp = jnp.where(kp >= 0, kp, jnp.iinfo(jnp.int32).max)
     live = (
         (mb < bound_ref[b])
         & (tbl_ref[b * nmb + mb] < n_blocks)
         & (qp >= 0)
-        & (jnp.min(live_kp) <= qp)
+        & (jnp.min(live_kp) <= qp_last)
     )
+
+    if t_tokens > 1:
+        # Per-sublane query position: row r holds token r // group.
+        # (Pad rows past t_tokens*group get later tokens' looser masks;
+        # their q rows are zero-padding and their outputs are sliced off.)
+        qp_rows = qp + jax.lax.broadcasted_iota(
+            jnp.int32, (tg8, 1), 0
+        ) // group  # [TG8, 1]
+    else:
+        qp_rows = None
 
     @pl.when(live)
     def _compute():
-        allowed = (kp >= 0) & (kp <= qp)
         # One grid cell covers ALL KV heads of the block (the loop
         # unrolls statically): grid cells are B × MB, not B × KVH × MB —
         # measured ~1 µs of per-cell overhead made the finer grid SLOWER
         # than the gathered-view fallback it replaces.
         for h in range(kvh):
-            sl = slice(h * g8, (h + 1) * g8)
+            sl = slice(h * tg8, (h + 1) * tg8)
             q = q_ref[0, h]
             if quantized:
                 # int8 pool: cast the tile in VMEM (int8 magnitudes are
@@ -115,9 +140,13 @@ def _paged_kernel(
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale  # [G8, BLK]
+            ) * scale  # [TG8, BLK]
             if quantized:
                 s = s * ksc
+            if t_tokens > 1:
+                allowed = (kp >= 0) & (kp <= qp_rows)  # [TG8, BLK]
+            else:
+                allowed = (kp >= 0) & (kp <= qp)       # [1, BLK]
             s = jnp.where(allowed, s, MASK_VALUE)
             m_prev = m_ref[sl, :1]
             m_new = jnp.maximum(
@@ -125,9 +154,16 @@ def _paged_kernel(
             )
             alpha = jnp.exp(m_prev - m_new)
             p = jnp.exp(s - m_new)
+            if t_tokens > 1:
+                # A tile can be live for token T-1 yet fully masked for
+                # token 0: that token's m_new stays MASK_VALUE and
+                # exp(MASK - MASK) = 1 would poison l/acc — zero masked
+                # probabilities explicitly (the T=1 path never hits this:
+                # its one qp makes tile-liveness == row-liveness).
+                p = jnp.where(allowed, p, 0.0)
             l_ref[sl] = jnp.broadcast_to(
                 alpha * l_ref[sl, :1] + jnp.sum(p, axis=-1, keepdims=True),
-                (g8, l_ref.shape[1]),
+                (tg8, l_ref.shape[1]),
             )
             if quantized:
                 pv = (p * v_scale_ref[h, 0, :1, :]).astype(q.dtype)
@@ -139,54 +175,63 @@ def _paged_kernel(
                 pv, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            m_ref[sl] = jnp.broadcast_to(m_new, (g8, m_ref.shape[1]))
+            m_ref[sl] = jnp.broadcast_to(m_new, (tg8, m_ref.shape[1]))
 
     @pl.when(mb == nmb - 1)
     def _finalize():
         l = l_ref[:, :1]
         o_ref[0] = (
             acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
-        ).reshape(kvh, g8, -1).astype(o_ref.dtype)
+        ).reshape(kvh, tg8, -1).astype(o_ref.dtype)
         # lse stays ~MASK_VALUE for rows that attended nothing, so the
         # caller's merge weight exp(lse - m_tot) underflows to exactly 0.
         lse_ref[0] = (
             m_ref[:] + jnp.log(jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:]))
-        ).reshape(kvh, g8, -1)
+        ).reshape(kvh, tg8, -1)
 
 
 def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("t_tokens", "interpret"))
 def paged_pool_attention(
-    q: jnp.ndarray,        # [B, KVH, G, d]  (grouped queries)
+    q: jnp.ndarray,        # [B, KVH, T*G, d]  (packed queries, r = t*G + g)
     k_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
     v_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
     pool_pos: jnp.ndarray,  # [NB, BLK] int32 (-1 = invalid slot)
     table: jnp.ndarray,    # [B, MB] int32 physical block ids (NB = unused)
-    q_pos: jnp.ndarray,    # [B] int32 (-1 = inactive row)
+    q_pos: jnp.ndarray,    # [B] int32 first token's position (-1 = inactive)
     k_scale: Optional[jnp.ndarray] = None,  # [KVH, NB, BLK] fp32 (int8 pool)
     v_scale: Optional[jnp.ndarray] = None,
+    t_tokens: int = 1,
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Attend each row's table-mapped pool blocks; no gather, pool read once.
 
-    With ``k_scale``/``v_scale`` the pool is int8 and the per-slot
-    dequant scales fold in-kernel (scores-level for K, probability-level
-    for V) — the pool streams at one byte per element plus fp32 scales.
+    With ``t_tokens`` > 1 each row carries T queries at CONSECUTIVE
+    positions (token t at ``q_pos + t`` — the speculative-verify /
+    multi-token decode shape); they ride the sublane axis packed
+    ``r = t*G + g``, so the pool still streams ONCE for the whole
+    (row, T) group.  With ``k_scale``/``v_scale`` the pool is int8 and
+    the per-slot dequant scales fold in-kernel (scores-level for K,
+    probability-level for V) — the pool streams at one byte per element
+    plus fp32 scales.
 
-    Returns (out [B, KVH, G, d] normalized over the pool slots,
-    lse [B, KVH, G] fp32 row logsumexp) for the caller's new-token merge.
+    Returns (out [B, KVH, T*G, d] normalized over the pool slots,
+    lse [B, KVH, T*G] fp32 row logsumexp) for the caller's
+    new-token merge.
     """
-    B, KVH, G, d = q.shape
+    B, KVH, TG, d = q.shape
     NB, BLK = pool_pos.shape
     MB = table.shape[1]
     assert k_pool.shape == (KVH, NB, BLK, d), (k_pool.shape, (KVH, NB, BLK, d))
+    assert TG % t_tokens == 0, (TG, t_tokens)
+    group = TG // t_tokens
     quantized = k_scale is not None
     interpret = _resolve_interpret(interpret)
-    G8 = _round_up(G, _SUBLANES)
-    qg = jnp.pad(q, ((0, 0), (0, 0), (0, G8 - G), (0, 0)))
+    TG8 = _round_up(TG, _SUBLANES)
+    qg = jnp.pad(q, ((0, 0), (0, 0), (0, TG8 - TG), (0, 0)))
     scale = 1.0 / (d ** 0.5)
 
     # Narrow-sublane position plane [NB, 1, BLK]: a free expand_dims
@@ -195,12 +240,14 @@ def paged_pool_attention(
     pos_r = pool_pos[:, None, :]
     tbl_flat = table.astype(jnp.int32).reshape(B * MB)
     q_pos = q_pos.astype(jnp.int32)
+    qp_last = q_pos + (t_tokens - 1)
 
     # Per-row live-block grid bound: 1 + the last table slot whose block
-    # holds any slot this row's query may attend.  Blocks at/after the
-    # bound (reserved-but-unwritten tail, sentinel entries) are clamped
-    # in the index maps — consecutive grid steps fetch the SAME tile, so
-    # the pipeline skips the DMA — and the kernel skips their compute.
+    # holds any slot this row's LAST query may attend.  Blocks at/after
+    # the bound (reserved-but-unwritten tail, sentinel entries) are
+    # clamped in the index maps — consecutive grid steps fetch the SAME
+    # tile, so the pipeline skips the DMA — and the kernel skips their
+    # compute.
     blk_min = jnp.min(
         jnp.where(pool_pos >= 0, pool_pos, jnp.iinfo(jnp.int32).max),
         axis=1,
@@ -209,7 +256,7 @@ def paged_pool_attention(
         [blk_min, jnp.full((1,), jnp.iinfo(jnp.int32).max, jnp.int32)]
     )  # sentinel id NB -> never attendable
     row_min = blk_min[jnp.minimum(table, NB)]  # [B, MB]
-    attendable = row_min <= q_pos[:, None]
+    attendable = row_min <= qp_last[:, None]
     bound = 1 + jnp.max(
         jnp.where(
             attendable, jnp.arange(MB, dtype=jnp.int32)[None, :], -1
@@ -231,7 +278,7 @@ def paged_pool_attention(
         return (b, 0, 0, 0)
 
     in_specs = [
-        pl.BlockSpec((1, KVH, G8, d), q_map),
+        pl.BlockSpec((1, KVH, TG8, d), q_map),
         pl.BlockSpec((KVH, 1, BLK, d), kv_map),
         pl.BlockSpec((KVH, 1, BLK, d), kv_map),
         pl.BlockSpec((1, 1, BLK), pos_map),
@@ -255,60 +302,62 @@ def paged_pool_attention(
 
     out, lse = pl.pallas_call(
         functools.partial(
-            _paged_kernel, scale=scale, n_blocks=NB, kvh=KVH, g8=G8,
-            quantized=quantized,
+            _paged_kernel, scale=scale, n_blocks=NB, kvh=KVH, tg8=TG8,
+            t_tokens=t_tokens, group=group, quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(B, MB),
             in_specs=in_specs,
             out_specs=(
-                pl.BlockSpec((1, KVH, G8, d), q_map),
-                pl.BlockSpec((1, KVH, G8, _LANES), q_map),
+                pl.BlockSpec((1, KVH, TG8, d), q_map),
+                pl.BlockSpec((1, KVH, TG8, _LANES), q_map),
             ),
             scratch_shapes=[
-                pltpu.VMEM((KVH * G8, _LANES), jnp.float32),
-                pltpu.VMEM((KVH * G8, _LANES), jnp.float32),
-                pltpu.VMEM((KVH * G8, d), jnp.float32),
+                pltpu.VMEM((KVH * TG8, _LANES), jnp.float32),
+                pltpu.VMEM((KVH * TG8, _LANES), jnp.float32),
+                pltpu.VMEM((KVH * TG8, d), jnp.float32),
             ],
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((B, KVH, G8, d), q.dtype),
-            jax.ShapeDtypeStruct((B, KVH, G8, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, KVH, TG8, d), q.dtype),
+            jax.ShapeDtypeStruct((B, KVH, TG8, _LANES), jnp.float32),
         ),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(tbl_flat, q_pos, bound, *operands)
-    return out[:, :, :G, :], lse[:, :, :G, 0]
+    return out[:, :, :TG, :], lse[:, :, :TG, 0]
 
 
 def paged_decode_attention(
-    q: jnp.ndarray,        # [B, 1, H, d] — this step's queries
-    k_new: jnp.ndarray,    # [B, 1, KVH, d] — this step's projections
-    v_new: jnp.ndarray,    # [B, 1, KVH, d]
+    q: jnp.ndarray,        # [B, T, H, d] — this step's queries
+    k_new: jnp.ndarray,    # [B, T, KVH, d] — this step's projections
+    v_new: jnp.ndarray,    # [B, T, KVH, d]
     k_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
     v_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
     pool_pos: jnp.ndarray,  # [NB, BLK]
     table: jnp.ndarray,    # [B, MB]
-    q_pos: jnp.ndarray,    # [B] (-1 = inactive)
+    q_pos: jnp.ndarray,    # [B] FIRST token's position (-1 = inactive row)
     k_scale: Optional[jnp.ndarray] = None,  # [KVH, NB, BLK] (int8 pool)
     v_scale: Optional[jnp.ndarray] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """One decode step of attention over (pool blocks ∪ the new slot).
+    """One decode step of attention over (pool blocks ∪ the step's T new
+    slots).
 
-    The pool pass runs in the Pallas kernel; the new token's single slot
-    (score ``q·k_new``, always attendable for an active row — a token may
-    attend itself) merges at the softmax level outside, keeping the pool
+    The pool pass runs in the Pallas kernel (T consecutive-position
+    queries per row share ONE pool sweep — the speculative-verify shape);
+    the step's own T tokens (token t attends new slots j <= t, plus
+    itself) merge at the softmax level outside, keeping the pool
     immutable through the layer scan (same append-free contract as
-    ``sdpa_cached``; the new token's K/V enter the merge at full
+    ``sdpa_cached``; the new tokens' K/V enter the merge at full
     precision, also matching sdpa_cached — only POOL reads see int8).
-    Returns [B, 1, H, d].
+    Token t's position is ``q_pos + t`` for active rows (consecutive —
+    the T>1 kernel's contract).  Returns [B, T, H, d].
     """
     B, T, H, d = q.shape
-    assert T == 1, "paged decode attention is a T=1 step"
     KVH = k_new.shape[2]
 
     # Tensor/data-parallel serving: a pallas_call is not partitioned by
@@ -385,26 +434,38 @@ def _paged_decode_local(
     G = H // KVH
     scale = 1.0 / (d ** 0.5)
 
-    # Head layout h = kvh * G + g (same contract as flash GQA packing).
-    qg = q[:, 0].reshape(B, KVH, G, d)
+    # Head layout h = kvh * G + g (same contract as flash GQA packing);
+    # kernel sublane packing r = t*G + g.
+    q5 = q.reshape(B, T, KVH, G, d)
+    qg = jnp.swapaxes(q5, 1, 2).reshape(B, KVH, T * G, d)
     out_pool, lse = paged_pool_attention(
         qg, k_pool, v_pool, pool_pos, table, q_pos,
-        k_scale=k_scale, v_scale=v_scale, interpret=interpret,
+        k_scale=k_scale, v_scale=v_scale, t_tokens=T, interpret=interpret,
     )
+    out_pool = out_pool.reshape(B, KVH, T, G, d)
+    lse = lse.reshape(B, KVH, T, G)
 
-    # New-slot scores [B, KVH, G]: the only same-step pair at T=1 is the
-    # token with itself, always allowed.
+    # New-slot scores [B, KVH, T, G, T]: token t attends the step's own
+    # slots j <= t (a token may attend itself; positions are consecutive
+    # so j <= t IS the positional mask).
     s_new = jnp.einsum(
-        "bkgd,bkd->bkg", qg, k_new[:, 0],
+        "btkgd,bjkd->bktgj", q5, k_new,
         preferred_element_type=jnp.float32,
     ) * scale
-    m_tot = jnp.maximum(lse, s_new)
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    causal = t_idx[:, None] >= t_idx[None, :]  # [T(t), T(j)]
+    s_new = jnp.where(causal[None, None, :, None, :], s_new, MASK_VALUE)
+
+    m_tot = jnp.maximum(lse, jnp.max(s_new, axis=-1))  # [B, KVH, T, G]
     w_pool = jnp.exp(lse - m_tot)
-    w_new = jnp.exp(s_new - m_tot)
-    denom = w_pool + w_new
-    out = (
-        out_pool.astype(jnp.float32) * (w_pool / denom)[..., None]
-        + v_new[:, 0, :, None, :].astype(jnp.float32)
-        * (w_new / denom)[..., None]
+    p_new = jnp.exp(s_new - m_tot[..., None])          # [B, KVH, T, G, T]
+    p_new = jnp.where(causal[None, None, :, None, :], p_new, 0.0)
+    denom = w_pool + jnp.sum(p_new, axis=-1)
+    new_contrib = jnp.einsum(
+        "bktgj,bjkd->bktgd", p_new, v_new.astype(jnp.float32),
     )
-    return out.reshape(B, 1, H, d).astype(q.dtype)
+    out = (
+        out_pool.astype(jnp.float32) * w_pool[..., None] + new_contrib
+    ) / denom[..., None]
+    out = jnp.swapaxes(out, 1, 2).reshape(B, T, H, d)
+    return out.astype(q.dtype)

@@ -1,17 +1,29 @@
-"""Rotary position embeddings, Meta-interleaved pairing, real-valued math.
+"""Rotary position embeddings — half-split (rotate-half) runtime layout,
+numerically identical to Meta's interleaved complex form.
 
 The reference applies RoPE in complex arithmetic over interleaved pairs
 ``(x[2i], x[2i+1])`` (``/root/reference/jax_llama/model.py:50-92``).  Complex
-dtypes are poison for the TPU vector unit, so we use the algebraically
-identical real-valued form:
+dtypes are poison for the TPU vector unit, and the *interleaved* real-valued
+form is nearly as bad: the strided even/odd slices and the re-interleave at
+the end each lower to a lane-shuffling relayout copy (xplane-measured ~3µs
+per decode layer at 1B scale).  So the runtime uses the HF-style half-split
+pairing — pair i is ``(x[i], x[i + hd/2])``:
 
-    out[2i]   = x[2i]*cos(t·w_i) - x[2i+1]*sin(t·w_i)
-    out[2i+1] = x[2i]*sin(t·w_i) + x[2i+1]*cos(t·w_i)
+    out[i]        = x[i]*cos(t·w_i) - x[i+hd/2]*sin(t·w_i)
+    out[i+hd/2]   = x[i]*sin(t·w_i) + x[i+hd/2]*cos(t·w_i)
 
-NOTE this is the *interleaved* (Meta checkpoint) pairing, not the HF
-half-split ("rotate_half") pairing — weight conversion from Meta checkpoints
-needs no Q/K permutation with this convention.  Tables are precomputed in
-float32 and rotation runs in float32 regardless of activation dtype.
+i.e. contiguous half-lane slices, no shuffles.  Equivalence with the Meta
+convention is exact — not approximate — because the q/k projection weights
+are stored with their head_dim axis PERMUTED even-first at load time
+(``models.llama.fuse_qkv``; the converter applies the same permutation):
+feature i of the runtime layout is Meta feature 2i, feature i + hd/2 is
+Meta feature 2i+1, so the half-split rotation of the permuted vector IS the
+interleaved rotation of the original, and attention scores are invariant
+because q and k share the permutation.  ``models.llama.split_qkv`` inverts
+it, which is what the parity tests check token-for-token.
+
+Tables are precomputed in float32 and rotation runs in float32 regardless
+of activation dtype.
 """
 
 from __future__ import annotations
@@ -80,20 +92,19 @@ def apply_rope(
     """Rotate q or k by position-dependent angles.
 
     Args:
-      x: [batch, seq, heads, head_dim].
+      x: [batch, seq, heads, head_dim] in the half-split feature layout
+        (see module docstring — projections are stored pre-permuted).
       cos, sin: [max_positions, head_dim // 2] fp32 tables from `rope_table`.
       positions: [batch, seq] int32 absolute position ids.
     Returns:
       Rotated tensor, same shape/dtype as x.
     """
     orig_dtype = x.dtype
+    d2 = x.shape[-1] // 2
     xf = x.astype(jnp.float32)
-    x_even = xf[..., 0::2]  # [B, S, H, D/2]
-    x_odd = xf[..., 1::2]
+    x1 = xf[..., :d2]  # [B, S, H, D/2] — contiguous lane halves
+    x2 = xf[..., d2:]
     c = jnp.take(cos, positions, axis=0)[:, :, None, :]  # [B, S, 1, D/2]
     s = jnp.take(sin, positions, axis=0)[:, :, None, :]
-    out_even = x_even * c - x_odd * s
-    out_odd = x_even * s + x_odd * c
-    # Re-interleave: stack on a trailing axis then flatten the last two.
-    out = jnp.stack([out_even, out_odd], axis=-1).reshape(x.shape)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
     return out.astype(orig_dtype)

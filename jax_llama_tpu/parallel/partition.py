@@ -2,11 +2,12 @@
 
 Capability parity with the reference rule tables (``/root/reference/
 jax_llama/partition.py:43-78``): Megatron-style column-parallel shards on
-q/k/v/gate/up/lm_head, row-parallel on o/down, vocab-sharded embedding,
-replicated norms; the ``fsdp`` variant additionally shards the non-TP axis
-over the fsdp mesh axis (the reference defines the same table over ``dp``
-but never uses it — jax_example.py:25 hardcodes fsdp=False; here it is a
-first-class option).
+the fused qkv/gate_up projections and lm_head (the reference shards the
+same weights, stored separately), row-parallel on o/down, vocab-sharded
+embedding, replicated norms; the ``fsdp`` variant additionally shards the
+non-TP axis over the fsdp mesh axis (the reference defines the same table
+over ``dp`` but never uses it — jax_example.py:25 hardcodes fsdp=False;
+here it is a first-class option).
 
 Because the param tree is structured (not a flat dict of dotted names),
 specs are written as a mirror-shaped pytree — no regex window-matching
@@ -52,13 +53,14 @@ def param_partition_specs(
         "embed": {"embedding": P(("tensor", f) if f else "tensor", None)},
         "layers": {
             "attn_norm": P(s, None),
-            "q": P(s, f, "tensor", None),            # column-parallel (heads)
-            "k": P(s, f, "tensor", None),
-            "v": P(s, f, "tensor", None),
+            # Fused [L, D, KVH, G+2, hd]: column-parallel over KV heads
+            # (each shard holds its heads' q slots AND k/v slots — the
+            # same per-shard contents as the separate q/k/v layout).
+            "qkv": P(s, f, "tensor", None, None),
             "o": P(s, "tensor", None, f),            # row-parallel
             "mlp_norm": P(s, None),
-            "gate": P(s, f, "tensor"),               # column-parallel
-            "up": P(s, f, "tensor"),
+            # Fused [L, D, 2, F]: column-parallel over F.
+            "gate_up": P(s, f, None, "tensor"),
             "down": P(s, "tensor", f),               # row-parallel
         },
         "final_norm": P(None),

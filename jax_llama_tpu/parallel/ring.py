@@ -13,12 +13,30 @@ device ever held more than ``S/n`` keys, and the rotation rides ICI
 point-to-point links, overlapping with the local compute under XLA's
 latency-hiding scheduler.
 
+Within a rotation the shard is folded CHUNKWISE (``lax.scan`` over
+fixed-size kv chunks with the same online-softmax update): peak per-device
+attention memory is O(B·H·T_local·chunk), not O(T_local·S/n) — the
+[B, H, T, S/n] probability tensor the first implementation materialized
+per rotation is gone, which is what makes 32k+ contexts per shard real.
+Each chunk update is ``jax.checkpoint``ed, so the backward pass recomputes
+chunk probabilities instead of saving them (same recompute-not-store deal
+as the Pallas flash backward).
+
 Masking is positional (same contract as ``ops.attention.attention_bias`` /
 the flash kernel): slot attendable iff ``kv_pos <= q_pos`` and
 ``kv_pos >= 0``.  Because masks derive from absolute positions carried with
 the shards, causality is layout-independent — no zig-zag reordering games
 are needed for correctness (contiguous sharding does leave the usual causal
 load imbalance; acceptable at this stage).
+
+Decode (``ring_decode``) does NOT rotate: the KV cache stays sharded over
+``seq`` (each device owns S/n slots permanently) and the tiny [B, T]
+queries are replicated; every device computes its shard's partial
+online-softmax statistics and ONE pmax + two psums over ``seq`` combine
+them exactly.  The step's own new tokens merge at the softmax level
+afterwards (the ``sdpa_cached`` append-free contract), so the cache rides
+the layer scan immutably and generation context is bounded by the MESH's
+combined HBM, not one chip's.
 """
 
 from __future__ import annotations
@@ -37,33 +55,84 @@ from .mesh import current_mesh
 
 BATCH_AXES = ("data", "fsdp")
 
+# kv-chunk length of the inner accumulation scan: MXU-friendly (multiple
+# of 128 lanes) and small enough that [B, H, T_local, RING_CHUNK] fp32
+# stays a rounding error next to the activations.
+RING_CHUNK = 512
 
-def _accumulate(qt, q_pos, k, v, kv_pos, m, l, acc, *, scale):
-    """Fold one KV shard into the running online-softmax state.
 
-    qt: [B, H, T, d]; k, v: [B, S, KVH, d]; m, l: [B, H, T] f32;
-    acc: [B, H, T, d] f32.
+def _fold_chunk(qt, q_pos, kc, vc, pc, m, l, acc, *, scale):
+    """Fold one kv chunk into the running online-softmax state.
+
+    qt: [B, H, T, d]; kc, vc: [B, C, KVH, d]; pc: [B, C];
+    m, l: [B, H, T] f32; acc: [B, H, T, d] f32.
     """
-    group = qt.shape[1] // k.shape[2]
-    kr = repeat_kv(k, group)  # [B, S, H, d]
-    vr = repeat_kv(v, group)
+    group = qt.shape[1] // kc.shape[2]
+    kr = repeat_kv(kc, group)  # [B, C, H, d]
+    vr = repeat_kv(vc, group)
     s = jnp.einsum(
         "bhtd,bshd->bhts", qt, kr, preferred_element_type=jnp.float32
     ) * scale
-    allowed = (kv_pos[:, None, None, :] <= q_pos[:, None, :, None]) & (
-        kv_pos >= 0
+    allowed = (pc[:, None, None, :] <= q_pos[:, None, :, None]) & (
+        pc >= 0
     )[:, None, None, :]
     s = jnp.where(allowed, s, MASK_VALUE)
 
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     alpha = jnp.exp(m - m_new)  # [B, H, T]
-    p = jnp.exp(s - m_new[..., None])  # [B, H, T, S] f32
+    p = jnp.exp(s - m_new[..., None])  # [B, H, T, C] f32
     l = alpha * l + jnp.sum(p, axis=-1)
     acc = alpha[..., None] * acc + jnp.einsum(
         "bhts,bshd->bhtd", p.astype(vr.dtype), vr,
         preferred_element_type=jnp.float32,
     )
     return m_new, l, acc
+
+
+def _accumulate(qt, q_pos, k, v, kv_pos, m, l, acc, *, scale,
+                chunk: int = RING_CHUNK):
+    """Fold one KV shard into the running state, chunk by chunk.
+
+    Memory: O(B·H·T·chunk) per step of the scan (the dense predecessor
+    held the full [B, H, T, S_shard] probability tensor).  Each chunk is
+    rematerialized in the backward pass (jax.checkpoint), so residuals
+    are O(S_shard·d), not O(T·S_shard).
+
+    NB the FIRST chunk folded for a live query must contain an attendable
+    slot before any fully-masked chunk can be skipped-by-zero: the ring
+    starts with the query's own shard and positions ascend within it, so
+    chunk 0 always contains the query's own slot — after which
+    exp(MASK - finite m) underflows to exactly 0 for masked chunks.
+    (Padding queries accumulate garbage that is masked downstream, same
+    as the dense version.)
+    """
+    B, S = k.shape[0], k.shape[1]
+    C = min(chunk, S)
+    pad = (-S) % C
+    if pad:
+        widths = [(0, 0)] * k.ndim
+        widths[1] = (0, pad)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+        kv_pos = jnp.pad(kv_pos, ((0, 0), (0, pad)), constant_values=-1)
+    nc = k.shape[1] // C
+
+    def to_chunks(a):  # [B, nc*C, ...] -> [nc, B, C, ...]
+        return jnp.moveaxis(
+            a.reshape((a.shape[0], nc, C) + a.shape[2:]), 1, 0
+        )
+
+    @jax.checkpoint
+    def body(carry, xs):
+        m, l, acc = carry
+        kc, vc, pc = xs
+        m, l, acc = _fold_chunk(qt, q_pos, kc, vc, pc, m, l, acc, scale=scale)
+        return (m, l, acc), None
+
+    (m, l, acc), _ = lax.scan(
+        body, (m, l, acc), (to_chunks(k), to_chunks(v), to_chunks(kv_pos))
+    )
+    return m, l, acc
 
 
 def ring_attention(
@@ -102,9 +171,12 @@ def ring_attention(
         return k, v, kv_pos, m, l, acc
 
     # n-1 rotations; the last shard is folded in without a trailing permute.
-    k, v, kv_pos, m, l, acc = lax.fori_loop(
-        0, axis_size - 1, body, (k, v, kv_pos, m, l, acc)
-    )
+    # (axis_size 1: no rotation, no collective — the body is also valid
+    # outside shard_map, which the 32k memory test exploits.)
+    if axis_size > 1:
+        k, v, kv_pos, m, l, acc = lax.fori_loop(
+            0, axis_size - 1, body, (k, v, kv_pos, m, l, acc)
+        )
     m, l, acc = _accumulate(qt, q_pos, k, v, kv_pos, m, l, acc, scale=scale)
 
     out = acc / l[..., None]
@@ -144,3 +216,128 @@ def ring_sdpa(
         check_vma=False,
     )
     return fn(q, k, v, q_pos, kv_pos)
+
+
+# ---------------------------------------------------------------------------
+# Seq-sharded cached decode
+# ---------------------------------------------------------------------------
+
+def _ring_decode_body(
+    q, kc, vc, sp, kn, vn, qp, npos, *, axis_name: str, scale: float,
+    softmax_dtype,
+):
+    """Per-device body: partial softmax over the LOCAL cache shard, exact
+    combine over ``seq``, then the step's own new tokens merge at the
+    softmax level (replicated arithmetic, no collective).
+
+    q: [B, T, H, d]; kc, vc: [B, S_local, KVH, d]; sp: [B, S_local];
+    kn, vn: [B, T, KVH, d]; qp, npos: [B, T].
+    """
+    B, T, H, d = q.shape
+    group = H // kc.shape[2]
+    qt = jnp.swapaxes(q, 1, 2)  # [B, H, T, d]
+
+    kr = repeat_kv(kc, group)
+    vr = repeat_kv(vc, group)
+    s = jnp.einsum(
+        "bhtd,bshd->bhts", qt, kr, preferred_element_type=softmax_dtype
+    ) * scale
+    allowed = (sp[:, None, None, :] <= qp[:, None, :, None]) & (
+        sp >= 0
+    )[:, None, None, :]
+    s = jnp.where(allowed, s, MASK_VALUE)
+    m_i = jnp.max(s, axis=-1)                      # [B, H, T]
+    p = jnp.exp(s - m_i[..., None])
+    p = jnp.where(allowed, p, 0.0)                 # all-masked shard: l_i = 0
+    l_i = jnp.sum(p, axis=-1)
+    o_i = jnp.einsum(
+        "bhts,bshd->bhtd", p.astype(vr.dtype), vr,
+        preferred_element_type=softmax_dtype,
+    )
+
+    if axis_name is None:
+        # Single-shard (no mesh / seq == 1): the local stats are global.
+        m, l, o = m_i, l_i, o_i
+    else:
+        # Exact combine across the seq shards: one pmax + two psums of
+        # [B, H, T(, d)] — decode-sized, so the collectives are tiny.
+        m = lax.pmax(m_i, axis_name)
+        w = jnp.exp(m_i - m)
+        l = lax.psum(l_i * w, axis_name)
+        o = lax.psum(o_i * w[..., None], axis_name)
+
+    # New-token merge (same two-source softmax split as sdpa_cached):
+    # token t attends new slot j iff npos[j] <= qp[t] (and j valid).
+    s_new = jnp.einsum(
+        "bhtd,bjhd->bhtj", qt, repeat_kv(kn, group),
+        preferred_element_type=softmax_dtype,
+    ) * scale
+    allowed_new = (
+        npos[:, None, None, :] <= qp[:, None, :, None]
+    ) & (npos >= 0)[:, None, None, :]
+    s_new = jnp.where(allowed_new, s_new, MASK_VALUE)
+    m_tot = jnp.maximum(m, jnp.max(s_new, axis=-1))
+    p_new = jnp.exp(s_new - m_tot[..., None])
+    p_new = jnp.where(allowed_new, p_new, 0.0)
+    w_old = jnp.exp(m - m_tot)
+    denom = l * w_old + jnp.sum(p_new, axis=-1)
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    out = (
+        o * (w_old / denom)[..., None]
+        + jnp.einsum(
+            "bhtj,bjhd->bhtd", p_new.astype(vn.dtype), repeat_kv(vn, group),
+            preferred_element_type=softmax_dtype,
+        ) / denom[..., None]
+    )
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_decode(
+    q: jnp.ndarray,        # [B, T, H, d] — this step's queries
+    k_cache: jnp.ndarray,  # [B, S, KVH, d] — seq-sharded KV cache (layer)
+    v_cache: jnp.ndarray,
+    slot_pos: jnp.ndarray,  # [B, S] int32 (-1 = invalid slot)
+    k_new: jnp.ndarray,    # [B, T, KVH, d] — this step's projections
+    v_new: jnp.ndarray,
+    q_pos: jnp.ndarray,    # [B, T] query positions (clamped >= 0)
+    new_pos: jnp.ndarray,  # [B, T] new-slot positions (-1 = padding)
+    *,
+    softmax_dtype=jnp.float32,
+    axis_name: str = "seq",
+) -> jnp.ndarray:
+    """Cached decode over a KV cache sharded along S over the ``seq`` mesh
+    axis: generation context is bounded by the mesh's combined HBM.
+
+    The cache never moves: each device reduces its own shard and the
+    partial softmax statistics combine with one pmax + two psums of
+    decode-sized tensors.  The cache stays immutable through the layer
+    scan; the caller lands the new K/V afterwards (the ``sdpa_cached``
+    append-free contract — so this is the drop-in seq>1 counterpart of
+    the xla decode path).  S must be divisible by the seq axis size.
+    """
+    mesh = current_mesh()
+    n = mesh.shape.get(axis_name, 1) if mesh is not None else 1
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    if n == 1:
+        return _ring_decode_body(
+            q, k_cache, v_cache, slot_pos, k_new, v_new, q_pos, new_pos,
+            axis_name=None, scale=scale, softmax_dtype=softmax_dtype,
+        )
+
+    rows = P(BATCH_AXES)
+    head4 = P(BATCH_AXES, None, "tensor", None)
+    cache4 = P(BATCH_AXES, axis_name, "tensor", None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_decode_body, axis_name=axis_name, scale=scale,
+            softmax_dtype=softmax_dtype,
+        ),
+        mesh=mesh,
+        in_specs=(
+            head4, cache4, cache4, P(BATCH_AXES, axis_name), head4, head4,
+            P(BATCH_AXES, None), P(BATCH_AXES, None),
+        ),
+        out_specs=head4,
+        check_vma=False,
+    )
+    return fn(q, k_cache, v_cache, slot_pos, k_new, v_new, q_pos, new_pos)

@@ -8,10 +8,12 @@ TPU never idles waiting for the longest generation in a batch.
 
 TPU-native mechanics:
   * **Static shapes everywhere.**  The pool is ``n_slots`` rows; every
-    decode step is one jitted [B=n_slots, T=1] forward.  Admission runs a
-    B=1 prefill whose length is bucketed to block multiples, so the jit
-    cache holds O(max_len / block_size) prefill programs + 1 decode
-    program.
+    decode step is one jitted [B=n_slots, T=1] forward.  A burst of k
+    admissible requests is admitted as ONE [k', Pmax] batched prefill
+    (k' = k rounded to a power of two with inactive pad rows, Pmax the
+    group's max block-padded prompt length), so the jit cache holds
+    O(log2(n_slots) · max_len / block_size) prefill programs + 1 decode
+    program, and a k-request burst pays one dispatch instead of k.
   * **Paged KV.**  KV lives in a pool of fixed-size blocks
     ([L, KVH, n_blocks, block_size, hd], KV-head-major — the paged
     kernel's layout); each slot holds a block table
@@ -25,12 +27,13 @@ TPU-native mechanics:
     ``models.paged_forward``: the kernel's BlockSpec index maps chase the
     block table directly (scalar prefetch), so the pool is read ONCE per
     step and no contiguous view is ever materialized (int8 pools fold
-    their dequant scales in-kernel).  A gathered-view fallback (per-row
-    virtually-contiguous cache + the model's per-row-offset forward)
-    remains for kernel-incompatible meshes (kv_heads % tensor != 0,
-    n_slots % (data*fsdp) != 0, or active seq/stage axes) and
-    non-8-multiple block sizes, and serves the multi-token forwards
-    (speculative rounds).
+    their dequant scales in-kernel).  Speculative rounds run the same
+    kernel: T=1 paged steps for the draft chain and ONE multi-token
+    (T = n_draft+1) kernel pass for the verify.  A gathered-view
+    fallback (per-row virtually-contiguous cache + the model's
+    per-row-offset forward) remains for kernel-incompatible meshes
+    (kv_heads % tensor != 0, n_slots % (data*fsdp) != 0, or active
+    seq/stage axes) and non-8-multiple block sizes.
   * **Per-request sampling.**  temperature/top-p/top-k and the PRNG
     chain are per-slot device arrays; each row samples with its own key
     (same warp math as ``ops.sampling.sample``, dynamic per-row), so a
@@ -207,6 +210,46 @@ def _scatter_back(
 # Per-row sampling (dynamic policies)
 # ---------------------------------------------------------------------------
 
+def _warp_rows(
+    logits: jnp.ndarray,       # [B, V] or [B, T, V]
+    temperature: jnp.ndarray,  # [B] fp32 (> 0 rows meaningful)
+    top_p: jnp.ndarray,        # [B] fp32; 1.0 = off
+    top_k: jnp.ndarray,        # [B] int32; V (or 0) = off
+) -> jnp.ndarray:
+    """Per-row warped LOGITS — the single source of truth for the warp
+    math shared by ``sample_rows`` (which draws from it) and
+    ``warped_probs_rows`` (which softmaxes it).  Row-wise identical to
+    ``ops.sampling``'s static filters: scale by temperature, threshold at
+    the k-th largest, nucleus threshold (same tie handling).  The
+    speculative bit-identity contract depends on every consumer warping
+    through THIS function.
+    """
+    V = logits.shape[-1]
+    lg = logits.astype(jnp.float32)
+    bshape = (logits.shape[0],) + (1,) * (lg.ndim - 1)
+    t = jnp.maximum(temperature, 1e-6).reshape(bshape)
+    scaled = lg / t
+    # top-k: threshold at the k-th largest (k==V keeps everything, matching
+    # the static filter's no-op when top_k is None).
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V).reshape(bshape)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.broadcast_to(k - 1, lg.shape[:-1] + (1,)), axis=-1
+    )
+    scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
+    # top-p: same construction as ops.sampling.top_p_filter, p per-row.
+    sorted2 = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p.reshape(bshape)
+    thr = jnp.min(
+        jnp.where(keep, sorted2, jnp.inf), axis=-1, keepdims=True
+    )
+    thr = jnp.minimum(thr, jnp.max(scaled, axis=-1, keepdims=True))
+    nucleus = jnp.where(top_p.reshape(bshape) < 1.0, thr, -jnp.inf)
+    return jnp.where(scaled >= nucleus, scaled, NEG_INF)
+
+
 def sample_rows(
     keys: jnp.ndarray,         # [B, 2] uint32 PRNG keys (one per row)
     logits: jnp.ndarray,       # [B, V]
@@ -216,35 +259,13 @@ def sample_rows(
 ) -> jnp.ndarray:
     """Per-row ``ops.sampling.sample`` with *traced* per-row policies.
 
-    Applies the identical warp math (scale, top-k threshold at the k-th
-    largest, nucleus threshold, categorical) row-wise so a row with
-    policy (t, p, k) and its own key chain draws bit-identically to
+    Applies the identical warp math (``_warp_rows``) row-wise so a row
+    with policy (t, p, k) and its own key chain draws bit-identically to
     ``sample(key, row[None], t, p, k)``.
     """
-    B, V = logits.shape
     lg = logits.astype(jnp.float32)
     greedy_tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-
-    t = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = lg / t
-    # top-k: threshold at the k-th largest (k==V keeps everything, matching
-    # the static filter's no-op when top_k is None).
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
-    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
-    scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
-    # top-p: same construction as ops.sampling.top_p_filter, p per-row.
-    sorted2 = jnp.sort(scaled, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sorted2, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = (cum - probs) < top_p[:, None]
-    thr = jnp.min(
-        jnp.where(keep, sorted2, jnp.inf), axis=-1, keepdims=True
-    )
-    thr = jnp.minimum(thr, jnp.max(scaled, axis=-1, keepdims=True))
-    nucleus = jnp.where(top_p[:, None] < 1.0, thr, -jnp.inf)
-    scaled = jnp.where(scaled >= nucleus, scaled, NEG_INF)
-
+    scaled = _warp_rows(logits, temperature, top_p, top_k)
     sampled = jax.vmap(
         lambda key, row: jax.random.categorical(key, row)
     )(keys, scaled).astype(jnp.int32)
@@ -256,6 +277,24 @@ def _split_rows(keys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     mirror of ``rng, sub = jax.random.split(rng)``."""
     out = jax.vmap(lambda key: jax.random.split(key))(keys)  # [B, 2, 2]
     return out[:, 0], out[:, 1]
+
+
+def warped_probs_rows(
+    logits: jnp.ndarray,       # [B, V] or [B, T, V]
+    temperature: jnp.ndarray,  # [B] fp32 (> 0 rows meaningful)
+    top_p: jnp.ndarray,        # [B] fp32; 1.0 = off
+    top_k: jnp.ndarray,        # [B] int32; V (or 0) = off
+) -> jnp.ndarray:
+    """Per-row ``ops.sampling.warped_probs`` with *traced* policies.
+
+    Identical warp math to ``sample_rows`` (shared ``_warp_rows``),
+    returning the full post-warp distribution instead of a draw — the p
+    and q of speculative accept/resample.  A row with policy (t, p, k)
+    gets bit-identically ``warped_probs(row, t, p, k)``.
+    """
+    return jax.nn.softmax(
+        _warp_rows(logits, temperature, top_p, top_k), axis=-1
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -341,23 +380,34 @@ def _paged_decode_step(
     donate_argnames=("pool",),
 )
 def _paged_insert(
-    params, pool, block_ids, prompt_tokens, prompt_mask, key,
+    params, pool, block_ids, prompt_tokens, prompt_mask, keys,
     temperature, top_p, top_k, *,
     config, prefill_chunk=None, mesh=None,
 ):
-    """Prefill one request and land its KV in the reserved blocks.
+    """Prefill a batch of k admitted requests and land their KV in their
+    reserved blocks.
 
-    prompt_tokens/prompt_mask: [1, P] left-padded, P a block multiple.
-    block_ids: [P // block_size] physical blocks for the prompt span.
-    Runs a B=1 prefill into a fresh contiguous P-token cache (optionally
-    in fixed chunks, bounding activation memory for long prompts), then
-    scatters the cache — reshaped to blocks — into the pool.  Returns
-    (first sampled token, prompt length, carried key, updated pool).
+    prompt_tokens/prompt_mask: [k, P] left-padded to the GROUP's max
+    block-multiple length (a burst of admissions shares ONE prefill
+    dispatch — previously each request paid its own B=1 prefill, and a
+    burst of k paid k serialized dispatches).  Rows whose own padded
+    length P_b < P simply carry more left-padding; their logits/sample
+    are unaffected (padding is masked), so each row emits bit-identically
+    to a standalone B=1 insert of its request.
+    block_ids: [k, P // block_size] physical blocks per row, LEADING
+    entries set to the sentinel (n_blocks) for rows with P_b < P — the
+    pool scatter drops them, so only the row's own P_b-span lands (P and
+    every P_b are block multiples, so the alignment is exact).
+    Inactive (padding) rows, if any, carry all-sentinel block_ids and an
+    all-False mask.
+    Returns (sampled tokens [k], prompt lengths [k], carried keys [k, 2],
+    updated pool).
     """
     with use_mesh(mesh):
-        P = prompt_tokens.shape[1]
+        k_rows, P = prompt_tokens.shape
         BLK = pool.block_size
-        sub = init_cache(config, 1, max_len=P)
+        NB = pool.n_blocks
+        sub = init_cache(config, k_rows, max_len=P)
         positions = prompt_positions(prompt_mask)
         chunk = prefill_chunk if prefill_chunk and prefill_chunk < P else P
         for start in range(0, P, chunk):
@@ -368,38 +418,42 @@ def _paged_insert(
                 attn_mask=prompt_mask[:, start:end],
                 compute_logits=end >= P,
             )
-        key, subkey = jax.random.split(key)
-        tau = sample_rows(
-            subkey[None], logits[:, -1], temperature[None], top_p[None],
-            top_k[None],
-        )[0]
-        plen = jnp.sum(prompt_mask.astype(jnp.int32))
+        keys, subkeys = _split_rows(keys)
+        tau = sample_rows(subkeys, logits[:, -1], temperature, top_p, top_k)
+        plen = jnp.sum(prompt_mask.astype(jnp.int32), axis=-1)
 
         L, KVH, _, _, hd = pool.k.shape
         nb = P // BLK
 
-        def to_blocks(a):  # [L, 1, P, KVH, ...] -> [L, KVH, nb, BLK, ...]
-            return jnp.moveaxis(a[:, 0], 2, 1).reshape(
-                (L, KVH, nb, BLK) + a.shape[4:]
+        def to_blocks(a):  # [L, k, P, KVH, ...] -> [L, KVH, k, nb, BLK, ...]
+            return jnp.moveaxis(a, 3, 1).reshape(
+                (L, KVH, k_rows, nb, BLK) + a.shape[4:]
             )
 
+        # block_ids is [k, nb]; sentinel entries (NB) drop their update.
         pool = dataclasses.replace(
             pool,
-            k=pool.k.at[:, :, block_ids].set(to_blocks(sub.k)),
-            v=pool.v.at[:, :, block_ids].set(to_blocks(sub.v)),
-            pos=pool.pos.at[block_ids].set(sub.pos[0].reshape(nb, BLK)),
+            k=pool.k.at[:, :, block_ids].set(
+                to_blocks(sub.k), mode="drop"
+            ),
+            v=pool.v.at[:, :, block_ids].set(
+                to_blocks(sub.v), mode="drop"
+            ),
+            pos=pool.pos.at[block_ids].set(
+                sub.pos.reshape(k_rows, nb, BLK), mode="drop"
+            ),
         )
         if pool.quantized:
             pool = dataclasses.replace(
                 pool,
                 k_scale=pool.k_scale.at[:, :, block_ids].set(
-                    to_blocks(sub.k_scale)
+                    to_blocks(sub.k_scale), mode="drop"
                 ),
                 v_scale=pool.v_scale.at[:, :, block_ids].set(
-                    to_blocks(sub.v_scale)
+                    to_blocks(sub.v_scale), mode="drop"
                 ),
             )
-        return tau, plen, key, pool
+        return tau, plen, keys, pool
 
 
 @functools.partial(jax.jit, donate_argnames=("pos",))
@@ -409,56 +463,138 @@ def _release_blocks(pos, block_ids):
     return pos.at[block_ids].set(-1, mode="drop")
 
 
+def _pool_as_cache(pool: BlockPool, table, fill) -> PagedKVCache:
+    return PagedKVCache(
+        k=pool.k, v=pool.v, pos=pool.pos, table=table, fill=fill,
+        k_scale=pool.k_scale, v_scale=pool.v_scale,
+    )
+
+
+def _cache_into_pool(pool: BlockPool, pcache: PagedKVCache) -> BlockPool:
+    return dataclasses.replace(
+        pool, k=pcache.k, v=pcache.v, pos=pcache.pos,
+        k_scale=pcache.k_scale, v_scale=pcache.v_scale,
+    )
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("t_config", "d_config", "n_draft", "mesh"),
+    static_argnames=(
+        "t_config", "d_config", "n_draft", "all_greedy", "use_kernel",
+        "mesh",
+    ),
     donate_argnames=("t_pool", "d_pool"),
 )
 def _spec_round(
     t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau, pos,
-    active, *, t_config, d_config, n_draft, mesh=None,
+    active, keys, temperature, top_p, top_k, *,
+    t_config, d_config, n_draft, all_greedy, use_kernel, mesh=None,
 ):
-    """One speculative round for every active slot (greedy verification).
+    """One speculative round for every active slot — greedy or sampled
+    verification, per-row policies.
 
-    Draft proposes ``n_draft`` tokens autoregressively, the target verifies
-    them in ONE [B, n_draft+1] forward (weights stream once per round —
-    the whole point on HBM-bound TPU decode), and the accepted prefix is
-    committed.  Both models share the block geometry, so one table/fill
-    serves the two pools.  Returns (outs [B, G+1] greedy continuations,
-    acc [B] accepted-draft counts, updated pools).
+    Draft proposes ``n_draft`` tokens autoregressively, the target
+    verifies them in ONE [B, n_draft+1] forward (weights stream once per
+    round — the whole point on HBM-bound TPU decode), and the accepted
+    prefix is committed.  Both models share the block geometry, so one
+    table/fill serves the two pools.
 
-    Rollback is real here (unlike ``generate_speculative``'s masked-slot
-    approach): per-row fills let the host rewind to fill + acc + 1, so
+    ``use_kernel`` (static) routes every forward through the Pallas
+    paged-attention kernel: the draft chain runs T=1 paged steps and the
+    verify is one T=G+1 multi-token kernel pass, so neither pool is ever
+    gathered into a contiguous view (the gathered path moved both pools'
+    bytes 3× per round).  The gathered fallback remains for
+    kernel-incompatible meshes / block sizes.
+
+    ``all_greedy`` (static) compiles the pure-argmax verification with no
+    RNG traffic.  Otherwise verification is per-row Leviathan rejection
+    sampling (``spec_decode``'s math with traced per-row policies): each
+    sampled row consumes its own key chain exactly as a standalone B=1
+    seeded ``generate_speculative`` of that request would — same split
+    topology, same warp math — so its emitted tokens are bit-identical;
+    greedy rows (temperature 0) take the exact-argmax path inside the
+    same program.
+
+    Returns (outs [B, G+1], acc [B], carried keys [B, 2], pools): the
+    host emits ``outs[:acc+1]`` per row and rewinds fill to +acc+1, so
     rejected drafts cost no pool capacity.
     """
     G = n_draft
     B = tau.shape[0]
+    V = t_config.vocab_size
     with use_mesh(mesh):
-        t_view = _gather_cache(t_pool, table, n_alloc, fill)
-        d_view = _gather_cache(d_pool, table, n_alloc, fill)
+        NB, BLK = t_pool.pos.shape
+        if all_greedy:
+            keys_out = keys
+            k_draft = k_accept = k_extra = keys  # unused
+        else:
+            # Row-wise mirror of _spec_impl's per-round
+            # ``rng, k_draft, k_accept, k_extra = jax.random.split(rng, 4)``.
+            splits = jax.vmap(lambda k: jax.random.split(k, 4))(keys)
+            keys_out, k_draft, k_accept, k_extra = (
+                splits[:, 0], splits[:, 1], splits[:, 2], splits[:, 3]
+            )
+
+        if use_kernel:
+            d_state = d_pool
+        else:
+            t_view = _gather_cache(t_pool, table, n_alloc, fill)
+            d_state = _gather_cache(d_pool, table, n_alloc, fill)
 
         # --- 1. draft chain: propose d_1 .. d_G ---
         def draft_one(carry, j):
-            view, tok = carry
+            state, tok, kd = carry
             pp = jnp.where(active, pos + j, -1)[:, None]
-            lg, view = forward(
-                d_params, tok[:, None], pp, d_config, cache=view,
-                attn_mask=active[:, None],
-            )
-            nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
-            return (view, nxt), nxt
+            if use_kernel:
+                pcache = _pool_as_cache(state, table, fill + j)
+                lg, pcache = forward(
+                    d_params, tok[:, None], pp, d_config, cache=pcache,
+                    attn_mask=active[:, None],
+                )
+                state = _cache_into_pool(state, pcache)
+            else:
+                lg, state = forward(
+                    d_params, tok[:, None], pp, d_config, cache=state,
+                    attn_mask=active[:, None],
+                )
+            greedy_nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            if all_greedy:
+                nxt = greedy_nxt
+                q = jnp.zeros((B, V), jnp.float32)  # unused
+            else:
+                # Mirror of _spec_impl.draft_one: key, sub = split(key);
+                # categorical(sub, log(q + 1e-30)).
+                kd, sub = _split_rows(kd)
+                q = warped_probs_rows(lg[:, -1], temperature, top_p, top_k)
+                sampled_nxt = jax.vmap(
+                    lambda key, row: jax.random.categorical(
+                        key, jnp.log(row + 1e-30)
+                    )
+                )(sub, q).astype(jnp.int32)
+                nxt = jnp.where(temperature <= 0.0, greedy_nxt, sampled_nxt)
+            return (state, nxt, kd), (nxt, q)
 
-        (d_view, d_last), drafts = jax.lax.scan(
-            draft_one, (d_view, tau), jnp.arange(G, dtype=jnp.int32)
+        (d_state, d_last, _), (drafts, qprobs) = jax.lax.scan(
+            draft_one, (d_state, tau, k_draft),
+            jnp.arange(G, dtype=jnp.int32),
         )
         drafts = jnp.swapaxes(drafts, 0, 1)  # [B, G]
+        qprobs = jnp.swapaxes(qprobs, 0, 1)  # [B, G, V]
         # Catch-up: land d_G's KV so a fully-accepted round leaves no hole
         # at pos+G (same reasoning as generate_speculative's extra forward).
-        _, d_view = forward(
-            d_params, d_last[:, None],
-            jnp.where(active, pos + G, -1)[:, None], d_config,
-            cache=d_view, attn_mask=active[:, None],
-        )
+        pp_g = jnp.where(active, pos + G, -1)[:, None]
+        if use_kernel:
+            pcache = _pool_as_cache(d_state, table, fill + G)
+            _, pcache = forward(
+                d_params, d_last[:, None], pp_g, d_config, cache=pcache,
+                attn_mask=active[:, None], compute_logits=False,
+            )
+            d_pool = _cache_into_pool(d_state, pcache)
+        else:
+            _, d_state = forward(
+                d_params, d_last[:, None], pp_g, d_config, cache=d_state,
+                attn_mask=active[:, None], compute_logits=False,
+            )
 
         # --- 2. one target pass over [tau, d_1 .. d_G] ---
         block = jnp.concatenate([tau[:, None], drafts], axis=1)
@@ -466,33 +602,103 @@ def _spec_round(
         block_pos = jnp.where(
             active[:, None], pos[:, None] + j, -1
         ).astype(jnp.int32)
-        t_logits, t_view = forward(
-            t_params, block, block_pos, t_config, cache=t_view,
-            attn_mask=jnp.broadcast_to(active[:, None], block.shape),
+        if use_kernel:
+            # The T=G+1 multi-token kernel pass: the target pool streams
+            # ONCE for the whole verify.
+            pcache = _pool_as_cache(t_pool, table, fill)
+            t_logits, pcache = forward(
+                t_params, block, block_pos, t_config, cache=pcache,
+                attn_mask=jnp.broadcast_to(active[:, None], block.shape),
+            )
+            t_pool = _cache_into_pool(t_pool, pcache)
+        else:
+            t_logits, t_view = forward(
+                t_params, block, block_pos, t_config, cache=t_view,
+                attn_mask=jnp.broadcast_to(active[:, None], block.shape),
+            )
+
+        # --- 3. verification ---
+        greedy_outs = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+        greedy_match = drafts == greedy_outs[:, :G]
+        greedy_acc = jnp.sum(
+            jnp.cumprod(greedy_match.astype(jnp.int32), axis=1), axis=1
         )
-        outs = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, G+1]
+        if all_greedy:
+            outs, acc = greedy_outs, greedy_acc
+        else:
+            # Per-row Leviathan rejection sampling (spec_decode._spec_impl
+            # with traced policies); greedy rows selected per-row below.
+            pprobs = warped_probs_rows(t_logits, temperature, top_p, top_k)
+            p_d = jnp.take_along_axis(
+                pprobs[:, :G], drafts[..., None], axis=-1
+            )[..., 0]
+            q_d = jnp.take_along_axis(
+                qprobs, drafts[..., None], axis=-1
+            )[..., 0]
+            u = jax.vmap(lambda k: jax.random.uniform(k, (G,)))(k_accept)
+            accept = u * q_d < p_d
+            acc_s = jnp.sum(
+                jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+            )
+            resid = jnp.maximum(pprobs[:, :G] - qprobs, 0.0)
+            cand = jnp.concatenate([resid, pprobs[:, G:]], axis=1)
+            dist = jnp.take_along_axis(
+                cand, acc_s[:, None, None], axis=1
+            )[:, 0]
+            mass = jnp.sum(dist, axis=-1, keepdims=True)
+            p_at = jnp.take_along_axis(
+                pprobs, acc_s[:, None, None], axis=1
+            )[:, 0]
+            dist = jnp.where(mass > 1e-12, dist, p_at)
+            extra = jax.vmap(
+                lambda key, row: jax.random.categorical(
+                    key, jnp.log(row + 1e-30)
+                )
+            )(k_extra, dist).astype(jnp.int32)
+            outs_s = jnp.concatenate(
+                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1
+            )
+            outs_s = outs_s.at[jnp.arange(B), acc_s].set(extra)
+            is_greedy = temperature <= 0.0
+            outs = jnp.where(is_greedy[:, None], greedy_outs, outs_s)
+            acc = jnp.where(is_greedy, greedy_acc, acc_s)
 
-        # --- 3. accept the matching draft prefix ---
-        match = drafts == outs[:, :G]
-        acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-
-        # --- 4. commit: invalidate rejected slots, write back both pools.
-        # Slot j holds block[j] (= tau for j=0, d_j after), valid iff
-        # j <= acc; the host rewinds fill to +acc+1 so rejected slots are
-        # reused, not wasted.
+        # --- 4. commit: invalidate rejected slots.  Slot j holds
+        # block[j] (= tau for j=0, d_j after), valid iff j <= acc; the
+        # host rewinds fill to +acc+1 so rejected slots are reused, not
+        # wasted.
         valid = j <= acc[:, None]
         patched = jnp.where(valid, block_pos, -1)
-        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
-        cols = fill[:, None] + j
-        t_view = dataclasses.replace(
-            t_view, pos=t_view.pos.at[rows, cols].set(patched, mode="drop")
-        )
-        d_view = dataclasses.replace(
-            d_view, pos=d_view.pos.at[rows, cols].set(patched, mode="drop")
-        )
-        t_pool = _scatter_back(t_pool, t_view, table, fill, active, T=G + 1)
-        d_pool = _scatter_back(d_pool, d_view, table, fill, active, T=G + 1)
-        return outs, acc, t_pool, d_pool
+        if use_kernel:
+            blk_i, off_i, _ = paged_write_indices(
+                table, fill, active, G + 1, NB, BLK
+            )
+            t_pool = dataclasses.replace(
+                t_pool,
+                pos=t_pool.pos.at[blk_i, off_i].set(patched, mode="drop"),
+            )
+            d_pool = dataclasses.replace(
+                d_pool,
+                pos=d_pool.pos.at[blk_i, off_i].set(patched, mode="drop"),
+            )
+        else:
+            rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+            cols = fill[:, None] + j
+            t_view = dataclasses.replace(
+                t_view,
+                pos=t_view.pos.at[rows, cols].set(patched, mode="drop"),
+            )
+            d_view = dataclasses.replace(
+                d_state,
+                pos=d_state.pos.at[rows, cols].set(patched, mode="drop"),
+            )
+            t_pool = _scatter_back(
+                t_pool, t_view, table, fill, active, T=G + 1
+            )
+            d_pool = _scatter_back(
+                d_pool, d_view, table, fill, active, T=G + 1
+            )
+        return outs, acc, keys_out, t_pool, d_pool
 
 
 # ---------------------------------------------------------------------------
@@ -545,10 +751,12 @@ class ContinuousBatcher:
 
     Passing ``draft_params``/``draft_config`` turns on speculative
     decoding inside the batcher: each step drafts ``n_draft`` tokens per
-    slot and verifies them in one target forward — output is token-
-    identical to the plain greedy batcher (the draft only changes speed;
-    see ``acceptance_rate()``).  Spec mode is greedy-only; sampled
-    speculative decode exists standalone in ``spec_decode``.
+    slot and verifies them in one target forward.  Greedy slots emit
+    token-identically to the plain greedy batcher; sampled slots emit
+    bit-identically to a standalone seeded ``generate_speculative`` of
+    the same request (per-row Leviathan rejection sampling with per-slot
+    key chains) — the draft only ever changes speed, never content (see
+    ``acceptance_rate()``).
     """
 
     def __init__(
@@ -583,12 +791,6 @@ class ContinuousBatcher:
                 raise ValueError("target and draft must share a vocabulary")
             if n_draft < 1:
                 raise ValueError("n_draft must be >= 1")
-            if temperature != 0.0:
-                raise ValueError(
-                    "speculative batching is greedy-only (temperature 0); "
-                    "use spec_decode.generate_speculative for sampled "
-                    "speculative decoding"
-                )
         self.draft_params = draft_params
         self.draft_config = draft_config
         self.n_draft = n_draft
@@ -660,11 +862,6 @@ class ContinuousBatcher:
         """
         if not prompt_tokens:
             raise ValueError("empty prompt")
-        if self.spec and (
-            (temperature or 0.0) != 0.0
-            or temperature is None and self.temperature != 0.0
-        ):
-            raise ValueError("speculative batching is greedy-only")
         # Capacity covers the BLOCK-PADDED prompt: admission pads the
         # prompt to a block multiple and the row's write offset starts
         # there.
@@ -698,8 +895,10 @@ class ContinuousBatcher:
                 f"request needs {req.blocks_needed(self.block_size)} "
                 f"blocks; the pool has {self.n_blocks} total"
             )
+        # Queue only — admission happens at the next step() boundary, so
+        # a burst of submits is admitted as ONE batched prefill dispatch
+        # instead of k serialized ones.
         self.queue.append(req)
-        self._admit()
         return rid
 
     def pending(self) -> bool:
@@ -803,17 +1002,43 @@ class ContinuousBatcher:
         self._admit()
         return out
 
+    def _spec_kernel_ok(self) -> bool:
+        """Same kernel-eligibility gate as _paged_decode_step (the T>1
+        verify adds no constraints: it shards identically)."""
+        ok = self.block_size % 8 == 0
+        if self.mesh is not None:
+            rows = (
+                self.mesh.shape.get("data", 1)
+                * self.mesh.shape.get("fsdp", 1)
+            )
+            ok &= (
+                self.config.kv_heads % self.mesh.shape.get("tensor", 1) == 0
+                and self.n_slots % rows == 0
+                and self.mesh.shape.get("seq", 1) == 1
+                and self.mesh.shape.get("stage", 1) == 1
+            )
+            if self.draft_config is not None:
+                ok &= (
+                    self.draft_config.kv_heads
+                    % self.mesh.shape.get("tensor", 1) == 0
+                )
+        return bool(ok)
+
     def _spec_tail(self, out: List[Tuple[int, int, bool]]) -> None:
         """Speculative remainder of a step: draft + verify, emit the
         accepted prefix (appended to ``out``), rewind fills past rejected
         slots."""
-        outs, acc, self.pool, self.draft_pool = _spec_round(
+        all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
+        outs, acc, self.keys, self.pool, self.draft_pool = _spec_round(
             self.params, self.draft_params, self.pool, self.draft_pool,
             jnp.array(self.table), jnp.array(self.n_alloc),
             jnp.array(self.fill), self.tau, jnp.array(self.pos),
-            jnp.array(self.active),
+            jnp.array(self.active), self.keys,
+            jnp.array(self.temp_arr), jnp.array(self.top_p_arr),
+            jnp.array(self.top_k_arr),
             t_config=self.config, d_config=self.draft_config,
-            n_draft=self.n_draft, mesh=self.mesh,
+            n_draft=self.n_draft, all_greedy=all_greedy,
+            use_kernel=self._spec_kernel_ok(), mesh=self.mesh,
         )
         outs = np.asarray(outs)
         acc = np.asarray(acc)
@@ -877,63 +1102,114 @@ class ContinuousBatcher:
         self.active[b] = False
 
     def _admit(self) -> None:
-        for b, slot in self.slots.items():
-            if slot is not None or not self.queue:
-                continue
-            need = self.queue[0].blocks_needed(self.block_size)
-            if need > len(self.free_blocks):
-                # Head-of-line blocking (FIFO fairness): wait for blocks.
-                return
-            req = self.queue.pop(0)
-            blocks = [self.free_blocks.pop(0) for _ in range(need)]
+        """Admit queued requests into free slots.
 
-            P = _round_up(len(req.tokens), self.block_size)
-            pt = np.zeros((1, P), np.int32)
-            pm = np.zeros((1, P), bool)
-            pt[0, P - len(req.tokens):] = req.tokens
-            pm[0, P - len(req.tokens):] = True
-            prompt_blocks = P // self.block_size
-            # Stable mix (NOT Python's hash(): its tuple algorithm is an
-            # interpreter implementation detail, which would silently
-            # change sampled outputs across Python versions).
-            seed = (
-                req.seed if req.seed is not None
-                else (self.seed * 1000003 + req.rid) & 0x7FFFFFFF
+        A burst of k admissible requests shares ONE [k', P] prefill
+        dispatch (k' = k rounded up to a power of two with inactive pad
+        rows, P = the group's max block-padded prompt length) instead of
+        k serialized B=1 dispatches — in this environment each dispatch
+        costs ~100ms of tunnel latency on top of the prefill itself.
+        Per-row left-padding and per-row key chains keep every request's
+        output bit-identical to one-at-a-time admission; head-of-line
+        FIFO blocking on block reservations is preserved.
+        """
+        while True:
+            free_slots = [b for b, s in self.slots.items() if s is None]
+            if not free_slots or not self.queue:
+                return
+            batch: List[_Request] = []
+            budget = len(self.free_blocks)
+            for req in self.queue:
+                if len(batch) >= len(free_slots):
+                    break
+                need = req.blocks_needed(self.block_size)
+                if need > budget:
+                    # Head-of-line blocking (FIFO fairness): wait.
+                    break
+                budget -= need
+                batch.append(req)
+            if not batch:
+                return
+            del self.queue[:len(batch)]
+            k = len(batch)
+            kb = 1 << max(k - 1, 0).bit_length()  # pow2 row bucket
+            P = max(
+                _round_up(len(r.tokens), self.block_size) for r in batch
             )
-            key = jax.random.PRNGKey(seed)
-            prompt_block_ids = jnp.asarray(
-                np.asarray(blocks[:prompt_blocks], np.int32)
-            )
-            tau, plen, key, self.pool = _paged_insert(
-                self.params, self.pool, prompt_block_ids,
-                jnp.asarray(pt), jnp.asarray(pm), key,
-                jnp.float32(req.temperature), jnp.float32(req.top_p),
-                jnp.int32(req.top_k),
+            nb = P // self.block_size
+            pt = np.zeros((kb, P), np.int32)
+            pm = np.zeros((kb, P), bool)
+            bid = np.full((kb, nb), self.n_blocks, np.int32)
+            keys = np.zeros((kb, 2), np.uint32)
+            temps = np.zeros((kb,), np.float32)
+            top_ps = np.ones((kb,), np.float32)
+            top_ks = np.zeros((kb,), np.int32)
+            row_blocks: List[List[int]] = []
+            for i, req in enumerate(batch):
+                Pb = _round_up(len(req.tokens), self.block_size)
+                need = req.blocks_needed(self.block_size)
+                blocks = [self.free_blocks.pop(0) for _ in range(need)]
+                row_blocks.append(blocks)
+                pt[i, P - len(req.tokens):] = req.tokens
+                pm[i, P - len(req.tokens):] = True
+                # Leading sentinels cover the group padding below this
+                # row's own block-padded length; block boundaries align
+                # because P and Pb are both block multiples.
+                lead = (P - Pb) // self.block_size
+                bid[i, lead:lead + Pb // self.block_size] = blocks[
+                    : Pb // self.block_size
+                ]
+                # Stable mix (NOT Python's hash(): its tuple algorithm is
+                # an interpreter implementation detail, which would
+                # silently change sampled outputs across Python versions).
+                seed = (
+                    req.seed if req.seed is not None
+                    else (self.seed * 1000003 + req.rid) & 0x7FFFFFFF
+                )
+                keys[i] = np.asarray(jax.random.PRNGKey(seed))
+                temps[i] = req.temperature
+                top_ps[i] = req.top_p
+                top_ks[i] = req.top_k
+            taus, plens, keys_out, self.pool = _paged_insert(
+                self.params, self.pool, jnp.asarray(bid),
+                jnp.asarray(pt), jnp.asarray(pm), jnp.asarray(keys),
+                jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(top_ks),
                 config=self.config, prefill_chunk=self.prefill_chunk,
                 mesh=self.mesh,
             )
             if self.spec:
                 # Prefill the draft pool over the same reserved blocks
-                # (its sampled token is discarded — the target picks tau).
+                # (its sampled tokens are discarded — the target picks
+                # tau, and each row's key chain carries from the TARGET
+                # insert only).
                 _, _, _, self.draft_pool = _paged_insert(
-                    self.draft_params, self.draft_pool, prompt_block_ids,
-                    jnp.asarray(pt), jnp.asarray(pm), key,
-                    jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+                    self.draft_params, self.draft_pool, jnp.asarray(bid),
+                    jnp.asarray(pt), jnp.asarray(pm), jnp.asarray(keys),
+                    jnp.zeros((kb,), jnp.float32),
+                    jnp.ones((kb,), jnp.float32),
+                    jnp.zeros((kb,), jnp.int32),
                     config=self.draft_config,
                     prefill_chunk=self.prefill_chunk, mesh=self.mesh,
                 )
-            self.tau = self.tau.at[b].set(tau)
-            self.keys = self.keys.at[b].set(key)
-            self.pos[b] = int(plen)
-            self.fill[b] = P
-            self.active[b] = True
-            self.table[b] = self.n_blocks
-            self.table[b, :need] = blocks
-            self.n_alloc[b] = need
-            self.temp_arr[b] = req.temperature
-            self.top_p_arr[b] = req.top_p
-            self.top_k_arr[b] = req.top_k
-            self.slots[b] = _Slot(
-                request_id=req.rid, emitted=[], max_new=req.max_new,
-                stop_tokens=req.stops, blocks=blocks,
-            )
+            slot_ids = free_slots[:k]
+            idx = jnp.asarray(np.asarray(slot_ids, np.int32))
+            self.tau = self.tau.at[idx].set(taus[:k])
+            self.keys = self.keys.at[idx].set(keys_out[:k])
+            plens_np = np.asarray(plens)
+            for i, req in enumerate(batch):
+                b = slot_ids[i]
+                blocks = row_blocks[i]
+                self.pos[b] = int(plens_np[i])
+                self.fill[b] = _round_up(len(req.tokens), self.block_size)
+                self.active[b] = True
+                self.table[b] = self.n_blocks
+                self.table[b, : len(blocks)] = blocks
+                self.n_alloc[b] = len(blocks)
+                self.temp_arr[b] = req.temperature
+                self.top_p_arr[b] = req.top_p
+                self.top_k_arr[b] = req.top_k
+                self.slots[b] = _Slot(
+                    request_id=req.rid, emitted=[], max_new=req.max_new,
+                    stop_tokens=req.stops, blocks=blocks,
+                )

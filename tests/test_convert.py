@@ -97,9 +97,22 @@ def test_convert_shard_reassembly_exact(tmp_path):
     params, config = convert_meta_checkpoint(
         tmp_path, vocab_size=VOCAB, dtype="float32"
     )
-    # wq of layer 0: concat shards on axis 0, transpose, reshape to heads.
+    # wq of layer 0: concat shards on axis 0, transpose, reshape to heads
+    # — recovered from the fused qkv layout via split_qkv (which inverts
+    # both the slot packing and the half-split RoPE feature permutation).
+    from jax_llama_tpu.models import split_qkv
+
+    got_q, got_k, got_v = split_qkv(np.asarray(params["layers"]["qkv"][0]))
     want_q = full["layers.0.attention.wq.weight"].T.reshape(DIM, HEADS, HD)
-    np.testing.assert_array_equal(params["layers"]["q"][0], want_q)
+    np.testing.assert_array_equal(got_q, want_q)
+    want_k = full["layers.0.attention.wk.weight"].T.reshape(DIM, KVH, HD)
+    np.testing.assert_array_equal(got_k, want_k)
+    want_v = full["layers.0.attention.wv.weight"].T.reshape(DIM, KVH, HD)
+    np.testing.assert_array_equal(got_v, want_v)
+    want_up = full["layers.0.feed_forward.w3.weight"].T
+    np.testing.assert_array_equal(
+        params["layers"]["gate_up"][0][:, 1], want_up
+    )
     want_o = full["layers.0.attention.wo.weight"].T.reshape(HEADS, HD, DIM)
     np.testing.assert_array_equal(params["layers"]["o"][0], want_o)
     np.testing.assert_array_equal(
@@ -167,7 +180,7 @@ def test_convert_single_shard_and_tied(tmp_path):
 def test_convert_bf16_dtype(tmp_path):
     _make_meta_ckpt(tmp_path)
     params, _ = convert_meta_checkpoint(tmp_path, vocab_size=VOCAB)
-    assert params["layers"]["q"].dtype == jnp.bfloat16
+    assert params["layers"]["qkv"].dtype == jnp.bfloat16
     assert params["embed"]["embedding"].dtype == jnp.bfloat16
 
 
@@ -184,6 +197,48 @@ def test_orbax_roundtrip(tmp_path):
     )
 
 
+def test_orbax_old_layout_checkpoint_migrates(tmp_path):
+    """Rounds 1-2 checkpoints stored separate q/k/v + gate/up (Meta
+    interleaved RoPE feature order): load_checkpoint must detect the old
+    tree, restore it, and fuse_params-migrate — same forward after."""
+    import orbax.checkpoint as ocp
+
+    from jax_llama_tpu.models import split_qkv
+
+    cfg = cfg_lib.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    # Construct the old on-disk layout from the fused tree (split_qkv
+    # inverts both the packing and the rope permutation — exactly what an
+    # old checkpoint held).
+    lp = dict(params["layers"])
+    q, k, v = split_qkv(lp.pop("qkv"))
+    gate_up = lp.pop("gate_up")
+    lp.update(q=q, k=k, v=v, gate=gate_up[:, :, 0], up=gate_up[:, :, 1])
+    old = dict(params)
+    old["layers"] = lp
+
+    import dataclasses as _dc
+    import json as _json
+
+    ckpt = tmp_path / "old_ckpt"
+    ckpt.mkdir()
+    (ckpt / "config.json").write_text(
+        _json.dumps(dict(_dc.asdict(cfg), _quantized=False))
+    )
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save((ckpt / "params").absolute(), old, force=True)
+    ckptr.wait_until_finished()
+
+    restored, rcfg = load_checkpoint(ckpt)
+    assert rcfg == cfg
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        restored, params,
+    )
+
+
 def test_orbax_sharded_restore(tmp_path):
     cfg = cfg_lib.tiny()
     params = init_params(jax.random.PRNGKey(0), cfg)
@@ -191,10 +246,11 @@ def test_orbax_sharded_restore(tmp_path):
     save_checkpoint(ckpt, params, cfg)
     mesh = make_mesh(tensor=2, data=4)
     restored, rcfg = load_checkpoint(ckpt, mesh=mesh)
-    q = restored["layers"]["q"]
-    shard_shapes = {s.data.shape for s in q.addressable_shards}
+    qkv = restored["layers"]["qkv"]
+    shard_shapes = {s.data.shape for s in qkv.addressable_shards}
+    G = cfg.n_heads // cfg.kv_heads
     assert shard_shapes == {
-        (cfg.n_layers, cfg.dim, cfg.n_heads // 2, cfg.head_dim)
+        (cfg.n_layers, cfg.dim, cfg.kv_heads // 2, G + 2, cfg.head_dim)
     }
     # Restored-sharded forward == original.
     tokens = jnp.asarray([[1, 2, 3, 4]])

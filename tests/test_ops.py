@@ -39,28 +39,47 @@ def test_rms_norm_matches_oracle():
 
 
 def test_rope_matches_complex_oracle():
+    """The runtime rotation is half-split over PERMUTED features
+    (models.llama.rope_permute); permute -> rotate -> unpermute must equal
+    Meta's interleaved complex rotation of the raw vector exactly."""
+    from jax_llama_tpu.models.llama import rope_permute
+
     hd, max_pos, theta = 16, 64, 10000.0
     cos, sin = rope_table(hd, max_pos, theta)
     freqs = oracle.rope_freqs_cis(hd, max_pos, theta)
     for _ in range(TRIALS):
         x = np.random.randn(2, 7, 4, hd).astype(np.float32)
         pos = np.random.randint(0, max_pos, size=(2, 7))
-        got = apply_rope(jnp.asarray(x), cos, sin, jnp.asarray(pos))
+        got = rope_permute(
+            np.asarray(
+                apply_rope(
+                    jnp.asarray(rope_permute(x)), cos, sin, jnp.asarray(pos)
+                )
+            ),
+            inverse=True,
+        )
         want = oracle.apply_rope(
             torch.from_numpy(x), freqs, torch.from_numpy(pos)
         )
-        np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(got, want.numpy(), atol=1e-5, rtol=1e-5)
 
 
 def test_rope_large_theta_llama3():
+    from jax_llama_tpu.models.llama import rope_permute
+
     hd = 128
     cos, sin = rope_table(hd, 256, 500000.0)
     freqs = oracle.rope_freqs_cis(hd, 256, 500000.0)
     x = np.random.randn(1, 9, 2, hd).astype(np.float32)
     pos = np.arange(9)[None, :]
-    got = apply_rope(jnp.asarray(x), cos, sin, jnp.asarray(pos))
+    got = rope_permute(
+        np.asarray(
+            apply_rope(jnp.asarray(rope_permute(x)), cos, sin, jnp.asarray(pos))
+        ),
+        inverse=True,
+    )
     want = oracle.apply_rope(torch.from_numpy(x), freqs, torch.from_numpy(pos))
-    np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got, want.numpy(), atol=1e-5, rtol=1e-5)
 
 
 def test_llama31_scaled_rope():

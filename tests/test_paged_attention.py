@@ -110,6 +110,169 @@ def test_paged_kernel_gqa_head_order():
     np.testing.assert_allclose(got[0], want, atol=1e-5, rtol=1e-5)
 
 
+def _reference_multi(q, kn, vn, kp, vp, pool_pos, table, qpos, b, T):
+    """Dense attention over row b's gathered blocks + T new slots at
+    consecutive positions qpos..qpos+T-1 (within-step causal)."""
+    NB = kp.shape[1]
+    ks, vs, ps = [], [], []
+    for t in table[b]:
+        if t < NB:
+            ks.append(kp[:, t])
+            vs.append(vp[:, t])
+            ps.append(pool_pos[t])
+    kcat = np.concatenate(
+        ks + [kn[b].transpose(1, 0, 2)], axis=1
+    ).transpose(1, 0, 2)[None]
+    vcat = np.concatenate(
+        vs + [vn[b].transpose(1, 0, 2)], axis=1
+    ).transpose(1, 0, 2)[None]
+    new_pos = qpos[b] + np.arange(T)
+    pcat = np.concatenate(ps + [new_pos])
+    q_positions = (qpos[b] + np.arange(T))[None]
+    bias = attention_bias(
+        jnp.asarray(q_positions, jnp.int32), jnp.asarray(pcat[None]),
+        jnp.asarray((pcat >= 0)[None]),
+    )
+    return np.asarray(
+        sdpa(jnp.asarray(q[b:b + 1]), jnp.asarray(kcat), jnp.asarray(vcat),
+             bias)
+    )[0]
+
+
+def test_paged_kernel_multi_token_matches_dense():
+    """T>1 (speculative-verify shape): T consecutive-position queries per
+    row share one pool sweep; token t additionally attends the step's own
+    slots j <= t.  Must match dense attention over the gathered blocks +
+    new slots, including rows whose early tokens see fewer blocks."""
+    rng = np.random.RandomState(7)
+    B, H, KVH, d, T = 4, 8, 2, 32, 3
+    NB, BLK, MB = 12, 16, 5
+    fills = [40, 0, 16, 7]
+    qpos = np.array([40, -1, 16, 7], np.int32)
+    kp, vp, pool_pos, table = _random_pool_state(
+        rng, B, KVH, d, NB, BLK, MB, fills
+    )
+    q = rng.randn(B, T, H, d).astype(np.float32)
+    kn = rng.randn(B, T, KVH, d).astype(np.float32)
+    vn = rng.randn(B, T, KVH, d).astype(np.float32)
+
+    got = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kn), jnp.asarray(vn),
+        jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(pool_pos),
+        jnp.asarray(table), jnp.asarray(qpos),
+    ))
+    assert np.isfinite(got).all()
+    for b in range(B):
+        if qpos[b] < 0:
+            continue
+        want = _reference_multi(
+            q, kn, vn, kp, vp, pool_pos, table, qpos, b, T
+        )
+        np.testing.assert_allclose(got[b], want, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_kernel_multi_token_first_token_empty_pool():
+    """A fresh row (empty pool, qpos 0): token 0 attends only itself —
+    the all-masked-tile guard must not poison its softmax state."""
+    rng = np.random.RandomState(8)
+    B, H, KVH, d, T = 2, 4, 2, 16, 4
+    NB, BLK, MB = 6, 8, 3
+    fills = [0, 11]
+    qpos = np.array([0, 11], np.int32)
+    kp, vp, pool_pos, table = _random_pool_state(
+        rng, B, KVH, d, NB, BLK, MB, fills
+    )
+    # Row 0: reserve blocks but nothing written yet (pos stays -1).
+    table[0, :2] = [4, 5]
+    q = rng.randn(B, T, H, d).astype(np.float32)
+    kn = rng.randn(B, T, KVH, d).astype(np.float32)
+    vn = rng.randn(B, T, KVH, d).astype(np.float32)
+    got = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kn), jnp.asarray(vn),
+        jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(pool_pos),
+        jnp.asarray(table), jnp.asarray(qpos),
+    ))
+    assert np.isfinite(got).all()
+    for b in range(B):
+        want = _reference_multi(
+            q, kn, vn, kp, vp, pool_pos, table, qpos, b, T
+        )
+        np.testing.assert_allclose(got[b], want, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_forward_multi_token_matches_gathered_view():
+    """paged_forward at T=3 (the verify shape) vs the gathered-view
+    forward: same logits for active rows, same pool afterwards."""
+    import dataclasses
+
+    from jax_llama_tpu.serving import _scatter_back
+
+    config = get_config(
+        "tiny", vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    B, NB, BLK, MB, T = 3, 10, 8, 3, 3
+    pool = init_pool(config, NB, BLK)
+    rng = np.random.RandomState(9)
+    pool = dataclasses.replace(
+        pool,
+        k=jnp.asarray(rng.randn(*pool.k.shape), pool.k.dtype),
+        v=jnp.asarray(rng.randn(*pool.v.shape), pool.v.dtype),
+    )
+    fills = [10, 0, 17]
+    qpos = np.array([10, -1, 17], np.int32)
+    pool_pos = np.full((NB, BLK), -1, np.int32)
+    table = np.full((B, MB), NB, np.int32)
+    free = list(range(NB))
+    n_alloc = np.zeros((B,), np.int32)
+    for b, fill in enumerate(fills):
+        n = -(-(fill + T) // BLK) if qpos[b] >= 0 else 0
+        blocks = [free.pop(0) for _ in range(n)]
+        table[b, :n] = blocks
+        n_alloc[b] = n
+        for j, blk in enumerate(blocks):
+            m = max(0, min(BLK, fill - j * BLK))
+            if m:
+                pool_pos[blk, :m] = np.arange(j * BLK, j * BLK + m)
+    pool = dataclasses.replace(pool, pos=jnp.asarray(pool_pos))
+
+    toks = jnp.asarray(rng.randint(0, 128, (B, T)), jnp.int32)
+    active = jnp.asarray(qpos >= 0)
+    positions = jnp.asarray(
+        np.where((qpos >= 0)[:, None], qpos[:, None] + np.arange(T), -1),
+        jnp.int32,
+    )
+    fill_arr = jnp.asarray(fills, jnp.int32)
+    tbl = jnp.asarray(table)
+    amask = jnp.broadcast_to(active[:, None], (B, T))
+
+    view = _gather_cache(pool, tbl, jnp.asarray(n_alloc), fill_arr)
+    want_logits, view = forward(
+        params, toks, positions, config, cache=view, attn_mask=amask,
+    )
+    want_pool = _scatter_back(pool, view, tbl, fill_arr, active, T=T)
+
+    pcache = PagedKVCache(
+        k=pool.k, v=pool.v, pos=pool.pos, table=tbl, fill=fill_arr
+    )
+    got_logits, pcache = forward(
+        params, toks, positions, config, cache=pcache, attn_mask=amask,
+    )
+
+    act = np.asarray(active)
+    np.testing.assert_allclose(
+        np.asarray(got_logits)[act], np.asarray(want_logits)[act],
+        atol=1e-4, rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pcache.k), np.asarray(want_pool.k), atol=1e-5, rtol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pcache.pos), np.asarray(want_pool.pos)
+    )
+
+
 def test_paged_forward_matches_gathered_view_forward():
     """A full model step via paged_forward (Pallas kernel + scatter) must
     match the gathered-view forward (per-row-offset KVCache) it replaced:

@@ -44,9 +44,13 @@ def test_specs_cover_fsdp_variant(params):
 def test_tp_sharded_leaves(params):
     mesh = make_mesh(tensor=2, data=4)
     sharded = shard_params(params, mesh, CFG)
-    q = sharded["layers"]["q"]  # [L, D, H, hd] sharded on H over tensor=2
-    shard_shapes = {s.data.shape for s in q.addressable_shards}
-    assert shard_shapes == {(CFG.n_layers, CFG.dim, CFG.n_heads // 2, CFG.head_dim)}
+    # [L, D, KVH, G+2, hd] sharded on KVH over tensor=2
+    qkv = sharded["layers"]["qkv"]
+    G = CFG.n_heads // CFG.kv_heads
+    shard_shapes = {s.data.shape for s in qkv.addressable_shards}
+    assert shard_shapes == {
+        (CFG.n_layers, CFG.dim, CFG.kv_heads // 2, G + 2, CFG.head_dim)
+    }
 
 
 @pytest.mark.parametrize("axes", [dict(tensor=2, data=4),

@@ -94,26 +94,31 @@ def to_reference_params(params, config):
     """Map our stacked-layer pytree onto the reference's Flax param tree.
 
     Layout contract (reference model.py:105-180,302-341,602-744): Dense
-    kernels are [in, out]; our per-layer q/k/v [D, H, hd] flatten to the
-    reference's fused [D, H*hd]; o [H, hd, D] flattens to [H*hd, D];
-    gate/up/down are w1/w3/w2 unchanged; norms are 1-D 'kernel's.
+    kernels are [in, out]; our fused per-layer qkv [D, KVH, G+2, hd]
+    splits (models.llama.split_qkv) into the reference's separate
+    [D, H*hd] / [D, KVH*hd] kernels; o [H, hd, D] flattens to [H*hd, D];
+    gate_up[:, 0]/gate_up[:, 1]/down are w1/w3/w2; norms are 1-D
+    'kernel's.
     """
+    from jax_llama_tpu.models import split_qkv
+
     D, H, KVH, hd = config.dim, config.n_heads, config.kv_heads, config.head_dim
     lp = params["layers"]
     f32 = lambda x: np.asarray(x, np.float32)
     h = {}
     for i in range(config.n_layers):
+        q_i, k_i, v_i = split_qkv(lp["qkv"][i])
         h[str(i)] = {
             "attention": {
-                "wq": {"kernel": f32(lp["q"][i]).reshape(D, H * hd)},
-                "wk": {"kernel": f32(lp["k"][i]).reshape(D, KVH * hd)},
-                "wv": {"kernel": f32(lp["v"][i]).reshape(D, KVH * hd)},
+                "wq": {"kernel": f32(q_i).reshape(D, H * hd)},
+                "wk": {"kernel": f32(k_i).reshape(D, KVH * hd)},
+                "wv": {"kernel": f32(v_i).reshape(D, KVH * hd)},
                 "wo": {"kernel": f32(lp["o"][i]).reshape(H * hd, D)},
             },
             "feed_forward": {
-                "w1": {"kernel": f32(lp["gate"][i])},
+                "w1": {"kernel": f32(lp["gate_up"][i][:, 0])},
                 "w2": {"kernel": f32(lp["down"][i])},
-                "w3": {"kernel": f32(lp["up"][i])},
+                "w3": {"kernel": f32(lp["gate_up"][i][:, 1])},
             },
             "attention_norm": {"kernel": f32(lp["attn_norm"][i])},
             "ffn_norm": {"kernel": f32(lp["mlp_norm"][i])},

@@ -92,12 +92,17 @@ def test_stop_tokens_free_slot(model):
     params, config = model
     prompt = [5, 17, 99, 3, 42]
     free_run = _reference(params, config, prompt, 16)
-    stop = free_run[2]  # third emitted token becomes the stop
+    # First token value that does not also occur earlier in the run
+    # becomes the stop (so truncation-at-first-occurrence is unambiguous).
+    j = next(
+        i for i in range(1, len(free_run)) if free_run[i] not in free_run[:i]
+    )
+    stop = free_run[j]
     cb = ContinuousBatcher(params, config, n_slots=1, max_len=64,
                            stop_tokens=(stop,))
     rid = cb.submit(prompt, max_new_tokens=16)
     results = cb.run_to_completion()
-    assert results[rid] == free_run[:3]
+    assert results[rid] == free_run[:j + 1]
     assert not cb.pending()
 
 
@@ -147,6 +152,7 @@ def test_no_pow2_waste(model):
                            block_size=16, n_blocks=12)
     r1 = cb.submit(prompt, max_new_tokens=8)
     r2 = cb.submit(prompt[:10], max_new_tokens=8)
+    cb._admit()  # submit only queues; admission is a step-boundary batch
     # 65 -> 80 padded, +8 -> 88 -> 6 blocks; 10 -> 16, +8 -> 24 -> 2 blocks
     assert cb.slots[0] is not None and cb.slots[1] is not None
     results = cb.run_to_completion()
@@ -165,6 +171,7 @@ def test_overcommit_pool_queues_until_blocks_free(model):
                            block_size=16, n_blocks=6)
     prompts = [[4, 5, 6], [7, 8, 9], [10, 11, 12]]
     rids = [cb.submit(p, max_new_tokens=30) for p in prompts]
+    cb._admit()  # submit only queues; admission is a step-boundary batch
     # each request reserves ceil((16+30)/16) = 3 blocks; only two fit at
     # once, the third queues.
     assert sum(s is not None for s in cb.slots.values()) == 2

@@ -91,19 +91,94 @@ def test_spec_batcher_stop_tokens(models):
     assert sorted(cb.free_blocks) == list(range(cb.n_blocks))
 
 
-def test_spec_batcher_rejects_sampling(models):
+def test_spec_batcher_sampled_matches_standalone(models):
+    """Sampled speculative serving: a sampled slot must emit BIT-identical
+    tokens to a standalone seeded ``generate_speculative`` of the same
+    request (same key-split topology, same warp math), while a greedy slot
+    sharing the batch stays token-identical to the plain greedy batcher."""
+    import jax.numpy as jnp
+
+    from jax_llama_tpu.engine import GenerationConfig
+    from jax_llama_tpu.spec_decode import generate_speculative
+
     params, config, draft_params, draft_config = models
+    rng = np.random.RandomState(5)
+    sampled_prompt = rng.randint(1, 128, size=7).tolist()
+    greedy_prompt = rng.randint(1, 128, size=5).tolist()
+
     cb = ContinuousBatcher(
-        params, config, n_slots=1, max_len=64,
-        draft_params=draft_params, draft_config=draft_config,
+        params, config, n_slots=2, max_len=64,
+        draft_params=draft_params, draft_config=draft_config, n_draft=3,
     )
-    with pytest.raises(ValueError, match="greedy-only"):
-        cb.submit([1, 2, 3], max_new_tokens=4, temperature=0.8)
-    with pytest.raises(ValueError, match="greedy-only"):
-        ContinuousBatcher(
-            params, config, n_slots=1, max_len=64, temperature=0.7,
-            draft_params=draft_params, draft_config=draft_config,
+    r0 = cb.submit(
+        sampled_prompt, max_new_tokens=10, temperature=0.9, top_p=0.8,
+        seed=123,
+    )
+    r1 = cb.submit(greedy_prompt, max_new_tokens=10)
+    results = cb.run_to_completion()
+
+    # Greedy slot: unchanged vs the plain (non-spec) greedy batcher.
+    _, pres = _plain(params, config, [greedy_prompt], 10)
+    assert results[r1] == list(pres.values())[0]
+
+    # Sampled slot: bit-identical to the standalone engine with its seed.
+    gc = GenerationConfig(
+        max_new_tokens=10, temperature=0.9, top_p=0.8, top_k=None,
+        stop_tokens=(), pad_id=0,
+    )
+    P = len(sampled_prompt)
+    buf, _ = generate_speculative(
+        params, draft_params,
+        jnp.asarray([sampled_prompt], jnp.int32),
+        jnp.ones((1, P), bool),
+        jax.random.PRNGKey(123),
+        target_config=config, draft_config=draft_config, gen_config=gc,
+        n_draft=3, mesh=None,
+    )
+    want = np.asarray(buf)[0, P:P + 10].tolist()
+    assert results[r0] == want
+
+
+def test_spec_batcher_sampled_only_batch(models):
+    """Two sampled slots with different seeds/policies, no greedy rows:
+    each must reproduce its standalone seeded run."""
+    import jax.numpy as jnp
+
+    from jax_llama_tpu.engine import GenerationConfig
+    from jax_llama_tpu.spec_decode import generate_speculative
+
+    params, config, draft_params, draft_config = models
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 128, size=6).tolist(),
+               rng.randint(1, 128, size=9).tolist()]
+    policies = [dict(temperature=0.7, top_p=1.0, seed=7),
+                dict(temperature=1.3, top_p=0.9, top_k=20, seed=8)]
+
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64,
+        draft_params=draft_params, draft_config=draft_config, n_draft=2,
+    )
+    rids = [
+        cb.submit(p, max_new_tokens=8, **pol)
+        for p, pol in zip(prompts, policies)
+    ]
+    results = cb.run_to_completion()
+
+    for p, pol, rid in zip(prompts, policies, rids):
+        gc = GenerationConfig(
+            max_new_tokens=8, temperature=pol["temperature"],
+            top_p=pol["top_p"], top_k=pol.get("top_k"),
+            stop_tokens=(), pad_id=0,
         )
+        P = len(p)
+        buf, _ = generate_speculative(
+            params, draft_params, jnp.asarray([p], jnp.int32),
+            jnp.ones((1, P), bool), jax.random.PRNGKey(pol["seed"]),
+            target_config=config, draft_config=draft_config,
+            gen_config=gc, n_draft=2, mesh=None,
+        )
+        want = np.asarray(buf)[0, P:P + 8].tolist()
+        assert results[rid] == want, f"slot {rid}"
 
 
 def test_spec_batcher_staggered_admission(models):

@@ -79,8 +79,8 @@ def test_sharded_train_step_matches_single_device():
         float(loss_sharded), float(loss_single), rtol=1e-5
     )
     # Params actually changed and stayed finite.
-    q = np.asarray(sstate.params["layers"]["q"])
-    assert np.isfinite(q).all()
+    qkv = np.asarray(sstate.params["layers"]["qkv"])
+    assert np.isfinite(qkv).all()
 
 
 # ---------------------------------------------------------------------------

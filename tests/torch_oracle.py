@@ -37,6 +37,33 @@ def apply_rope(x: torch.Tensor, freqs_cis: torch.Tensor, positions: torch.Tensor
     return out.type_as(x)
 
 
+def _split_layers(lp):
+    """Accept either the framework's fused layer layout (qkv [L, D, KVH,
+    G+2, hd] + gate_up [L, D, 2, F]) or the separate one; return a dict
+    with separate q/k/v/gate/up views in Meta interleaved-RoPE feature
+    order, so the oracle math below stays an independent from-the-paper
+    implementation of Meta's convention."""
+    if "qkv" not in lp:
+        return lp
+
+    def unpermute(w):
+        # Inverse of models.llama.rope_permute (numpy): runtime half-split
+        # feature order -> Meta interleaved order.
+        *lead, hd = w.shape
+        return w.reshape(*lead, 2, hd // 2).swapaxes(-1, -2).reshape(w.shape)
+
+    qkv = np.asarray(lp["qkv"])
+    L, D, KVH, g2, hd = qkv.shape
+    G = g2 - 2
+    out = dict(lp)
+    out["q"] = unpermute(qkv[..., :G, :].reshape(L, D, KVH * G, hd))
+    out["k"] = unpermute(qkv[..., G, :])
+    out["v"] = qkv[..., G + 1, :]
+    gu = np.asarray(lp["gate_up"])
+    out["gate"], out["up"] = gu[:, :, 0], gu[:, :, 1]
+    return out
+
+
 def oracle_forward(params, tokens: np.ndarray, positions: np.ndarray, cfg) -> np.ndarray:
     """Full-model forward, no KV cache, fp32.  Returns [B, T, V] logits."""
     t = lambda a: torch.from_numpy(np.asarray(a)).float()
@@ -57,7 +84,7 @@ def oracle_forward(params, tokens: np.ndarray, positions: np.ndarray, cfg) -> np
     allowed = (slot_pos[:, None, :] >= 0) & (slot_pos[:, None, :] <= pos_c[:, :, None])
     bias = torch.where(allowed, 0.0, torch.finfo(torch.float32).min)[:, None, :, :]
 
-    lp = params["layers"]
+    lp = _split_layers(params["layers"])
     for i in range(cfg.n_layers):
         h = rms_norm(x, t(lp["attn_norm"][i]), cfg.rms_norm_eps)
         q = torch.einsum("btd,dhk->bthk", h, t(lp["q"][i]))
