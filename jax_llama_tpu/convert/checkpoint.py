@@ -94,13 +94,29 @@ def load_checkpoint(
     else:
         abstract = shapes
     ckptr = ocp.StandardCheckpointer()
-    try:
-        params = ckptr.restore(path / "params", abstract)
-    except Exception:
+    if _saved_layout_is_old(ckptr, path / "params"):
         params = _restore_old_layout(
             ckptr, path, config, quantized, mesh, fsdp
         )
+    else:
+        # New layout (or metadata unavailable): restore directly, letting
+        # any real failure (truncated files, version mismatch, OOM)
+        # propagate as itself — a restore error must never be
+        # mis-diagnosed as "old layout".
+        params = ckptr.restore(path / "params", abstract)
     return params, config
+
+
+def _saved_layout_is_old(ckptr, item_path: Path) -> bool:
+    """Whether the saved params tree predates the fused qkv/gate_up layout,
+    decided from the checkpoint's own tree metadata (cheap — no array
+    reads).  Unreadable metadata counts as new-layout."""
+    try:
+        tree = ckptr.metadata(item_path).item_metadata.tree
+        layers = tree.get("layers", {})
+    except Exception:
+        return False
+    return "q" in layers and "qkv" not in layers
 
 
 def _old_layout_shapes(config: LLaMAConfig) -> Any:

@@ -45,6 +45,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from ..config import LLaMAConfig
+from ..models.llama import rope_permute
 
 
 def _load_shards(ckpt_dir: str):
@@ -183,8 +184,6 @@ def convert_meta_checkpoint(
         # Meta's own head order, so no HEAD permutation happens — but the
         # q/k head_dim FEATURES are permuted to the runtime half-split
         # RoPE order, see ops.rope / models.llama.rope_permute).
-        from ..models.llama import rope_permute
-
         q_i = rope_permute(
             col(pre + "attention.wq.weight").reshape(D, H, hd)
         ).reshape(D, KVH, G, hd)

@@ -526,7 +526,21 @@ def _block(
 
             attn = ring_sdpa(q, kk, vv, positions, slot_pos)
         elif impl in ("flash", "ring"):
-            attn = flash_attention(q, kk, vv, positions, slot_pos)
+            if dropout_rng is not None and config.attn_pdrop > 0.0:
+                # In-kernel probability dropout: the mask is generated
+                # blockwise inside the flash forward AND rebuilt
+                # bit-identically in the backward kernels — O(S·d) memory
+                # stands, so attention-dropout training works at long
+                # context (the xla path materializes [B, H, T, S]).
+                attn = flash_attention(
+                    q, kk, vv, positions, slot_pos,
+                    dropout_rate=config.attn_pdrop,
+                    dropout_seed=jax.random.bits(
+                        jax.random.fold_in(dropout_rng, 0), (1,), "uint32"
+                    ),
+                )
+            else:
+                attn = flash_attention(q, kk, vv, positions, slot_pos)
         else:
             attn = sdpa(
                 q, kk, vv, bias, softmax_dtype=softmax_dtype,
@@ -655,19 +669,17 @@ def forward(
     # where flash's one-row grid and in-scan cache writes lose.
     impl = config.attn_impl
     if impl == "auto":
-        # Per-row indices and attention-probability dropout are only
-        # supported on the xla path, so "auto" resolves there regardless
-        # of T in those cases.  (int8 caches run on both: the flash
-        # kernel folds the dequant scales in-kernel.)
-        must_xla = (
-            cache is not None and cache.per_row_index
-        ) or (dropout_rng is not None and config.attn_pdrop > 0.0)
+        # Per-row indices are only supported on the xla path, so "auto"
+        # resolves there regardless of T in that case.  (int8 caches and
+        # attention dropout run on both: the flash kernel folds dequant
+        # scales — and generates dropout masks — in-kernel.)
+        must_xla = cache is not None and cache.per_row_index
         impl = "flash" if T > 8 and not must_xla else "xla"
-    if dropout_rng is not None and config.attn_pdrop > 0.0 and impl != "xla":
+    if dropout_rng is not None and config.attn_pdrop > 0.0 and impl == "ring":
         raise NotImplementedError(
-            "attn_pdrop requires the xla attention path (the flash/ring "
-            "kernels do not implement probability dropout); use "
-            "attn_impl='xla'/'auto' for dropout training or attn_pdrop=0"
+            "attn_pdrop does not compose with ring (seq-sharded) attention "
+            "— the chunked ring accumulation has no in-kernel dropout; "
+            "train with attn_impl='flash'/'xla'/'auto' or attn_pdrop=0"
         )
     bias_new = None
     ring_cached = False
@@ -980,7 +992,8 @@ def paged_forward(
     sublane iota): each active row's positions are CONSECUTIVE —
     ``positions[:, t] == positions[:, 0] + t`` — and a row is active or
     inactive as a whole (``attn_mask`` constant along T).  Speculative
-    rounds satisfy both by construction.
+    rounds satisfy both by construction; a row violating either is
+    folded to inactive (enforced below) rather than trusted.
 
     Rows with ``attn_mask`` False (or position -1) are inactive: they
     attend nothing, their logits are garbage the host ignores, and their
@@ -1001,9 +1014,23 @@ def paged_forward(
     )
 
     x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(adt)
-    q_pos_row = jnp.where(attn_mask[:, 0], positions[:, 0], -1).astype(
-        jnp.int32
-    )
+    # The kernel derives token t's mask/position from positions[:, 0] + t
+    # (sublane iota) and treats a row as live or dead as a whole, so the
+    # T > 1 contract above is enforced by DEFINITION rather than trust:
+    # a row violating it (mixed attn_mask, non-consecutive positions) is
+    # folded to inactive — attends nothing, writes nothing — instead of
+    # silently corrupting the pool.  [B, T] integer ops, free next to the
+    # forward; speculative rounds conform by construction.
+    row_active = attn_mask[:, 0]
+    if T > 1:
+        uniform = jnp.all(attn_mask == attn_mask[:, :1], axis=1)
+        consecutive = jnp.all(
+            positions
+            == positions[:, :1] + jnp.arange(T, dtype=positions.dtype),
+            axis=1,
+        )
+        row_active = row_active & uniform & consecutive
+    q_pos_row = jnp.where(row_active, positions[:, 0], -1).astype(jnp.int32)
 
     block = functools.partial(
         _block,
@@ -1064,7 +1091,7 @@ def paged_forward(
     # Land the step's projections via the shared write-back contract
     # (paged_write_indices — same function serving's gathered-view
     # scatter uses, so the two paths cannot drift).
-    active = attn_mask[:, 0]
+    active = row_active
     blk_idx, off, _ = paged_write_indices(
         cache.table, cache.fill, active, T, NB, BLK
     )  # [B, T] each

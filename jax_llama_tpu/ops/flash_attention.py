@@ -44,19 +44,71 @@ _LANES = 128  # TPU lane width
 _SUBLANES = 8  # TPU sublane width (fp32/int32)
 
 
+def _mix32(x):
+    """splitmix32 finalizer: a bijective avalanche mix on uint32.
+
+    The dropout mask must be regenerated bit-identically in THREE kernels
+    (forward, dQ sweep, dK/dV sweep) whose grids visit tiles in different
+    orders, and must run both compiled (Mosaic) and interpreted (CPU test
+    meshes) — ``pltpu.prng_seed`` has no interpret-mode lowering in this
+    JAX version, so the mask comes from a counter-based hash of the global
+    (row, column) indices instead of hardware PRNG state.  uint32 wraparound
+    is the modular arithmetic the constants were designed for.
+    """
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _dropout_keep(seed, b, h, qi, ki, bq, bk, rate):
+    """Deterministic keep-mask tile [bq, bk] for probability dropout.
+
+    Keyed on (seed, batch, head, global row, global column) so any kernel
+    that knows its tile coordinates rebuilds the exact same Bernoulli draw;
+    element (r, c) keeps with probability 1 - rate.  Three mixes: one per
+    (batch, head), one per row [bq, 1], one elementwise [bq, bk] — the
+    per-element VPU cost is a handful of integer ops.
+    """
+    base = _mix32(
+        seed
+        ^ _mix32(
+            b.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+            + h.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+            + jnp.uint32(1)
+        )
+    )
+    rows = jax.lax.broadcasted_iota(jnp.uint32, (bq, 1), 0) + (
+        qi * bq
+    ).astype(jnp.uint32)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, (1, bk), 1) + (
+        ki * bk
+    ).astype(jnp.uint32)
+    bits = _mix32(_mix32(base + rows) + cols)  # [bq, bk]
+    threshold = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
+    return bits >= threshold
+
+
 def _flash_kernel(
     kv_bound_ref,  # [B * nq] int32 scalar-prefetch: kv-block grid bound
-    q_pos_ref,  # [1, bq, 1] int32 (narrow-lane view)
-    kv_pos_ref,  # [1, 1, bk] int32 (narrow-sublane view)
-    q_ref,  # [1, 1, bq, d]
-    k_ref,  # [1, 1, bk, d] (int8 when quantized)
-    v_ref,  # [1, 1, bk, d] (int8 when quantized)
-    *rest,  # [k_scale_ref, v_scale_ref] when quantized; o_ref;
+    *args,  # [seed_ref] when dropout; q_pos/kv_pos/q/k/v refs;
+    #         [k_scale_ref, v_scale_ref] when quantized; o_ref;
     #         (lse_ref,) when with_lse; then m/l/acc scratch
     scale: float,
     with_lse: bool,
     quantized: bool = False,
+    dropout_rate: float = 0.0,
 ):
+    if dropout_rate > 0.0:
+        seed_ref, *args = args  # [1] uint32 scalar-prefetch
+    else:
+        seed_ref = None
+    q_pos_ref, kv_pos_ref, q_ref, k_ref, v_ref, *rest = args
+    # q_pos_ref: [1, bq, 1] int32 (narrow-lane view)
+    # kv_pos_ref: [1, 1, bk] int32 (narrow-sublane view)
+    # q_ref: [1, 1, bq, d]; k_ref/v_ref: [1, 1, bk, d] (int8 when quantized)
     if quantized:
         k_scale_ref, v_scale_ref, *rest = rest  # [1, 1, SUBLANES, bk] fp32
     else:
@@ -68,6 +120,9 @@ def _flash_kernel(
         (m_ref, l_ref, acc_ref), lse_ref = rest, None
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
+    # program_id must be read OUTSIDE pl.when bodies (no interpret-mode
+    # lowering inside the cond branch); the dropout hash closes over these.
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
@@ -127,13 +182,25 @@ def _flash_kernel(
         p = jnp.exp(s - m_new)  # [bq, bk]
 
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            # Probability dropout (training): the final output is
+            # acc / l, so zeroing entries of the acc-side p while keeping
+            # the denominator's p intact is EXACTLY inverted dropout
+            # applied to the post-softmax weights w = p / l — the xla
+            # path's semantics (ops.attention.sdpa), blockwise.
+            keep = _dropout_keep(
+                seed_ref[0], bi, hi, qi, ki, *p.shape, dropout_rate,
+            )
+            p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+        else:
+            p_acc = p
         if quantized:
             # v_scale folds into the (tiny) probabilities, mirroring
             # sdpa_cached's weights-level folding on the XLA path.
-            pv = (p * v_scale_ref[0, 0, :1, :]).astype(q.dtype)
+            pv = (p_acc * v_scale_ref[0, 0, :1, :]).astype(q.dtype)
             vb = v_ref[0, 0].astype(q.dtype)
         else:
-            pv = p.astype(v_ref.dtype)
+            pv = p_acc.astype(v_ref.dtype)
             vb = v_ref[0, 0]
         acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
             pv, vb, (((1,), (0,)), ((), ())),
@@ -171,7 +238,8 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("block_q", "block_k", "interpret", "dropout_rate"),
 )
 def flash_attention(
     q: jnp.ndarray,
@@ -182,6 +250,8 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 2048,
     interpret: Optional[bool] = None,
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Blockwise attention; drop-in for ``ops.attention.sdpa`` + bias.
 
@@ -200,12 +270,28 @@ def flash_attention(
         on a v5e with run-differenced timing: (512, 2048) measures 2.7x
         faster than (256, 512) at S=8k and 5x at S=16k (~79% of MXU peak,
         causally counted).
+      dropout_rate: attention-probability dropout (training; parity with
+        the reference's attn_pdrop, model.py:276-288, and with
+        ``ops.attention.sdpa``'s inverted-dropout semantics).  The mask is
+        generated *inside* the kernels from a counter-based hash — never
+        materialized at [T, S] — and the backward kernels rebuild the
+        identical mask, so gradients see exactly the forward's draw.
+      dropout_seed: [1] (or scalar) uint32 seed; required when
+        dropout_rate > 0.  Derive per call site, e.g. via jax.random.bits.
     Returns:
       [B, T, H, d] in q.dtype.
     """
     H, KVH = q.shape[2], k.shape[2]
     assert H % KVH == 0, (H, KVH)
     group = H // KVH
+    if dropout_rate > 0.0:
+        if not 0.0 < dropout_rate < 1.0:
+            raise ValueError(f"dropout_rate={dropout_rate} not in [0, 1)")
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        seed = dropout_seed.reshape((1,)).astype(jnp.uint32)
+    else:
+        seed = jnp.zeros((1,), jnp.uint32)
     if group > 1:
         # GQA query packing: fold the `group` query heads of each KV head
         # into the query-row axis, so the kernel grid runs over KV heads
@@ -213,17 +299,25 @@ def flash_attention(
         # once per query head (group x less KV-cache traffic — dominant in
         # long-context decode).  Masking is purely positional, so packing
         # is just a relayout: row r = g*T + t keeps position q_pos[t].
+        # Dropout keys off the PACKED row index, so each (head, query)
+        # pair still draws independently.
         B, T = q.shape[:2]
         qp = jnp.moveaxis(
             q.reshape(B, T, KVH, group, -1), 3, 1
         ).reshape(B, group * T, KVH, -1)
         pos_p = jnp.tile(q_pos, (1, group))
-        out = _flash(qp, k, v, pos_p, kv_pos, block_q, block_k, interpret)
+        out = _flash(
+            qp, k, v, pos_p, kv_pos, seed, block_q, block_k, interpret,
+            dropout_rate,
+        )
         out = jnp.moveaxis(
             out.reshape(B, group, T, KVH, -1), 1, 3
         ).reshape(B, T, H, -1)
         return out
-    return _flash(q, k, v, q_pos, kv_pos, block_q, block_k, interpret)
+    return _flash(
+        q, k, v, q_pos, kv_pos, seed, block_q, block_k, interpret,
+        dropout_rate,
+    )
 
 
 @functools.partial(
@@ -281,27 +375,35 @@ def flash_attention_quantized(
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, q_pos, kv_pos, block_q, block_k, interpret)
-
-
-def _flash_fwd(q, k, v, q_pos, kv_pos, block_q, block_k, interpret):
-    out, lse = _flash_forward(
-        q, k, v, q_pos, kv_pos, block_q, block_k, interpret, need_lse=True
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _flash(q, k, v, q_pos, kv_pos, seed, block_q, block_k, interpret,
+           dropout_rate=0.0):
+    return _flash_forward(
+        q, k, v, q_pos, kv_pos, block_q, block_k, interpret,
+        dropout_rate=dropout_rate, dropout_seed=seed,
     )
-    return out, (q, k, v, q_pos, kv_pos, out, lse)
 
 
-def _flash_bwd(block_q, block_k, interpret, res, g):
-    q, k, v, q_pos, kv_pos, out, lse = res
+def _flash_fwd(q, k, v, q_pos, kv_pos, seed, block_q, block_k, interpret,
+               dropout_rate=0.0):
+    out, lse = _flash_forward(
+        q, k, v, q_pos, kv_pos, block_q, block_k, interpret, need_lse=True,
+        dropout_rate=dropout_rate, dropout_seed=seed,
+    )
+    return out, (q, k, v, q_pos, kv_pos, seed, out, lse)
+
+
+def _flash_bwd(block_q, block_k, interpret, dropout_rate, res, g):
+    q, k, v, q_pos, kv_pos, seed, out, lse = res
     dq, dk, dv = _flash_backward(
-        q, k, v, q_pos, kv_pos, out, lse, g, block_q, block_k, interpret
+        q, k, v, q_pos, kv_pos, out, lse, g, block_q, block_k, interpret,
+        dropout_rate=dropout_rate, dropout_seed=seed,
     )
     # Integer primals take float0 cotangents.
     zq = np.zeros(q_pos.shape, jax.dtypes.float0)
     zk = np.zeros(kv_pos.shape, jax.dtypes.float0)
-    return dq, dk, dv, zq, zk
+    zs = np.zeros(seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, zq, zk, zs
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -333,13 +435,17 @@ def _clamp_blocks(T, S, block_q, block_k, interpret):
 
 def _flash_forward(
     q, k, v, q_pos, kv_pos, block_q, block_k, interpret, need_lse=False,
-    k_scale=None, v_scale=None,
+    k_scale=None, v_scale=None, dropout_rate=0.0, dropout_seed=None,
 ):
     B, T, H, d = q.shape
     S, KVH = k.shape[1], k.shape[2]
     assert H % KVH == 0, (H, KVH)
     group = H // KVH
     quantized = k_scale is not None
+    with_dropout = dropout_rate > 0.0
+    assert not (with_dropout and quantized), (
+        "dropout is training-only; the int8-KV path is inference-only"
+    )
     scale = 1.0 / (d ** 0.5)
     interpret = _resolve_interpret(interpret)
     block_q, block_k = _clamp_blocks(T, S, block_q, block_k, interpret)
@@ -383,10 +489,12 @@ def _flash_forward(
     )  # [B, nq], values in [0, nk]
     kv_bound_flat = kv_bound.reshape(B * nq)
 
+    # Index maps take trailing *_ so the same lambdas serve both prefetch
+    # layouts (kv_bound alone, or kv_bound + dropout seed).
     def _clamp_ki(b, qi, ki, bound):
         return jnp.minimum(ki, jnp.maximum(bound[b * nq + qi] - 1, 0))
 
-    def q_row(b, h, qi, ki, bound):
+    def q_row(b, h, qi, ki, bound, *_):
         return (b, h, qi, 0)
 
     out_shape = jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype)
@@ -403,22 +511,24 @@ def _flash_forward(
         )
     in_specs = [
         pl.BlockSpec(
-            (1, block_q, 1), lambda b, h, qi, ki, bound: (b, qi, 0)
+            (1, block_q, 1), lambda b, h, qi, ki, bound, *_: (b, qi, 0)
         ),
         pl.BlockSpec(
             (1, 1, block_k),
-            lambda b, h, qi, ki, bound: (b, 0, _clamp_ki(b, qi, ki, bound)),
+            lambda b, h, qi, ki, bound, *_: (
+                b, 0, _clamp_ki(b, qi, ki, bound)
+            ),
         ),
         pl.BlockSpec((1, 1, block_q, d), q_row),
         pl.BlockSpec(
             (1, 1, block_k, d),
-            lambda b, h, qi, ki, bound: (
+            lambda b, h, qi, ki, bound, *_: (
                 b, h // group, _clamp_ki(b, qi, ki, bound), 0
             ),
         ),
         pl.BlockSpec(
             (1, 1, block_k, d),
-            lambda b, h, qi, ki, bound: (
+            lambda b, h, qi, ki, bound, *_: (
                 b, h // group, _clamp_ki(b, qi, ki, bound), 0
             ),
         ),
@@ -433,19 +543,22 @@ def _flash_forward(
 
         scale_spec = pl.BlockSpec(
             (1, 1, 1, block_k),
-            lambda b, h, qi, ki, bound: (
+            lambda b, h, qi, ki, bound, *_: (
                 b, h // group, 0, _clamp_ki(b, qi, ki, bound)
             ),
         )
         in_specs += [scale_spec, scale_spec]
         operands += [_scale_plane(k_scale), _scale_plane(v_scale)]
+    prefetch = [kv_bound_flat]
+    if with_dropout:
+        prefetch.append(dropout_seed.reshape((1,)).astype(jnp.uint32))
     out = pl.pallas_call(
         functools.partial(
             _flash_kernel, scale=scale, with_lse=need_lse,
-            quantized=quantized,
+            quantized=quantized, dropout_rate=dropout_rate,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=len(prefetch),
             grid=grid,
             in_specs=in_specs,
             out_specs=out_spec,
@@ -464,7 +577,7 @@ def _flash_forward(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(kv_bound_flat, *operands)
+    )(*prefetch, *operands)
     if need_lse:
         out, lse = out
         return jnp.swapaxes(out[:, :, :T, :], 1, 2), lse
@@ -492,12 +605,17 @@ def _flash_forward(
 
 
 def _flash_dq_kernel(
-    q_pos_ref, kv_pos_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-    dq_ref, dq_acc, *, scale: float,
+    *args, scale: float, dropout_rate: float = 0.0,
 ):
-    # lse_ref/delta_ref are narrow-lane [1, 1, bq, 1] rows.
+    # With dropout a [1] uint32 seed_ref leads; lse_ref/delta_ref are
+    # narrow-lane [1, 1, bq, 1] rows.
+    if dropout_rate > 0.0:
+        seed_ref, *args = args
+    (q_pos_ref, kv_pos_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+     dq_ref, dq_acc) = args
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
@@ -521,6 +639,17 @@ def _flash_dq_kernel(
             gb, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if dropout_rate > 0.0:
+            # Forward: out = (D ∘ w) V with w = softmax(s), D the inverted-
+            # dropout mask.  Chain rule gives dw = D ∘ dp, and the softmax
+            # Jacobian's weighted sum Σ_k w_k (D_k dp_k) is exactly
+            # rowsum(dO ∘ O) — the SAME delta as the no-dropout case — so
+            # only dp needs masking.  The mask is rebuilt bit-identically
+            # from the tile's grid coordinates (same hash as the forward).
+            keep = _dropout_keep(
+                seed_ref[0], bi, hi, qi, ki, *p.shape, dropout_rate,
+            )
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
         ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
@@ -533,11 +662,15 @@ def _flash_dq_kernel(
 
 
 def _flash_dkv_kernel(
-    q_pos_ref, kv_pos_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+    *args, scale: float, dropout_rate: float = 0.0,
 ):
+    if dropout_rate > 0.0:
+        seed_ref, *args = args
+    (q_pos_ref, kv_pos_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+     dk_ref, dv_ref, dk_acc, dv_acc) = args
     qi = pl.program_id(3)
     nq = pl.num_programs(3)
+    bi, hi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
     def _init():
@@ -558,15 +691,27 @@ def _flash_dkv_kernel(
         ) * scale  # [bq, bk]
         allowed = (kp <= qp) & (kp >= 0)
         p = jnp.where(allowed, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
-        # dV_j += P_ijᵀ dO_i: contract the q-row axis.
+        if dropout_rate > 0.0:
+            # Same tile coordinates as the forward/dQ kernels — NOTE the
+            # grid here is (B, H, nk, nq), so qi/ki swap program ids.
+            keep = _dropout_keep(
+                seed_ref[0], bi, hi, qi, ki, *p.shape, dropout_rate,
+            )
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_v = jnp.where(keep, p, 0.0) * inv  # dV sees dropped weights
+            dp_mask = lambda dp: jnp.where(keep, dp, 0.0) * inv
+        else:
+            p_v = p
+            dp_mask = lambda dp: dp
+        # dV_j += (D ∘ P)_ijᵀ dO_i: contract the q-row axis.
         dv_acc[:] += jax.lax.dot_general(
-            p.astype(gb.dtype), gb, (((0,), (0,)), ((), ())),
+            p_v.astype(gb.dtype), gb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
+        dp = dp_mask(jax.lax.dot_general(
             gb, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
+        ))
         ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
@@ -580,7 +725,8 @@ def _flash_dkv_kernel(
 
 
 def _flash_backward(
-    q, k, v, q_pos, kv_pos, out, lse, g, block_q, block_k, interpret
+    q, k, v, q_pos, kv_pos, out, lse, g, block_q, block_k, interpret,
+    dropout_rate=0.0, dropout_seed=None,
 ):
     """Blockwise VJP.  Memory is O(S·d) per head (plus narrow-lane
     lse/Δ rows) — replacing the r1 dense-recompute fallback whose backward
@@ -591,6 +737,11 @@ def _flash_backward(
     scale = 1.0 / (d ** 0.5)
     interpret = _resolve_interpret(interpret)
     block_q, block_k = _clamp_blocks(T, S, block_q, block_k, interpret)
+    with_dropout = dropout_rate > 0.0
+    seed_ops = (
+        (dropout_seed.reshape((1,)).astype(jnp.uint32),)
+        if with_dropout else ()
+    )
 
     # Δ = rowsum(dO ∘ O): tiny elementwise pass outside the kernels.
     delta = jnp.sum(
@@ -612,51 +763,76 @@ def _flash_backward(
     # lse comes from the forward already padded, narrow-lane [B, H, Tp, 1].
 
     pos_specs = [
-        pl.BlockSpec((1, block_q, 1), lambda b, h, qi, ki: (b, qi, 0)),
-        pl.BlockSpec((1, 1, block_k), lambda b, h, qi, ki: (b, 0, ki)),
+        pl.BlockSpec((1, block_q, 1), lambda b, h, qi, ki, *_: (b, qi, 0)),
+        pl.BlockSpec((1, 1, block_k), lambda b, h, qi, ki, *_: (b, 0, ki)),
     ]
     q_row_specs = [
-        pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h, qi, ki, *_: (b, h, qi, 0)
+        ),
     ]
     kv_specs = [
-        pl.BlockSpec((1, 1, block_k, d), lambda b, h, qi, ki: (b, h, ki, 0)),
-        pl.BlockSpec((1, 1, block_k, d), lambda b, h, qi, ki: (b, h, ki, 0)),
+        pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h, qi, ki, *_: (b, h, ki, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h, qi, ki, *_: (b, h, ki, 0)
+        ),
     ]
     row_aux_specs = [
         pl.BlockSpec(
-            (1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)
+            (1, 1, block_q, 1), lambda b, h, qi, ki, *_: (b, h, qi, 0)
         ),
         pl.BlockSpec(
-            (1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)
+            (1, 1, block_q, 1), lambda b, h, qi, ki, *_: (b, h, qi, 0)
         ),
     ]
 
-    dq = pl.pallas_call(
-        functools.partial(_flash_dq_kernel, scale=scale),
-        grid=(B, H, nq, nk),
-        in_specs=pos_specs + q_row_specs + kv_specs + q_row_specs
-        + row_aux_specs,
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)
+    def _call(kernel, grid, in_specs, out_specs, out_shape, scratch_shapes):
+        # Dropout threads the [1] uint32 seed as a scalar-prefetch operand
+        # (the mask hash needs it before tile compute); the no-dropout
+        # trace is unchanged.
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=len(seed_ops),
+                grid=grid,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                scratch_shapes=scratch_shapes,
+            ),
+            out_shape=out_shape,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=(
+                    "parallel", "parallel", "parallel", "arbitrary"
+                ),
+            ),
+            interpret=interpret,
+        )
+
+    dq = _call(
+        functools.partial(
+            _flash_dq_kernel, scale=scale, dropout_rate=dropout_rate
         ),
-        out_shape=jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        (B, H, nq, nk),
+        pos_specs + q_row_specs + kv_specs + q_row_specs + row_aux_specs,
+        pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h, qi, ki, *_: (b, h, qi, 0)
         ),
-        interpret=interpret,
-    )(q_pos_r, kv_pos_r, qt, kt, vt, gt, lse, delta_r)
+        jax.ShapeDtypeStruct((B, H, Tp, d), q.dtype),
+        [pltpu.VMEM((block_q, d), jnp.float32)],
+    )(*seed_ops, q_pos_r, kv_pos_r, qt, kt, vt, gt, lse, delta_r)
 
     # dK/dV kernel: kv blocks third, q sweep innermost.
-    def qrow(b, h, ki, qi):
+    def qrow(b, h, ki, qi, *_):
         return (b, h, qi, 0)
 
-    def kvrow(b, h, ki, qi):
+    def kvrow(b, h, ki, qi, *_):
         return (b, h, ki, 0)
 
     dkv_specs = [
-        pl.BlockSpec((1, block_q, 1), lambda b, h, ki, qi: (b, qi, 0)),
-        pl.BlockSpec((1, 1, block_k), lambda b, h, ki, qi: (b, 0, ki)),
+        pl.BlockSpec((1, block_q, 1), lambda b, h, ki, qi, *_: (b, qi, 0)),
+        pl.BlockSpec((1, 1, block_k), lambda b, h, ki, qi, *_: (b, 0, ki)),
         pl.BlockSpec((1, 1, block_q, d), qrow),
         pl.BlockSpec((1, 1, block_k, d), kvrow),
         pl.BlockSpec((1, 1, block_k, d), kvrow),
@@ -664,27 +840,25 @@ def _flash_backward(
         pl.BlockSpec((1, 1, block_q, 1), qrow),
         pl.BlockSpec((1, 1, block_q, 1), qrow),
     ]
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_dkv_kernel, scale=scale),
-        grid=(B, H, nk, nq),
-        in_specs=dkv_specs,
-        out_specs=(
+    dk, dv = _call(
+        functools.partial(
+            _flash_dkv_kernel, scale=scale, dropout_rate=dropout_rate
+        ),
+        (B, H, nk, nq),
+        dkv_specs,
+        (
             pl.BlockSpec((1, 1, block_k, d), kvrow),
             pl.BlockSpec((1, 1, block_k, d), kvrow),
         ),
-        out_shape=(
+        (
             jax.ShapeDtypeStruct((B, H, Sp, d), k.dtype),
             jax.ShapeDtypeStruct((B, H, Sp, d), v.dtype),
         ),
-        scratch_shapes=[
+        [
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(q_pos_r, kv_pos_r, qt, kt, vt, gt, lse, delta_r)
+    )(*seed_ops, q_pos_r, kv_pos_r, qt, kt, vt, gt, lse, delta_r)
 
     dq = jnp.swapaxes(dq[:, :, :T, :], 1, 2)
     dk = jnp.swapaxes(dk[:, :, :S, :], 1, 2)

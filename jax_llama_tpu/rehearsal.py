@@ -37,17 +37,10 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import contextlib
 import tempfile
 import time
 from pathlib import Path
-
-
-import contextlib
-
-
-def _nullcontext():
-    return contextlib.nullcontext()
-
 
 def _log(msg: str) -> None:
     print(f"[rehearsal +{time.perf_counter() - _T0:7.1f}s] {msg}", flush=True)
@@ -208,7 +201,11 @@ def _pipeline(ckpt_dir: str, out_dir: str, tokenizer, vocab_size, dtype,
     except RuntimeError:
         cpu = None
     host_params = jax.device_get(restored)
-    with jax.default_device(cpu) if cpu is not None else _nullcontext():
+    ctx = (
+        jax.default_device(cpu) if cpu is not None
+        else contextlib.nullcontext()
+    )
+    with ctx:
         mine = np.asarray(
             jax.jit(
                 lambda p, t, q: model_forward(p, t, q, fp32_cfg)[0]

@@ -295,3 +295,206 @@ def test_flash_backward_no_quadratic_memory_32k():
             for sub in jax.core.jaxprs_in_params(eqn.params):
                 walk(sub)
     walk(jaxpr.jaxpr)
+
+
+# ---------------------------------------------------------------------------
+# In-kernel attention-probability dropout (attn_pdrop on the flash path).
+#
+# There is no PRNG-bit parity to check against the xla path (different
+# generators by design), so the tests pin down the *semantics*: the realized
+# mask is Bernoulli with the right rate, scaled by 1/(1-rate), identical
+# across tilings and calls, and the backward kernels reproduce the exact
+# forward draw (gradient parity vs a dense model built from the EXTRACTED
+# mask — any fwd/bwd mask drift would show up at O(1), not 1e-4).
+# ---------------------------------------------------------------------------
+
+
+def _extract_dropout_weights(q, k, q_pos, kv_pos, rate, seed, bq, bk):
+    """Run the kernel with v = identity basis so row i of the output IS the
+    post-dropout weight row u_i = D_i * softmax(s)_i (needs d >= S)."""
+    B, T, H, d = q.shape
+    S = k.shape[1]
+    assert d >= S and H == k.shape[2]
+    v = jnp.zeros((B, S, H, d), jnp.float32)
+    eye = jnp.arange(S)
+    for b in range(B):
+        for h in range(H):
+            v = v.at[b, eye, h, eye].set(1.0)
+    out = flash_attention(
+        jnp.asarray(q), jnp.asarray(k), v, jnp.asarray(q_pos),
+        jnp.asarray(kv_pos), block_q=bq, block_k=bk,
+        dropout_rate=rate, dropout_seed=seed,
+    )
+    return np.asarray(out[..., :S])  # [B, T, H, S] realized u
+
+
+def _dense_weights(q, k, q_pos, kv_pos):
+    import jax
+
+    s = jnp.einsum("bthd,bshd->bths", jnp.asarray(q), jnp.asarray(k))
+    s = s / np.sqrt(q.shape[-1])
+    allowed = (
+        (jnp.asarray(kv_pos)[:, None, None, :]
+         <= jnp.asarray(q_pos)[:, :, None, None])
+        & (jnp.asarray(kv_pos) >= 0)[:, None, None, :]
+    )
+    s = jnp.where(allowed, s, -1e30)
+    return np.asarray(jax.nn.softmax(s, axis=-1)), np.asarray(allowed)
+
+
+def test_flash_dropout_mask_is_inverted_bernoulli():
+    import jax
+
+    B, T, S, H, d = 1, 64, 64, 2, 64
+    rng = np.random.RandomState(3)
+    q = rng.randn(B, T, H, d).astype(np.float32) * 0.2
+    k = rng.randn(B, S, H, d).astype(np.float32) * 0.2
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    rate = 0.25
+    seed = jnp.asarray([77], jnp.uint32)
+    u = _extract_dropout_weights(q, k, pos, pos, rate, seed, 16, 16)
+    w, allowed = _dense_weights(q, k, pos, pos)
+    resolvable = allowed & (w > 1e-3)
+    D = u[resolvable] / w[resolvable]
+    keep_val = 1.0 / (1.0 - rate)
+    is_kept = np.abs(D - keep_val) < 1e-2
+    is_dropped = np.abs(D) < 1e-2
+    assert np.all(is_kept | is_dropped)  # binary inverted-dropout values
+    frac = is_dropped.mean()
+    assert abs(frac - rate) < 0.05, frac  # ~Bernoulli(rate)
+    # Tile-size invariance: the mask hashes GLOBAL (row, col) indices, so
+    # retiling must not change the draw.
+    u2 = _extract_dropout_weights(q, k, pos, pos, rate, seed, 32, 64)
+    np.testing.assert_allclose(u, u2, atol=1e-5)
+    # Seed sensitivity + per-head independence.
+    u3 = _extract_dropout_weights(
+        q, k, pos, pos, rate, jnp.asarray([78], jnp.uint32), 16, 16
+    )
+    assert np.abs(u - u3).max() > 0.1
+    D_full = np.where(w > 1e-3, u / np.maximum(w, 1e-30), 0.0)
+    assert np.abs(D_full[0, :, 0] - D_full[0, :, 1]).max() > 0.1
+
+
+def test_flash_dropout_rate0_and_seed_requirements():
+    B, T, H, D = 1, 16, 2, 32
+    q, k, v = _rand(B, T, T, H, H, D)
+    pos = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+    base = flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(pos), jnp.asarray(pos), block_q=8, block_k=8,
+    )
+    with_seed = flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(pos), jnp.asarray(pos), block_q=8, block_k=8,
+        dropout_rate=0.0, dropout_seed=jnp.asarray([5], jnp.uint32),
+    )
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(with_seed))
+    with pytest.raises(ValueError, match="dropout_seed"):
+        flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(pos), jnp.asarray(pos), dropout_rate=0.5,
+        )
+
+
+def test_flash_dropout_backward_matches_dense_with_extracted_mask():
+    """Gradient parity for q/k/v against a dense attention whose dropout
+    matrix is the mask EXTRACTED from the kernel forward: proves all three
+    kernels (fwd, dQ, dK/dV) regenerate the same draw, including under GQA
+    query packing and left-padding."""
+    import jax
+
+    B, T, S, H, KVH, d = 2, 40, 40, 4, 2, 64
+    rng = np.random.RandomState(5)
+    q = rng.randn(B, T, H, d).astype(np.float32) * 0.2
+    k = rng.randn(B, S, KVH, d).astype(np.float32) * 0.2
+    v = rng.randn(B, S, KVH, d).astype(np.float32) * 0.2
+    pos = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+    pos[1, :7] = -1
+    pos[1, 7:] = np.arange(T - 7)
+    qp = np.maximum(pos, 0)
+    rate, seed = 0.3, jnp.asarray([123], jnp.uint32)
+    g = rng.randn(B, T, H, d).astype(np.float32)
+    g[1, :7] = 0.0
+
+    # Extract the realized per-(b, kv-head, packed-row, col) mask by
+    # running the PACKED single-group geometry the kernel actually uses.
+    group = H // KVH
+    q_packed = np.moveaxis(
+        q.reshape(B, T, KVH, group, d), 3, 1
+    ).reshape(B, group * T, KVH, d)
+    qp_packed = np.tile(qp, (1, group))
+    u = _extract_dropout_weights(
+        q_packed, k, qp_packed, pos, rate, seed, 16, 16
+    )  # [B, group*T, KVH, S]
+    w, allowed = _dense_weights(q_packed, k, qp_packed, pos)
+    keep_val = 1.0 / (1.0 - rate)
+    D = np.where(
+        allowed & (w > 1e-4),
+        np.rint(u / np.maximum(w, 1e-30) / keep_val) * keep_val,
+        # Unresolvable (w ~ 0) entries contribute ~nothing to outputs or
+        # grads either way; call them kept.
+        keep_val,
+    ).astype(np.float32)
+    D = jnp.asarray(D)  # [B, group*T, KVH, S] packed-row dropout matrix
+
+    def dense_fn(q, k, v):
+        qp_j = jnp.moveaxis(
+            q.reshape(B, T, KVH, group, d), 3, 1
+        ).reshape(B, group * T, KVH, d)
+        s = jnp.einsum("bthd,bshd->bths", qp_j, k) / np.sqrt(d)
+        s = jnp.where(
+            (jnp.asarray(pos)[:, None, None, :]
+             <= jnp.asarray(qp_packed)[:, :, None, None])
+            & (jnp.asarray(pos) >= 0)[:, None, None, :],
+            s, -1e30,
+        )
+        ww = jax.nn.softmax(s, axis=-1) * D
+        o = jnp.einsum("bths,bshd->bthd", ww, v)
+        return jnp.moveaxis(
+            o.reshape(B, group, T, KVH, d), 1, 3
+        ).reshape(B, T, H, d)
+
+    def flash_fn(q, k, v):
+        return flash_attention(
+            jnp.asarray(q), k, v, jnp.asarray(qp), jnp.asarray(pos),
+            block_q=16, block_k=16, dropout_rate=rate, dropout_seed=seed,
+        )
+
+    fout, fvjp = jax.vjp(flash_fn, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    dout, dvjp = jax.vjp(dense_fn, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(
+        np.asarray(fout)[0], np.asarray(dout)[0], atol=1e-4, rtol=1e-3
+    )
+    for f, dref, name in zip(fvjp(jnp.asarray(g)), dvjp(jnp.asarray(g)),
+                             ("dq", "dk", "dv")):
+        f, dref = np.asarray(f), np.asarray(dref)
+        denom = max(np.abs(dref).max(), 1e-6)
+        assert np.abs(f - dref).max() / denom < 2e-3, name
+
+
+def test_flash_dropout_no_quadratic_memory_32k():
+    """Dropout must not break the O(S*d) guarantee: the mask lives only as
+    [block_q, block_k] tiles inside the kernels."""
+    import jax
+
+    B, S, H, D = 1, 32768, 1, 64
+
+    def loss(q, k, v):
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        return flash_attention(
+            q, k, v, pos, pos, dropout_rate=0.1,
+            dropout_seed=jnp.asarray([9], jnp.uint32),
+        ).sum()
+
+    sds = jax.ShapeDtypeStruct((B, S, H, D), jnp.float32)
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(sds, sds, sds)
+
+    limit = S * 1024
+    def walk(jpr):
+        for eqn in jpr.eqns:
+            for var in eqn.outvars:
+                size = int(np.prod(var.aval.shape)) if var.aval.shape else 1
+                assert size <= limit, (eqn.primitive.name, var.aval.shape)
+            for sub in jax.core.jaxprs_in_params(eqn.params):
+                walk(sub)
+    walk(jaxpr.jaxpr)
