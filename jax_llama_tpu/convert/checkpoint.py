@@ -21,6 +21,7 @@ from pathlib import Path
 from typing import Any, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding
 
@@ -94,44 +95,100 @@ def load_checkpoint(
     else:
         abstract = shapes
     ckptr = ocp.StandardCheckpointer()
-    if _saved_layout_is_old(ckptr, path / "params"):
+    layout = _saved_layout(ckptr, path / "params", config)
+    if layout != "current":
         params = _restore_old_layout(
-            ckptr, path, config, quantized, mesh, fsdp
+            ckptr, path, config, quantized, mesh, fsdp, layout
         )
     else:
-        # New layout (or metadata unavailable): restore directly, letting
-        # any real failure (truncated files, version mismatch, OOM)
-        # propagate as itself — a restore error must never be
+        # Current layout (or metadata unavailable): restore directly,
+        # letting any real failure (truncated files, version mismatch,
+        # OOM) propagate as itself — a restore error must never be
         # mis-diagnosed as "old layout".
         params = ckptr.restore(path / "params", abstract)
     return params, config
 
 
-def _saved_layout_is_old(ckptr, item_path: Path) -> bool:
-    """Whether the saved params tree predates the fused qkv/gate_up layout,
-    decided from the checkpoint's own tree metadata (cheap — no array
-    reads).  Unreadable metadata counts as new-layout."""
+def _saved_layout(ckptr, item_path: Path, config: LLaMAConfig) -> str:
+    """Which param layout the checkpoint was saved in, decided from its
+    own tree metadata (cheap — no array reads): "separate" (rounds 1-2
+    q/k/v/gate/up), "d_first" (the r3 fused layout with the contracted D
+    axis leading), or "current".  Unreadable metadata counts as current.
+    """
     try:
         tree = ckptr.metadata(item_path).item_metadata.tree
         layers = tree.get("layers", {})
+        if "q" in layers and "qkv" not in layers:
+            return "separate"
+        qkv_md = layers["qkv"]
+        if isinstance(qkv_md, dict):  # QuantizedTensor: {q, scale} subtree
+            qkv_md = qkv_md["q"]
+        qkv_shape = tuple(qkv_md.shape)
     except Exception:
-        return False
-    return "q" in layers and "qkv" not in layers
+        return "current"
+    if len(qkv_shape) == 5 and qkv_shape[1] == config.dim:
+        return "d_first"
+    return "current"
 
 
-def _old_layout_shapes(config: LLaMAConfig) -> Any:
-    """Abstract param tree in the pre-fused layout (separate q/k/v and
-    gate/up — rounds 1-2 checkpoints)."""
+def _to_d_first(lp: dict) -> dict:
+    """Permute a current-layout layers dict to the r3 D-first layout.
+    QuantizedTensor leaves permute payload AND scale identically (the
+    scale has size-1 contracted dims in the same positions), so the
+    transform is exact for quantized trees too."""
+    from ..ops.quant import QuantizedTensor
+
+    def mv(x, src, dst):
+        if isinstance(x, QuantizedTensor):
+            return QuantizedTensor(
+                q=jnp.moveaxis(x.q, src, dst),
+                scale=jnp.moveaxis(x.scale, src, dst),
+            )
+        return jnp.moveaxis(x, src, dst)
+
+    lp = dict(lp)
+    lp["qkv"] = mv(lp["qkv"], -2, -4)
+    lp["gate_up"] = mv(lp["gate_up"], -2, -3)
+    return lp
+
+
+def _from_d_first(lp: dict) -> dict:
+    """Inverse of ``_to_d_first`` (the load-time migration)."""
+    from ..ops.quant import QuantizedTensor
+
+    def mv(x, src, dst):
+        if isinstance(x, QuantizedTensor):
+            return QuantizedTensor(
+                q=jnp.moveaxis(x.q, src, dst),
+                scale=jnp.moveaxis(x.scale, src, dst),
+            )
+        return jnp.moveaxis(x, src, dst)
+
+    lp = dict(lp)
+    lp["qkv"] = mv(lp["qkv"], -4, -2)
+    lp["gate_up"] = mv(lp["gate_up"], -3, -2)
+    return lp
+
+
+def _old_layout_shapes(config: LLaMAConfig, layout: str, quantized: bool) -> Any:
+    """Abstract param tree in a historical layout: "separate" (rounds 1-2
+    q/k/v + gate/up) or "d_first" (r3 fused, D leading)."""
     from ..models.llama import split_qkv
+    from ..ops.quant import quantize_params
 
     def build():
         params = init_params(jax.random.PRNGKey(0), config)
+        if quantized:
+            params = quantize_params(params)
         lp = dict(params["layers"])
-        q, k, v = split_qkv(lp.pop("qkv"))
-        gate_up = lp.pop("gate_up")
-        lp.update(
-            q=q, k=k, v=v, gate=gate_up[:, :, 0], up=gate_up[:, :, 1]
-        )
+        if layout == "d_first":
+            lp = _to_d_first(lp)
+        else:
+            q, k, v = split_qkv(lp.pop("qkv"))
+            gate_up = lp.pop("gate_up")
+            lp.update(
+                q=q, k=k, v=v, gate=gate_up[:, 0], up=gate_up[:, 1]
+            )
         out = dict(params)
         out["layers"] = lp
         return out
@@ -139,23 +196,33 @@ def _old_layout_shapes(config: LLaMAConfig) -> Any:
     return jax.eval_shape(build)
 
 
-def _restore_old_layout(ckptr, path, config, quantized, mesh, fsdp):
-    """Fallback for checkpoints saved before the fused qkv/gate_up layout:
-    restore the old tree on host, migrate with ``fuse_params``, then shard
-    onto the mesh if one was given.  Quantized old checkpoints cannot be
-    migrated (int8 scales do not concatenate) — re-quantize from the
-    full-precision source instead."""
+def _restore_old_layout(ckptr, path, config, quantized, mesh, fsdp, layout):
+    """Fallback for checkpoints saved in a historical layout: restore the
+    old tree on host, migrate, then shard onto the mesh if one was given.
+
+    The d_first→current migration is a pure axis permutation, exact for
+    full-precision AND int8 trees (payload and scale permute together).
+    Quantized SEPARATE-layout checkpoints (rounds 1-2) are refused:
+    fusing them needs a quantized fuse_qkv (feature permutation + slot
+    concat on payload and scales) that is not implemented — re-quantize
+    from the full-precision source instead."""
     from ..models.llama import fuse_params
 
-    if quantized:
+    if quantized and layout != "d_first":
         raise ValueError(
-            f"{path} is an int8-quantized checkpoint in the old (separate "
-            "q/k/v) layout; per-channel scales cannot be fused — "
-            "re-quantize from the full-precision checkpoint with "
-            "quantize_params and save again"
+            f"{path} is an int8-quantized checkpoint in the old separate "
+            "q/k/v layout; migrating it is not implemented — re-quantize "
+            "from the full-precision checkpoint with quantize_params and "
+            "save again"
         )
-    old = ckptr.restore(path / "params", _old_layout_shapes(config))
-    params = fuse_params(old)
+    old = ckptr.restore(
+        path / "params", _old_layout_shapes(config, layout, quantized)
+    )
+    if layout == "d_first":
+        params = dict(old)
+        params["layers"] = _from_d_first(old["layers"])
+    else:
+        params = fuse_params(old)
     if mesh is not None:
         from ..parallel.partition import shard_params
 

@@ -179,20 +179,26 @@ def convert_meta_checkpoint(
         layer_acc["attn_norm"].append(
             _take(shards, pre + "attention_norm.weight", None).astype(od)
         )
-        # Fused decode layout: per KV head, slots [q_0..q_{G-1}, k, v]
-        # (models.llama.fuse_qkv's contract; query head h = kvh*G + g is
-        # Meta's own head order, so no HEAD permutation happens — but the
-        # q/k head_dim FEATURES are permuted to the runtime half-split
-        # RoPE order, see ops.rope / models.llama.rope_permute).
-        q_i = rope_permute(
-            col(pre + "attention.wq.weight").reshape(D, H, hd)
-        ).reshape(D, KVH, G, hd)
+        # Fused decode layout [KVH, G+2, D, hd]: per KV head, slots
+        # [q_0..q_{G-1}, k, v] (models.llama.fuse_qkv's contract; query
+        # head h = kvh*G + g is Meta's own head order, so no HEAD
+        # permutation happens — but the q/k head_dim FEATURES are permuted
+        # to the runtime half-split RoPE order, see ops.rope /
+        # models.llama.rope_permute; D second-from-last is the scan-slice
+        # layout contract, models.llama module docstring).
+        q_i = np.moveaxis(
+            rope_permute(
+                col(pre + "attention.wq.weight").reshape(D, H, hd)
+            ).reshape(D, KVH, G, hd), 0, 2,
+        )  # [KVH, G, D, hd]
         k_i = rope_permute(
             col(pre + "attention.wk.weight").reshape(D, KVH, hd)
-        ).reshape(D, KVH, 1, hd)
-        v_i = col(pre + "attention.wv.weight").reshape(D, KVH, 1, hd)
+        ).transpose(1, 0, 2)[:, None]  # [KVH, 1, D, hd]
+        v_i = col(
+            pre + "attention.wv.weight"
+        ).reshape(D, KVH, hd).transpose(1, 0, 2)[:, None]
         layer_acc["qkv"].append(
-            np.concatenate([q_i, k_i, v_i], axis=2).astype(od)
+            np.concatenate([q_i, k_i, v_i], axis=1).astype(od)
         )
         layer_acc["o"].append(
             row(pre + "attention.wo.weight").reshape(H, hd, D).astype(od)
@@ -203,8 +209,8 @@ def convert_meta_checkpoint(
         layer_acc["gate_up"].append(
             np.stack(
                 [col(pre + "feed_forward.w1.weight"),
-                 col(pre + "feed_forward.w3.weight")], axis=1
-            ).astype(od)
+                 col(pre + "feed_forward.w3.weight")], axis=0
+            ).astype(od)  # [2, D, F]
         )
         layer_acc["down"].append(row(pre + "feed_forward.w2.weight").astype(od))
 

@@ -27,10 +27,10 @@ Param tree layout (all layers stacked on a leading L axis):
 
     {"embed":  {"embedding": [V, D]},
      "layers": {"attn_norm": [L, D],
-                "qkv": [L, D, KVH, G+2, hd],   # G = H // KVH (GQA group)
+                "qkv": [L, KVH, G+2, D, hd],   # G = H // KVH (GQA group)
                 "o": [L, H, hd, D],
                 "mlp_norm": [L, D],
-                "gate_up": [L, D, 2, F], "down": [L, F, D]},
+                "gate_up": [L, 2, D, F], "down": [L, F, D]},
      "final_norm": [D],
      "lm_head": [D, V]}            # absent when tie_word_embeddings
 
@@ -42,11 +42,21 @@ single long burst (xplane-measured: the three separate projections ran
 at ~80% of the bandwidth roofline vs ~90%+ for the large MLP matmuls —
 the reference also runs them separately,
 ``/root/reference/jax_llama/model.py:210-214``).  Slot layout along
-axis 3 of ``qkv``: [q_0..q_{G-1}, k, v] per KV head, so the merged
-query-head order is h = kvh*G + g — identical to the GQA packing
+the G+2 axis of ``qkv``: [q_0..q_{G-1}, k, v] per KV head, so the
+merged query-head order is h = kvh*G + g — identical to the GQA packing
 contract the flash/paged kernels already use, and tensor-parallelism
 shards the KVH axis exactly like the separate layout did.
-``fuse_params`` migrates an old-layout (separate q/k/v/gate/up) tree.
+
+Axis ORDER within the fused weights is chosen for the layer scan, not
+for reading aloud: ``qkv`` stores [KVH, G+2, D, hd] and ``gate_up``
+[2, D, F] — the contracted D axis SECOND-from-last — because that is
+the operand layout XLA:TPU assigns the decode matmuls.  With D leading
+(the r3 layout) each ``lax.scan`` iteration's dynamic-slice of the
+stacked weight relayouted into the matmul's layout: an xplane-profiled
+~175us/step of pure weight-copy traffic (two kLoop relayout fusions per
+layer step); with matching axis order the slice is a free view
+(A/B-measured on chip, see ROADMAP).  ``fuse_params`` migrates both the
+separate-q/k/v layout and the r3 D-first fused layout.
 """
 
 from __future__ import annotations
@@ -281,10 +291,10 @@ def init_params(rng: jax.Array, config: LLaMAConfig) -> Params:
         },
         "layers": {
             "attn_norm": jnp.ones((L, D), dtype=wd),
-            "qkv": stacked(keys[1], (D, KVH, G + 2, hd), D),
+            "qkv": stacked(keys[1], (KVH, G + 2, D, hd), D),
             "o": stacked(keys[4], (H, hd, D), D),
             "mlp_norm": jnp.ones((L, D), dtype=wd),
-            "gate_up": stacked(keys[5], (D, 2, F), D),
+            "gate_up": stacked(keys[5], (2, D, F), D),
             "down": stacked(keys[7], (F, D), F),
         },
         "final_norm": jnp.ones((D,), dtype=wd),
@@ -314,46 +324,62 @@ def fuse_qkv(
     v: jnp.ndarray,  # [L, D, KVH, hd]
 ) -> jnp.ndarray:
     """Pack separate q/k/v projection weights (Meta interleaved-RoPE
-    feature order) into the fused [..., D, KVH, G+2, hd] runtime layout:
+    feature order) into the fused [..., KVH, G+2, D, hd] runtime layout:
     slots [q_0..q_{G-1}, k, v] per KV head (query head order h = kvh*G +
     g, the kernels' GQA contract), with q/k head_dim features permuted to
     the half-split RoPE order (``rope_permute``; v is not rotated and
-    keeps Meta order)."""
+    keeps Meta order).  D sits second-from-last (see module docstring:
+    the scan-slice layout contract)."""
     *lead, D, H, hd = q.shape
     KVH = k.shape[-2]
     G = H // KVH
-    qg = rope_permute(q).reshape(*lead, D, KVH, G, hd)
-    return jnp.concatenate(
-        [qg, rope_permute(k)[..., :, :, None, :], v[..., :, :, None, :]],
-        axis=-2,
-    )
+    qg = jnp.moveaxis(
+        rope_permute(q).reshape(*lead, D, KVH, G, hd), -4, -2
+    )  # [..., KVH, G, D, hd]
+    kk = jnp.swapaxes(rope_permute(k), -3, -2)[..., :, None, :, :]
+    vv = jnp.swapaxes(v, -3, -2)[..., :, None, :, :]
+    return jnp.concatenate([qg, kk, vv], axis=-3)
 
 
 def split_qkv(
-    qkv: jnp.ndarray,  # [..., D, KVH, G+2, hd]
+    qkv: jnp.ndarray,  # [..., KVH, G+2, D, hd]
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Inverse of ``fuse_qkv``: (q [..., D, H, hd], k, v [..., D, KVH, hd])
     in Meta interleaved-RoPE feature order."""
-    *lead, D, KVH, g2, hd = qkv.shape
+    *lead, KVH, g2, D, hd = qkv.shape
     G = g2 - 2
-    q = qkv[..., :G, :].reshape(*lead, D, KVH * G, hd)
+    q = jnp.moveaxis(qkv[..., :G, :, :], -2, -4).reshape(
+        *lead, D, KVH * G, hd
+    )
     return (
         rope_permute(q, inverse=True),
-        rope_permute(qkv[..., G, :], inverse=True),
-        qkv[..., G + 1, :],
+        rope_permute(jnp.swapaxes(qkv[..., G, :, :], -3, -2), inverse=True),
+        jnp.swapaxes(qkv[..., G + 1, :, :], -3, -2),
     )
 
 
 def fuse_params(params: Params) -> Params:
-    """Migrate an old-layout param tree (separate q/k/v + gate/up, rounds
-    1-2 and older Orbax checkpoints) to the fused layout.  No-op when the
-    tree is already fused.  Quantized trees must be re-quantized from the
-    full-precision source instead (scales do not concatenate)."""
+    """Migrate an old-layout param tree to the current fused layout:
+    either separate q/k/v + gate/up (rounds 1-2 Orbax checkpoints) or the
+    r3 D-first fused layout (qkv [L, D, KVH, G+2, hd], gate_up
+    [L, D, 2, F]).  No-op when already current.  Quantized trees must be
+    re-quantized from the full-precision source instead (scales do not
+    concatenate)."""
     lp = dict(params["layers"])
     if "qkv" in lp:
+        d_model = lp["attn_norm"].shape[-1]
+        if (lp["qkv"].shape[-4] == d_model
+                and lp["gate_up"].shape[-3] == d_model):
+            # r3 D-first fused layout: move D to second-from-last.
+            # (D == KVH cannot alias: KVH is a head count, D the model dim.)
+            lp["qkv"] = jnp.moveaxis(lp["qkv"], -4, -2)
+            lp["gate_up"] = jnp.moveaxis(lp["gate_up"], -3, -2)
+            out = dict(params)
+            out["layers"] = lp
+            return out
         return params
     lp["qkv"] = fuse_qkv(lp.pop("q"), lp.pop("k"), lp.pop("v"))
-    lp["gate_up"] = jnp.stack([lp.pop("gate"), lp.pop("up")], axis=-2)
+    lp["gate_up"] = jnp.stack([lp.pop("gate"), lp.pop("up")], axis=-3)
     out = dict(params)
     out["layers"] = lp
     return out
@@ -413,7 +439,7 @@ def _block(
     # slots [q_0..q_{G-1}, k, v] per KV head.  Sharded over KVH on
     # "tensor", so the slice/reshape below are shard-local.
     G = config.n_heads // config.kv_heads
-    qkv = qeinsum(h, lp["qkv"], "btd,dcgk->btcgk", adt)
+    qkv = qeinsum(h, lp["qkv"], "btd,cgdk->btcgk", adt)
     qkv = constrain(qkv, "data", "seq", "tensor", None, None)
     q = qkv[..., :G, :].reshape(B, T, config.n_heads, config.head_dim)
     k = qkv[..., G, :]
@@ -435,10 +461,19 @@ def _block(
         # softmax level inside ring_decode via ``ring_new_pos``.
         from ..parallel.ring import ring_decode
 
-        attn = ring_decode(
-            q, cache_k.astype(adt), cache_v.astype(adt), slot_pos,
-            k, v, positions, ring_new_pos, softmax_dtype=softmax_dtype,
-        )
+        if cache_k_scale is not None:
+            # int8 seq-sharded cache: payload + scales stay int8/fp32 in
+            # HBM, sharded along S; scales fold per shard inside the body.
+            attn = ring_decode(
+                q, cache_k, cache_v, slot_pos, k, v, positions,
+                ring_new_pos, softmax_dtype=softmax_dtype,
+                k_scale=cache_k_scale, v_scale=cache_v_scale,
+            )
+        else:
+            attn = ring_decode(
+                q, cache_k.astype(adt), cache_v.astype(adt), slot_pos,
+                k, v, positions, ring_new_pos, softmax_dtype=softmax_dtype,
+            )
         cache_k, cache_v = k, v
     elif cache_k is not None and impl == "xla":
         # Append-free decode: the cache stays immutable through the layer
@@ -564,7 +599,7 @@ def _block(
     # fusion — the F axis stays "tensor"-sharded like the separate
     # layout) ---
     h = rms_norm(x, lp["mlp_norm"], config.rms_norm_eps)
-    gate_up = qeinsum(h, lp["gate_up"], "btd,dcf->btcf", adt)
+    gate_up = qeinsum(h, lp["gate_up"], "btd,cdf->btcf", adt)
     gate_up = constrain(gate_up, "data", "seq", None, "tensor")
     hidden = jax.nn.silu(gate_up[..., 0, :]) * gate_up[..., 1, :]
     down = qeinsum(hidden, lp["down"], "btf,fd->btd", adt)
@@ -699,11 +734,6 @@ def forward(
                     "seq-sharded decode needs a lockstep (scalar) cache "
                     "index; continuous batching uses seq == 1 meshes"
                 )
-            if cache.quantized:
-                raise NotImplementedError(
-                    "int8 KV + seq-sharded decode is not implemented "
-                    "(the ring decode body does not fold dequant scales)"
-                )
             ring_cached = True
             impl = "ring_decode"
     xla_cached = cache is not None and impl == "xla"
@@ -781,13 +811,6 @@ def forward(
         # per-stage); generation meshes keep stage == 1.
         from ..parallel.pipeline import pipeline_blocks
 
-        if layers_rng is not None:
-            raise NotImplementedError(
-                "dropout does not compose with stage > 1 pipeline meshes "
-                "(per-layer rng threading through microbatched stages is "
-                "not implemented); train with stage == 1 or pdrop = 0"
-            )
-
         if _mesh.shape.get("seq", 1) > 1:
             raise NotImplementedError(
                 "stage > 1 does not compose with seq > 1 (ring attention "
@@ -795,16 +818,31 @@ def forward(
                 "meshes for pipeline training"
             )
 
-        def stage_fn(stage_layers, xx, pos, spos):
+        # Per-layer dropout keys ride the staged tree ([L] leaves reshape
+        # to [S, L/S] like the weights); each stage folds the current
+        # microbatch index in, so every (layer, microbatch) pair draws an
+        # independent mask — stage-1 semantics, microbatched.
+        with_drop = layers_rng is not None
+        stage_tree = (
+            (lp, jax.random.split(layers_rng, config.n_layers))
+            if with_drop else lp
+        )
+
+        def stage_fn(stage_layers, xx, pos, spos, mb_index):
             sbias = (
                 None
                 if impl in ("flash", "ring")
                 else attention_bias(pos, spos, spos >= 0)
             )
 
-            def one(carry, lp_i):
+            def one(carry, xs):
+                if with_drop:
+                    lp_i, key_i = xs
+                    rng_i = jax.random.fold_in(key_i, mb_index)
+                else:
+                    lp_i, rng_i = xs, None
                 y, *_ = _block(
-                    carry, lp_i, None, None,
+                    carry, lp_i, None, None, None, None, rng_i,
                     config=config, positions=pos, bias=sbias,
                     slot_pos=spos, cache_index=None, cos=cos, sin=sin,
                     impl=impl,
@@ -817,7 +855,7 @@ def forward(
             return y
 
         x = pipeline_blocks(
-            stage_fn, lp, x, q_positions, slot_pos,
+            stage_fn, stage_tree, x, q_positions, slot_pos,
             mesh=_mesh,
             n_microbatches=config.pp_microbatches or pp_stages,
         )
@@ -955,6 +993,9 @@ def forward(
         new_k = constrain(new_k, None, "data", "seq", "tensor", None)
         new_v = constrain(new_v, None, "data", "seq", "tensor", None)
         slot_pos = constrain(slot_pos, "data", "seq")
+        if cache.quantized:
+            new_k_scale = constrain(new_k_scale, None, "data", "seq", "tensor")
+            new_v_scale = constrain(new_v_scale, None, "data", "seq", "tensor")
 
     logits = lm_head_logits(params, x, config) if compute_logits else None
 

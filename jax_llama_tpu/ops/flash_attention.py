@@ -141,14 +141,17 @@ def _flash_kernel(
     in_bound = ki < kv_bound_ref[
         pl.program_id(0) * pl.num_programs(2) + pl.program_id(2)
     ]
-    # Block-level causal skip: if the smallest *live* kv position in this
-    # block exceeds every query position, no (q, kv) pair is attendable and
+    # Block-level causal skip: if the smallest kv position in this block
+    # exceeds every query position, no (q, kv) pair is attendable and
     # both dots + the softmax update can be skipped — for standard causal
     # prefill that halves the MXU work (every block above the diagonal).
-    # Padding slots (-1) don't count as live; an all-padding block is
-    # skipped too (the finalize guards l == 0 for rows that never attend).
-    live_kp = jnp.where(kp >= 0, kp, jnp.iinfo(jnp.int32).max)
-    block_live = in_bound & (jnp.min(live_kp) <= jnp.max(qp))
+    # Padding slots carry +INT_MAX here (the wrappers remap the public -1
+    # convention before the kernel), so they exclude themselves from this
+    # min AND from the single `kp <= qp` compare below — the kernel's
+    # per-element mask chain is one compare + one select, not two
+    # compares + and + select.  An all-padding block is skipped too (the
+    # finalize guards l == 0 for rows that never attend).
+    block_live = in_bound & (jnp.min(kp) <= jnp.max(qp))
 
     @pl.when(block_live)
     def _compute():
@@ -167,19 +170,24 @@ def _flash_kernel(
         # NB: folding the scale into q outside the kernel was tried and
         # measured ~15% SLOWER on v5e (A/B, min-of-5 differencing) — the
         # fused multiply here rides the MXU output for free.
+        # The online softmax runs in BASE 2: log2(e) is pre-folded into
+        # `scale` (see _flash_forward), so the per-element transcendental
+        # is a bare exp2 — the VPU's native exponent — instead of exp's
+        # exp2(x·log2e) with its extra wide multiply.  exp2(s2 - m2)
+        # equals exp(s - m) exactly in the mask limit too (MASK_VALUE is
+        # a huge negative in either base).
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk]
+        ) * scale  # [bq, bk], base-2 domain
         if quantized:
             s = s * ksc
-        allowed = (kp <= qp) & (kp >= 0)
-        s = jnp.where(allowed, s, MASK_VALUE)
+        s = jnp.where(kp <= qp, s, MASK_VALUE)
 
         m_prev = m_ref[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)  # [bq, 1] rescale of old state
-        p = jnp.exp(s - m_new)  # [bq, bk]
+        alpha = jnp.exp2(m_prev - m_new)  # [bq, 1] rescale of old state
+        p = jnp.exp2(s - m_new)  # [bq, bk]
 
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_rate > 0.0:
@@ -221,10 +229,14 @@ def _flash_kernel(
             # Row logsumexp of the (scaled, masked) scores — the backward
             # kernels rebuild P = exp(s - lse) from it without storing
             # any S×S tensor.  Narrow-lane [bq, 1] (the lane-replicated
-            # form cost 128x the lse bytes at long context).
-            lse_ref[0, 0] = m_ref[:, :1] + jnp.log(
-                jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
-            )
+            # form cost 128x the lse bytes at long context).  m/l live in
+            # the base-2 domain (see _compute); convert once per row so
+            # the backward kernels stay in natural log.
+            lse_ref[0, 0] = (
+                m_ref[:, :1] + jnp.log2(
+                    jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
+                )
+            ) * float(np.log(2.0))
 
 
 def _pad_to(x: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
@@ -446,18 +458,26 @@ def _flash_forward(
     assert not (with_dropout and quantized), (
         "dropout is training-only; the int8-KV path is inference-only"
     )
-    scale = 1.0 / (d ** 0.5)
+    # log2(e) folded into the score scale: the kernel's online softmax
+    # runs in base 2 (bare VPU exp2 per element, no hidden wide multiply).
+    scale = (1.0 / (d ** 0.5)) * float(np.log2(np.e))
     interpret = _resolve_interpret(interpret)
     block_q, block_k = _clamp_blocks(T, S, block_q, block_k, interpret)
 
     # Pad sequence axes up to tile multiples OUTSIDE the kernel: Pallas
     # out-of-bounds tile reads are undefined, so padded kv slots must carry
-    # a real -1 position for the in-kernel mask to exclude them.
+    # a real sentinel position for the in-kernel mask to exclude them.
+    # Invalid slots (public contract: -1) are remapped to +INT_MAX here so
+    # the kernel's per-element mask is ONE compare (`kp <= qp` excludes
+    # padding by magnitude) instead of two compares + and.
     qt = _pad_to(jnp.swapaxes(q, 1, 2), 2, block_q)  # [B, H, Tp, d]
     kt = _pad_to(jnp.swapaxes(k, 1, 2), 2, block_k)  # [B, KVH, Sp, d]
     vt = _pad_to(jnp.swapaxes(v, 1, 2), 2, block_k)
     q_pos_p = _pad_to(q_pos.astype(jnp.int32), 1, block_q)
     kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), 1, block_k, value=-1)
+    kv_pos_p = jnp.where(
+        kv_pos_p < 0, jnp.iinfo(jnp.int32).max, kv_pos_p
+    )
     Tp, Sp = qt.shape[2], kt.shape[2]
     nq, nk = Tp // block_q, Sp // block_k
     # Narrow-lane/sublane position views (free expand_dims, no copies).
@@ -474,12 +494,7 @@ def _flash_forward(
     # causal prefill this removes the dead upper-triangle K/V traffic that
     # the in-kernel block_live check alone still paid bandwidth for.
     qmax = jnp.max(q_pos_p.reshape(B, nq, block_q), axis=2)
-    kmin = jnp.min(
-        jnp.where(
-            kv_pos_p >= 0, kv_pos_p, jnp.iinfo(jnp.int32).max
-        ).reshape(B, nk, block_k),
-        axis=2,
-    )
+    kmin = jnp.min(kv_pos_p.reshape(B, nk, block_k), axis=2)
     attendable = kmin[:, None, :] <= qmax[:, :, None]  # [B, nq, nk]
     kv_bound = 1 + jnp.max(
         jnp.where(
@@ -622,9 +637,8 @@ def _flash_dq_kernel(
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     qp = q_pos_ref[0, :, :1]  # [bq, 1]
-    kp = kv_pos_ref[0, :1, :]  # [1, bk]
-    live_kp = jnp.where(kp >= 0, kp, jnp.iinfo(jnp.int32).max)
-    block_live = jnp.min(live_kp) <= jnp.max(qp)
+    kp = kv_pos_ref[0, :1, :]  # [1, bk] (+INT_MAX on padding slots)
+    block_live = jnp.min(kp) <= jnp.max(qp)
 
     @pl.when(block_live)
     def _compute():
@@ -633,8 +647,7 @@ def _flash_dq_kernel(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        allowed = (kp <= qp) & (kp >= 0)
-        p = jnp.where(allowed, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
+        p = jnp.where(kp <= qp, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
         dp = jax.lax.dot_general(
             gb, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -678,9 +691,8 @@ def _flash_dkv_kernel(
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     qp = q_pos_ref[0, :, :1]  # [bq, 1]
-    kp = kv_pos_ref[0, :1, :]  # [1, bk]
-    live_kp = jnp.where(kp >= 0, kp, jnp.iinfo(jnp.int32).max)
-    block_live = jnp.min(live_kp) <= jnp.max(qp)
+    kp = kv_pos_ref[0, :1, :]  # [1, bk] (+INT_MAX on padding slots)
+    block_live = jnp.min(kp) <= jnp.max(qp)
 
     @pl.when(block_live)
     def _compute():
@@ -689,8 +701,7 @@ def _flash_dkv_kernel(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
-        allowed = (kp <= qp) & (kp >= 0)
-        p = jnp.where(allowed, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
+        p = jnp.where(kp <= qp, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
         if dropout_rate > 0.0:
             # Same tile coordinates as the forward/dQ kernels — NOTE the
             # grid here is (B, H, nk, nq), so qi/ki swap program ids.
@@ -754,7 +765,11 @@ def _flash_backward(
     gt = _pad_to(jnp.swapaxes(g, 1, 2), 2, block_q)  # dO; pad rows are 0 so
     #   padded-q contributions to every gradient vanish (Δ is 0 there too).
     q_pos_p = _pad_to(q_pos.astype(jnp.int32), 1, block_q)
+    # Same +INT_MAX invalid-slot remap as the forward (single-compare mask).
     kv_pos_p = _pad_to(kv_pos.astype(jnp.int32), 1, block_k, value=-1)
+    kv_pos_p = jnp.where(
+        kv_pos_p < 0, jnp.iinfo(jnp.int32).max, kv_pos_p
+    )
     Tp, Sp = qt.shape[2], kt.shape[2]
     nq, nk = Tp // block_q, Sp // block_k
     q_pos_r = q_pos_p[:, :, None]

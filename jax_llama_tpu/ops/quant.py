@@ -122,12 +122,12 @@ def matmul(
 
 # Contraction axes of each quantizable projection, in the *per-layer* shape
 # (the stacked tree adds a leading L axis — axes shift by one):
-#   qkv [D, KVH, G+2, hd] contract D; o [H, hd, D] contract (H, hd);
-#   gate_up [D, 2, F] contract D; down [F, D] contract F; lm_head [D, V]
+#   qkv [KVH, G+2, D, hd] contract D; o [H, hd, D] contract (H, hd);
+#   gate_up [2, D, F] contract D; down [F, D] contract F; lm_head [D, V]
 #   contract D.
 _LAYER_CONTRACT = {
-    "qkv": (0,), "o": (0, 1),
-    "gate_up": (0,), "down": (0,),
+    "qkv": (2,), "o": (0, 1),
+    "gate_up": (1,), "down": (0,),
 }
 
 

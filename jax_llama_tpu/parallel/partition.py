@@ -53,14 +53,14 @@ def param_partition_specs(
         "embed": {"embedding": P(("tensor", f) if f else "tensor", None)},
         "layers": {
             "attn_norm": P(s, None),
-            # Fused [L, D, KVH, G+2, hd]: column-parallel over KV heads
+            # Fused [L, KVH, G+2, D, hd]: column-parallel over KV heads
             # (each shard holds its heads' q slots AND k/v slots — the
             # same per-shard contents as the separate q/k/v layout).
-            "qkv": P(s, f, "tensor", None, None),
+            "qkv": P(s, "tensor", None, f, None),
             "o": P(s, "tensor", None, f),            # row-parallel
             "mlp_norm": P(s, None),
-            # Fused [L, D, 2, F]: column-parallel over F.
-            "gate_up": P(s, f, None, "tensor"),
+            # Fused [L, 2, D, F]: column-parallel over F.
+            "gate_up": P(s, None, f, "tensor"),
             "down": P(s, "tensor", f),               # row-parallel
         },
         "final_norm": P(None),

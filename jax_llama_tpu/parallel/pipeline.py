@@ -33,7 +33,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-StageFn = Callable[[Any, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+StageFn = Callable[
+    [Any, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray
+]
 
 
 def pipeline_blocks(
@@ -50,9 +52,13 @@ def pipeline_blocks(
     """Run the stacked layer params as a pipeline over the ``stage`` axis.
 
     Args:
-      stage_fn: ``(stage_layers, x, positions, slot_pos) -> x`` applying one
-        stage's layers to one microbatch (``stage_layers`` leaves keep a
-        leading ``L/S`` axis for the caller's own scan).
+      stage_fn: ``(stage_layers, x, positions, slot_pos, mb_index) -> x``
+        applying one stage's layers to one microbatch (``stage_layers``
+        leaves keep a leading ``L/S`` axis for the caller's own scan).
+        ``mb_index`` is the int32 index of the microbatch this stage is
+        processing this tick (clamped during fill/drain bubble ticks,
+        whose outputs are discarded) — dropout callers fold it into their
+        per-layer keys so every (layer, microbatch) draws independently.
       layer_params: pytree of stacked layer params, leading axis L.
       x: [B, T, D] embeddings.
       positions: [B, T] int32 query positions (clamped >= 0).
@@ -103,7 +109,11 @@ def pipeline_blocks(
             pos = jnp.where(is_first, pos_mb[inject], state_pos)
             spos = jnp.where(is_first, spos_mb[inject], state_spos)
 
-            y = stage_fn(layers, xx, pos, spos)
+            # Microbatch index at this stage this tick (GPipe: stage s runs
+            # microbatch t - s); clamped on bubble ticks, whose compute is
+            # discarded.
+            mb_index = jnp.clip(t - stage, 0, M - 1).astype(jnp.int32)
+            y = stage_fn(layers, xx, pos, spos, mb_index)
 
             # The last stage finished microbatch t - (S-1) this tick; every
             # stage writes uniformly (SPMD), only the last stage's buffer is

@@ -222,26 +222,44 @@ def ring_sdpa(
 # Seq-sharded cached decode
 # ---------------------------------------------------------------------------
 
+def _scale_rows(sc: jnp.ndarray, group: int) -> jnp.ndarray:
+    """Per-slot dequant scales [B, S, KVH] -> [B, H, 1, S] for folding
+    into scores/probabilities (constant along d, so they commute with the
+    attention contractions — the sdpa_cached trick, ring-sharded)."""
+    scr = repeat_kv(sc[..., None], group)[..., 0]  # [B, S, H]
+    return jnp.transpose(scr, (0, 2, 1))[:, :, None, :]
+
+
 def _ring_decode_body(
-    q, kc, vc, sp, kn, vn, qp, npos, *, axis_name: str, scale: float,
-    softmax_dtype,
+    q, kc, vc, sp, kn, vn, qp, npos, *args, axis_name: str, scale: float,
+    softmax_dtype, quantized: bool = False,
 ):
     """Per-device body: partial softmax over the LOCAL cache shard, exact
     combine over ``seq``, then the step's own new tokens merge at the
     softmax level (replicated arithmetic, no collective).
 
-    q: [B, T, H, d]; kc, vc: [B, S_local, KVH, d]; sp: [B, S_local];
-    kn, vn: [B, T, KVH, d]; qp, npos: [B, T].
+    q: [B, T, H, d]; kc, vc: [B, S_local, KVH, d] (int8 when quantized);
+    sp: [B, S_local]; kn, vn: [B, T, KVH, d]; qp, npos: [B, T]; with
+    ``quantized``, *args carries (k_scale, v_scale) [B, S_local, KVH] fp32
+    local shards — folded at the scores/probability level, so the int8
+    payload is never dequantized in memory (the new tokens merge at full
+    precision, matching sdpa_cached's same-step treatment).
     """
     B, T, H, d = q.shape
     group = H // kc.shape[2]
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, T, d]
 
+    if quantized:
+        k_scale, v_scale = args
+        kc = kc.astype(q.dtype)
+        vc = vc.astype(q.dtype)
     kr = repeat_kv(kc, group)
     vr = repeat_kv(vc, group)
     s = jnp.einsum(
         "bhtd,bshd->bhts", qt, kr, preferred_element_type=softmax_dtype
     ) * scale
+    if quantized:
+        s = s * _scale_rows(k_scale, group)
     allowed = (sp[:, None, None, :] <= qp[:, None, :, None]) & (
         sp >= 0
     )[:, None, None, :]
@@ -250,8 +268,14 @@ def _ring_decode_body(
     p = jnp.exp(s - m_i[..., None])
     p = jnp.where(allowed, p, 0.0)                 # all-masked shard: l_i = 0
     l_i = jnp.sum(p, axis=-1)
+    if quantized:
+        # v_scale folds into the (tiny) probabilities, AFTER l_i: the
+        # denominator must sum the unscaled p.
+        pv = (p * _scale_rows(v_scale, group)).astype(vr.dtype)
+    else:
+        pv = p.astype(vr.dtype)
     o_i = jnp.einsum(
-        "bhts,bshd->bhtd", p.astype(vr.dtype), vr,
+        "bhts,bshd->bhtd", pv, vr,
         preferred_element_type=softmax_dtype,
     )
 
@@ -304,6 +328,8 @@ def ring_decode(
     *,
     softmax_dtype=jnp.float32,
     axis_name: str = "seq",
+    k_scale: Optional[jnp.ndarray] = None,  # [B, S, KVH] fp32 (int8 cache)
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Cached decode over a KV cache sharded along S over the ``seq`` mesh
     axis: generation context is bounded by the mesh's combined HBM.
@@ -314,30 +340,42 @@ def ring_decode(
     scan; the caller lands the new K/V afterwards (the ``sdpa_cached``
     append-free contract — so this is the drop-in seq>1 counterpart of
     the xla decode path).  S must be divisible by the seq axis size.
+
+    int8 caches pass ``k_scale``/``v_scale`` per-slot dequant planes; the
+    scales shard along S with the payload and fold at the scores /
+    probability level per shard (``k_new``/``v_new`` stay full-precision —
+    same-step tokens merge unquantized, like sdpa_cached).
     """
     mesh = current_mesh()
     n = mesh.shape.get(axis_name, 1) if mesh is not None else 1
     scale = 1.0 / (q.shape[-1] ** 0.5)
+    quantized = k_scale is not None
+    scale_ops = (k_scale, v_scale) if quantized else ()
     if n == 1:
         return _ring_decode_body(
             q, k_cache, v_cache, slot_pos, k_new, v_new, q_pos, new_pos,
+            *scale_ops,
             axis_name=None, scale=scale, softmax_dtype=softmax_dtype,
+            quantized=quantized,
         )
 
-    rows = P(BATCH_AXES)
     head4 = P(BATCH_AXES, None, "tensor", None)
     cache4 = P(BATCH_AXES, axis_name, "tensor", None)
+    scale3 = P(BATCH_AXES, axis_name, "tensor")
     fn = jax.shard_map(
         functools.partial(
             _ring_decode_body, axis_name=axis_name, scale=scale,
-            softmax_dtype=softmax_dtype,
+            softmax_dtype=softmax_dtype, quantized=quantized,
         ),
         mesh=mesh,
         in_specs=(
             head4, cache4, cache4, P(BATCH_AXES, axis_name), head4, head4,
             P(BATCH_AXES, None), P(BATCH_AXES, None),
-        ),
+        ) + ((scale3, scale3) if quantized else ()),
         out_specs=head4,
         check_vma=False,
     )
-    return fn(q, k_cache, v_cache, slot_pos, k_new, v_new, q_pos, new_pos)
+    return fn(
+        q, k_cache, v_cache, slot_pos, k_new, v_new, q_pos, new_pos,
+        *scale_ops,
+    )

@@ -111,7 +111,7 @@ def test_convert_shard_reassembly_exact(tmp_path):
     np.testing.assert_array_equal(got_v, want_v)
     want_up = full["layers.0.feed_forward.w3.weight"].T
     np.testing.assert_array_equal(
-        params["layers"]["gate_up"][0][:, 1], want_up
+        params["layers"]["gate_up"][0][1], want_up
     )
     want_o = full["layers.0.attention.wo.weight"].T.reshape(HEADS, HD, DIM)
     np.testing.assert_array_equal(params["layers"]["o"][0], want_o)
@@ -213,7 +213,7 @@ def test_orbax_old_layout_checkpoint_migrates(tmp_path):
     lp = dict(params["layers"])
     q, k, v = split_qkv(lp.pop("qkv"))
     gate_up = lp.pop("gate_up")
-    lp.update(q=q, k=k, v=v, gate=gate_up[:, :, 0], up=gate_up[:, :, 1])
+    lp.update(q=q, k=k, v=v, gate=gate_up[:, 0], up=gate_up[:, 1])
     old = dict(params)
     old["layers"] = lp
 
@@ -250,7 +250,7 @@ def test_orbax_sharded_restore(tmp_path):
     shard_shapes = {s.data.shape for s in qkv.addressable_shards}
     G = cfg.n_heads // cfg.kv_heads
     assert shard_shapes == {
-        (cfg.n_layers, cfg.dim, cfg.kv_heads // 2, G + 2, cfg.head_dim)
+        (cfg.n_layers, cfg.kv_heads // 2, G + 2, cfg.dim, cfg.head_dim)
     }
     # Restored-sharded forward == original.
     tokens = jnp.asarray([[1, 2, 3, 4]])
@@ -333,3 +333,52 @@ def test_checkpoint_kind_mismatch_errors():
         save_checkpoint(td + "/s", params, config)
         with pytest.raises(ValueError, match="serving checkpoint"):
             load_train_state(td + "/s", opt)
+
+
+def test_orbax_d_first_layout_checkpoint_migrates(tmp_path):
+    """r3 checkpoints stored the fused weights with the contracted D axis
+    leading; load_checkpoint must detect the layout from metadata and
+    migrate by axis permutation — exact for full-precision AND int8 trees
+    (payload and scale permute together)."""
+    import dataclasses as _dc
+    import json as _json
+
+    import orbax.checkpoint as ocp
+
+    from jax_llama_tpu.convert.checkpoint import _to_d_first
+    from jax_llama_tpu.ops.quant import QuantizedTensor, quantize_params
+
+    cfg = cfg_lib.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    def save_as_d_first(tree, path, quantized):
+        old = dict(tree)
+        old["layers"] = _to_d_first(tree["layers"])
+        path.mkdir()
+        (path / "config.json").write_text(
+            _json.dumps(dict(_dc.asdict(cfg), _quantized=quantized))
+        )
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save((path / "params").absolute(), old, force=True)
+        ckptr.wait_until_finished()
+
+    save_as_d_first(params, tmp_path / "fp", quantized=False)
+    restored, rcfg = load_checkpoint(tmp_path / "fp")
+    assert rcfg == cfg
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        restored, params,
+    )
+
+    qp = quantize_params(params)
+    save_as_d_first(qp, tmp_path / "q8", quantized=True)
+    restored_q, _ = load_checkpoint(tmp_path / "q8")
+    assert isinstance(restored_q["layers"]["qkv"], QuantizedTensor)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        restored_q, qp,
+    )

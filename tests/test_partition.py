@@ -44,12 +44,12 @@ def test_specs_cover_fsdp_variant(params):
 def test_tp_sharded_leaves(params):
     mesh = make_mesh(tensor=2, data=4)
     sharded = shard_params(params, mesh, CFG)
-    # [L, D, KVH, G+2, hd] sharded on KVH over tensor=2
+    # [L, KVH, G+2, D, hd] sharded on KVH over tensor=2
     qkv = sharded["layers"]["qkv"]
     G = CFG.n_heads // CFG.kv_heads
     shard_shapes = {s.data.shape for s in qkv.addressable_shards}
     assert shard_shapes == {
-        (CFG.n_layers, CFG.dim, CFG.kv_heads // 2, G + 2, CFG.head_dim)
+        (CFG.n_layers, CFG.kv_heads // 2, G + 2, CFG.dim, CFG.head_dim)
     }
 
 

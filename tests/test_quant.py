@@ -118,7 +118,7 @@ def test_quantized_checkpoint_roundtrip(tmp_path):
     assert {
         s.data.shape
         for s in sharded["layers"]["qkv"].q.addressable_shards
-    } == {(CFG.n_layers, CFG.dim, CFG.kv_heads // 2, G + 2, CFG.head_dim)}
+    } == {(CFG.n_layers, CFG.kv_heads // 2, G + 2, CFG.dim, CFG.head_dim)}
 
 
 def test_quantized_sharded_forward_matches_single_device():
@@ -135,10 +135,10 @@ def test_quantized_sharded_forward_matches_single_device():
     # int8 payload sharded over KV heads; per-channel scale sharded
     # identically on the dims it has.
     assert {s.data.shape for s in qkv.q.addressable_shards} == {
-        (CFG.n_layers, CFG.dim, CFG.kv_heads // 2, G + 2, CFG.head_dim)
+        (CFG.n_layers, CFG.kv_heads // 2, G + 2, CFG.dim, CFG.head_dim)
     }
     assert {s.data.shape for s in qkv.scale.addressable_shards} == {
-        (CFG.n_layers, 1, CFG.kv_heads // 2, G + 2, CFG.head_dim)
+        (CFG.n_layers, CFG.kv_heads // 2, G + 2, 1, CFG.head_dim)
     }
     got, _ = forward(sharded, tokens, positions, CFG)
     np.testing.assert_allclose(

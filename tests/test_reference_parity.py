@@ -94,11 +94,10 @@ def to_reference_params(params, config):
     """Map our stacked-layer pytree onto the reference's Flax param tree.
 
     Layout contract (reference model.py:105-180,302-341,602-744): Dense
-    kernels are [in, out]; our fused per-layer qkv [D, KVH, G+2, hd]
+    kernels are [in, out]; our fused per-layer qkv [KVH, G+2, D, hd]
     splits (models.llama.split_qkv) into the reference's separate
     [D, H*hd] / [D, KVH*hd] kernels; o [H, hd, D] flattens to [H*hd, D];
-    gate_up[:, 0]/gate_up[:, 1]/down are w1/w3/w2; norms are 1-D
-    'kernel's.
+    gate_up[0]/gate_up[1]/down are w1/w3/w2; norms are 1-D 'kernel's.
     """
     from jax_llama_tpu.models import split_qkv
 
@@ -116,9 +115,9 @@ def to_reference_params(params, config):
                 "wo": {"kernel": f32(lp["o"][i]).reshape(H * hd, D)},
             },
             "feed_forward": {
-                "w1": {"kernel": f32(lp["gate_up"][i][:, 0])},
+                "w1": {"kernel": f32(lp["gate_up"][i][0])},
                 "w2": {"kernel": f32(lp["down"][i])},
-                "w3": {"kernel": f32(lp["gate_up"][i][:, 1])},
+                "w3": {"kernel": f32(lp["gate_up"][i][1])},
             },
             "attention_norm": {"kernel": f32(lp["attn_norm"][i])},
             "ffn_norm": {"kernel": f32(lp["mlp_norm"][i])},

@@ -267,3 +267,56 @@ def test_ring_chunked_accumulate_matches_unchunked():
             np.testing.assert_allclose(
                 np.asarray(g), np.asarray(w), atol=1e-5, rtol=1e-5
             )
+
+
+def test_ring_cached_decode_int8_kv():
+    """int8 KV + seq-sharded decode: the payload and its dequant scales
+    shard along S together and fold per shard — logits must match the
+    single-device int8 xla decode (both paths quantize identically, so
+    fp32 CPU agreement is exact up to reduction order)."""
+    from jax_llama_tpu.models import init_cache
+
+    config = get_config(
+        "tiny", dtype="float32", max_seq_len=16, kv_cache_dtype="int8"
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    B, P, STEPS = 2, 8, 4
+    rng = np.random.RandomState(11)
+    prompt = jnp.asarray(rng.randint(0, config.vocab_size, (B, P)), jnp.int32)
+    ppos = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+    steps = jnp.asarray(rng.randint(0, config.vocab_size, (B, STEPS)), jnp.int32)
+
+    ref_cache = init_cache(config, B, max_len=16)
+    assert ref_cache.quantized
+    ref_logits = []
+    lg, ref_cache = forward(params, prompt, ppos, config, cache=ref_cache)
+    ref_logits.append(np.asarray(lg[:, -1]))
+    for i in range(STEPS):
+        lg, ref_cache = forward(
+            params, steps[:, i:i + 1],
+            jnp.full((B, 1), P + i, jnp.int32), config, cache=ref_cache,
+        )
+        ref_logits.append(np.asarray(lg[:, 0]))
+
+    ring_config = config.replace(attn_impl="ring")
+    mesh = make_mesh(data=2, seq=4, devices=jax.devices()[:8])
+    sharded = shard_params(params, mesh, ring_config)
+    with use_mesh(mesh):
+        cache = init_cache(ring_config, B, max_len=16)
+        step = jax.jit(
+            lambda p, t, pos, c: forward(p, t, pos, ring_config, cache=c)
+        )
+        got_logits = []
+        lg, cache = step(sharded, prompt, ppos, cache)
+        got_logits.append(np.asarray(lg[:, -1]))
+        for i in range(STEPS):
+            lg, cache = step(
+                sharded, steps[:, i:i + 1],
+                jnp.full((B, 1), P + i, jnp.int32), cache,
+            )
+            got_logits.append(np.asarray(lg[:, 0]))
+
+    for j, (g, r) in enumerate(zip(got_logits, ref_logits)):
+        np.testing.assert_allclose(
+            g, r, atol=2e-4, rtol=1e-4, err_msg=f"step {j}"
+        )

@@ -183,8 +183,9 @@ def test_dropout_refusals():
         assert np.isfinite(np.asarray(la, np.float32)).all()
         np.testing.assert_array_equal(np.asarray(la), np.asarray(la2))
         assert np.abs(np.asarray(la) - np.asarray(lb)).max() > 0
-    # Embedding-only dropout needs no layer rng threading: it must work on
-    # a stage > 1 pipeline mesh (resid/attn dropout there still refuses).
+    # Embedding-only dropout needs no layer rng threading; full per-layer
+    # dropout on a stage > 1 mesh is covered by
+    # test_pipeline.test_pipeline_dropout_training.
     emb_only = cfg_lib.tiny(max_seq_len=32, embd_pdrop=0.5)
     mesh = make_mesh(stage=2, devices=jax.devices()[:2])
     sp = shard_params(init_params(jax.random.PRNGKey(0), emb_only), mesh, emb_only)

@@ -38,11 +38,11 @@ def apply_rope(x: torch.Tensor, freqs_cis: torch.Tensor, positions: torch.Tensor
 
 
 def _split_layers(lp):
-    """Accept either the framework's fused layer layout (qkv [L, D, KVH,
-    G+2, hd] + gate_up [L, D, 2, F]) or the separate one; return a dict
-    with separate q/k/v/gate/up views in Meta interleaved-RoPE feature
-    order, so the oracle math below stays an independent from-the-paper
-    implementation of Meta's convention."""
+    """Accept either the framework's fused layer layout (qkv [L, KVH,
+    G+2, D, hd] + gate_up [L, 2, D, F]) or the separate one; return a
+    dict with separate q/k/v/gate/up views in Meta interleaved-RoPE
+    feature order, so the oracle math below stays an independent
+    from-the-paper implementation of Meta's convention."""
     if "qkv" not in lp:
         return lp
 
@@ -53,14 +53,16 @@ def _split_layers(lp):
         return w.reshape(*lead, 2, hd // 2).swapaxes(-1, -2).reshape(w.shape)
 
     qkv = np.asarray(lp["qkv"])
-    L, D, KVH, g2, hd = qkv.shape
+    L, KVH, g2, D, hd = qkv.shape
     G = g2 - 2
     out = dict(lp)
-    out["q"] = unpermute(qkv[..., :G, :].reshape(L, D, KVH * G, hd))
-    out["k"] = unpermute(qkv[..., G, :])
-    out["v"] = qkv[..., G + 1, :]
+    out["q"] = unpermute(
+        np.moveaxis(qkv[:, :, :G], 3, 1).reshape(L, D, KVH * G, hd)
+    )
+    out["k"] = unpermute(qkv[:, :, G].swapaxes(1, 2))
+    out["v"] = qkv[:, :, G + 1].swapaxes(1, 2)
     gu = np.asarray(lp["gate_up"])
-    out["gate"], out["up"] = gu[:, :, 0], gu[:, :, 1]
+    out["gate"], out["up"] = gu[:, 0], gu[:, 1]
     return out
 
 
