@@ -99,18 +99,36 @@ def main() -> None:
     is_v5e = "v5 lite" in str(jax.devices()[0]).lower()
     embed_entries = config.vocab_size * config.dim
 
-    def hbm_util(weight_itemsize: float, per_step_s: float) -> float:
+    def modeled_step_bytes(weight_itemsize: float) -> float:
+        """The one byte model both figures below read: weights once per
+        step + bf16 KV at mean context."""
         weight_bytes = (n_params - embed_entries) * weight_itemsize
         mean_ctx = P + (N + 1) / 2
         kv_bytes = (
             2 * config.n_layers * B * mean_ctx
             * config.kv_heads * config.head_dim * 2  # bf16 cache
         )
-        return (weight_bytes + kv_bytes) / per_step_s / V5E_HBM_BYTES_PER_S
+        return weight_bytes + kv_bytes
+
+    def hbm_util(weight_itemsize: float, per_step_s: float) -> float:
+        return (
+            modeled_step_bytes(weight_itemsize)
+            / per_step_s / V5E_HBM_BYTES_PER_S
+        )
 
     bf16_hbm = hbm_util(2.0, decode_s / (N - 1))
     int8_step_s = B / int8_toks_per_s
     int8_hbm = hbm_util(1.0, int8_step_s)
+
+    def roofline_tps(weight_itemsize: float) -> float:
+        """Decode ceiling if every modeled byte moved at the v5e HBM peak
+        with zero other time.  Context for vs_baseline: the param-scaled
+        50-tok/s target sits at ~100% of this ceiling for the bf16 B=8
+        geometry — crossing ~0.95 vs_baseline means saturating the chip's
+        memory system, not trimming overhead."""
+        return B / (
+            modeled_step_bytes(weight_itemsize) / V5E_HBM_BYTES_PER_S
+        )
 
     # ------------------------------------------------------------------
     # Long-prompt prefill through the compiled Pallas flash kernel
@@ -201,14 +219,16 @@ def main() -> None:
     # Speculative serving (target as its own draft => 100% acceptance):
     # isolates the speculative round's mechanics.  Kernel path (T=1 draft
     # steps + one multi-token verify kernel pass, pools never gathered)
-    # vs the gathered-view fallback (forced via a non-8-multiple block
-    # size), same workload — the delta is the gather traffic.
+    # vs the gathered-view fallback via the explicit toggle — SAME block
+    # size and pool geometry on both sides, so the delta is purely the
+    # attention path (the r3 version forced the fallback with an odd
+    # block size, which also changed pool capacity and queueing).
     # ------------------------------------------------------------------
-    def spec_run(block_size):
+    def spec_run(use_kernel):
         cb = ContinuousBatcher(
-            params, config, n_slots=4, max_len=1024,
-            block_size=block_size,
+            params, config, n_slots=4, max_len=1024, block_size=128,
             draft_params=params, draft_config=config, n_draft=3,
+            use_pallas_kernel=use_kernel,
         )
         srng = np.random.RandomState(2)
         for _ in range(4):
@@ -218,13 +238,13 @@ def main() -> None:
         emitted = 0
         while cb.pending():
             emitted += len(cb.step())
-        return time.time() - t0, emitted
+        return time.time() - t0, emitted, cb.stats()["draft_acceptance_rate"]
 
-    spec_run(128)  # warmup
-    sk_t, sk_n = min(spec_run(128) for _ in range(3))
+    spec_run(True)  # warmup
+    sk_t, sk_n, spec_kernel_accept = min(spec_run(True) for _ in range(3))
     spec_kernel_toks_per_s = sk_n / sk_t
-    spec_run(100)  # warmup (100 % 8 != 0 -> gathered fallback)
-    sg_t, sg_n = min(spec_run(100) for _ in range(3))
+    spec_run(False)  # warmup
+    sg_t, sg_n, spec_gathered_accept = min(spec_run(False) for _ in range(3))
     spec_gathered_toks_per_s = sg_n / sg_t
 
     # Larger serving batch (B=16): decode is weight-bandwidth-bound, so
@@ -336,6 +356,14 @@ def main() -> None:
             "hbm_utilization_bf16": round(bf16_hbm, 3) if is_v5e else None,
             "hbm_utilization_int8": round(int8_hbm, 3) if is_v5e else None,
             "hbm_model": "weights-once-per-step + bf16 KV at mean context",
+            # Bandwidth ceiling for this geometry (see roofline_tps):
+            # vs_baseline 0.95 ~= 100% of the bf16 ceiling on this chip.
+            "decode_roofline_tokens_per_s_bf16": (
+                round(roofline_tps(2.0), 1) if is_v5e else None
+            ),
+            "decode_roofline_tokens_per_s_int8": (
+                round(roofline_tps(1.0), 1) if is_v5e else None
+            ),
             # Compiled Pallas flash kernel, long-prompt prefill (B=1).
             "flash_prefill_8k_s": round(flash8k_s, 3),
             "flash_prefill_8k_tflops": round(flash8k_tf, 1),
@@ -360,12 +388,22 @@ def main() -> None:
             "burst_admission_s": round(admit_s, 3),
             # Speculative serving (self-draft, n_draft=3): Pallas path
             # (T=1 draft steps + multi-token verify kernel) vs the
-            # gathered-view fallback on the same workload.
+            # gathered-view fallback at IDENTICAL pool geometry.  NOTE:
+            # self-draft acceptance on the kernel path is <1.0 because
+            # the draft chain (T=1 tiles) and verify (T=4 tiles) differ
+            # in fp reduction order and a bf16 near-tie argmax flips —
+            # tokens stay correct (rejections fall back to the target's
+            # token), it just costs extra rounds; the acceptance fields
+            # attribute any throughput gap between the two paths.
             "spec_serving_kernel_tokens_per_s": round(
                 spec_kernel_toks_per_s, 2
             ),
+            "spec_serving_kernel_acceptance": round(spec_kernel_accept, 3),
             "spec_serving_gathered_tokens_per_s": round(
                 spec_gathered_toks_per_s, 2
+            ),
+            "spec_serving_gathered_acceptance": round(
+                spec_gathered_accept, 3
             ),
             # Batch-16 steady-state decode (headline stays B=8 for
             # round-over-round comparability).

@@ -158,11 +158,22 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         temperature=args.temperature, top_p=args.top_p,
         seed=args.seed, mesh=mesh,
     )
+    # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
+    # the reference's own framing; other tokenizers have no chat contract).
+    chat_format = None
+    if hasattr(tokenizer, "special_tokens") and hasattr(tokenizer, "eot_id"):
+        from .tokenizers.llama3 import ChatFormat
+
+        chat_format = ChatFormat(tokenizer)
     with LLMServer(
-        cb, tokenizer=tokenizer, host=args.host, port=args.http
+        cb, tokenizer=tokenizer, host=args.host, port=args.http,
+        chat_format=chat_format,
     ) as srv:
+        endpoints = "POST /generate" + (
+            ", /chat" if chat_format is not None else ""
+        )
         print(f"serving on {srv.address} "
-              f"(POST /generate, GET /metrics, /healthz)", flush=True)
+              f"({endpoints}, GET /metrics, /healthz)", flush=True)
         if _test_hook is not None:
             _test_hook(srv)
             return

@@ -20,6 +20,12 @@ parity.  Design constraints, in order:
     Prometheus text format; ``GET /healthz`` for liveness.
 
 Endpoints:
+  POST /chat       {"messages": [{"role": ..., "content": ...}, ...]}
+                   (needs a server-side chat_format — llama3 ChatFormat).
+                   Same sampling/stream/timeout options as /generate;
+                   stop_tokens default to the tokenizer's stop set
+                   (end_of_text + eot for llama3) and "text" fields
+                   decode with stop ids stripped.
   POST /generate   {"prompt": [ids]} or {"text": "..."} (needs tokenizer),
                    optional max_new_tokens / temperature / top_p / top_k /
                    seed / stop_tokens / timeout_s / stream.
@@ -74,6 +80,9 @@ class _Pending:
     # Set by the handler when the client socket dies mid-stream; the loop
     # cancels the request at the next step boundary.
     disconnected: bool = False
+    # /chat request: dialog framing on submit, stop ids stripped from the
+    # decoded text fields.
+    chat: bool = False
 
     def fail(self, message: str, code: int) -> None:
         self.error = message
@@ -97,9 +106,11 @@ class LLMServer:
         host: str = "127.0.0.1",
         port: int = 0,
         max_queue: int = 256,
+        chat_format: Any = None,
     ):
         self.batcher = batcher
         self.tokenizer = tokenizer
+        self.chat_format = chat_format
         self.max_queue = max_queue
         self._inbox: "queue.Queue[_Pending]" = queue.Queue()
         self._active: Dict[int, _Pending] = {}
@@ -139,7 +150,7 @@ class LLMServer:
                     self._reply_json(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != "/generate":
+                if self.path not in ("/generate", "/chat"):
                     self._reply_json(404, {"error": "not found"})
                     return
                 try:
@@ -161,7 +172,8 @@ class LLMServer:
                     )
                     return
                 pending = _Pending(
-                    payload=payload, stream=bool(payload.get("stream"))
+                    payload=payload, stream=bool(payload.get("stream")),
+                    chat=self.path == "/chat",
                 )
                 timeout_s = payload.get("timeout_s")
                 if timeout_s is not None:
@@ -237,7 +249,9 @@ class LLMServer:
                     "tokens": pending.tokens,
                 }
                 if server.tokenizer is not None:
-                    out["text"] = server.tokenizer.decode(pending.tokens)
+                    out["text"] = server.tokenizer.decode(
+                        server._visible(pending.tokens, pending.chat)
+                    )
                 self._reply_json(200, out)
 
             def _stream_reply(self, pending: "_Pending"):
@@ -277,7 +291,9 @@ class LLMServer:
                         break
                     line: Dict[str, Any] = {"token": ev}
                     if server.tokenizer is not None:
-                        line["text"] = server.tokenizer.decode([ev])
+                        line["text"] = server.tokenizer.decode(
+                            server._visible([ev], pending.chat)
+                        )
                     if not emit(line):
                         return  # client gone; the loop reaps the request
                 final: Dict[str, Any] = {
@@ -322,9 +338,44 @@ class LLMServer:
 
     # -- serving loop (sole owner of the batcher) ---------------------------
 
+    def _visible(self, tokens: List[int], chat: bool) -> List[int]:
+        """Tokens to DECODE for a reply: /chat strips the stop ids (the
+        eot/eos framing is protocol, not assistant text); /generate
+        returns everything verbatim."""
+        if not chat:
+            return list(tokens)
+        stops = set(getattr(self.tokenizer, "stop_tokens", None) or ())
+        return [t for t in tokens if t not in stops]
+
     def _submit(self, p: _Pending) -> None:
         payload = p.payload
-        if "prompt" in payload:
+        if p.chat:
+            if self.chat_format is None:
+                raise ValueError(
+                    "/chat needs a server-side chat_format "
+                    "(e.g. tokenizers.llama3.ChatFormat)"
+                )
+            messages = payload.get("messages")
+            if not isinstance(messages, list) or not messages:
+                raise ValueError(
+                    'missing "messages" (non-empty list of '
+                    '{"role", "content"})'
+                )
+            for m in messages:
+                # Type-check the values too: ChatFormat calls .strip() /
+                # encode() on them, and an AttributeError from a payload
+                # is not in the loop's caught-error set — one malformed
+                # request must never kill the device-owning thread.
+                if (
+                    not isinstance(m, dict)
+                    or not isinstance(m.get("role"), str)
+                    or not isinstance(m.get("content"), str)
+                ):
+                    raise ValueError(
+                        'each message needs string "role" and "content"'
+                    )
+            tokens = self.chat_format.encode_dialog_prompt(messages)
+        elif "prompt" in payload:
             tokens = [int(t) for t in payload["prompt"]]
         elif "text" in payload:
             if self.tokenizer is None:
@@ -348,6 +399,12 @@ class LLMServer:
             kwargs["stop_tokens"] = tuple(
                 int(t) for t in payload["stop_tokens"]
             )
+        elif p.chat:
+            # Dialog completions stop at the tokenizer's stop set
+            # (llama3: end_of_text + eot_id) unless overridden.
+            stops = getattr(self.tokenizer, "stop_tokens", None)
+            if stops:
+                kwargs["stop_tokens"] = tuple(int(t) for t in stops)
         rid = self.batcher.submit(tokens, **kwargs)
         p.request_id = rid
         self._active[rid] = p

@@ -303,12 +303,13 @@ def warped_probs_rows(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "mesh", "all_greedy"),
+    static_argnames=("config", "mesh", "all_greedy", "allow_kernel"),
     donate_argnames=("pool",),
 )
 def _paged_decode_step(
     params, pool, table, n_alloc, fill, tau, pos, active, keys,
     temperature, top_p, top_k, *, config, all_greedy=False, mesh=None,
+    allow_kernel=True,
 ):
     """One [n_slots, 1] decode step over the paged pool.
 
@@ -336,7 +337,7 @@ def _paged_decode_step(
         # are verified compiled on hardware — bf16 and int8 kernels match
         # interpret mode exactly at BLK 8/16/32/64/128 on a v5e chip
         # (regression-tested in tests/test_tpu_compiled.py).
-        use_kernel = pool.block_size % 8 == 0
+        use_kernel = allow_kernel and pool.block_size % 8 == 0
         if mesh is not None:
             rows = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
             use_kernel &= (
@@ -777,6 +778,7 @@ class ContinuousBatcher:
         draft_config: Optional[LLaMAConfig] = None,
         n_draft: int = 4,
         mesh=None,
+        use_pallas_kernel: bool = True,
     ):
         if config.attn_impl not in ("xla", "auto"):
             raise ValueError(
@@ -797,6 +799,10 @@ class ContinuousBatcher:
         self.params = params
         self.config = config
         self.mesh = mesh
+        # False forces the gathered-view attention everywhere the kernel
+        # would run — an A/B and debugging knob (bench.py uses it to
+        # compare the two paths at identical block size / pool geometry).
+        self.use_pallas_kernel = use_pallas_kernel
         self.n_slots = n_slots
         self.max_len = max_len or config.max_seq_len
         self.block_size = block_size or min(
@@ -995,7 +1001,7 @@ class ContinuousBatcher:
                     jnp.array(self.temp_arr), jnp.array(self.top_p_arr),
                     jnp.array(self.top_k_arr),
                     config=self.config, all_greedy=all_greedy,
-                    mesh=self.mesh,
+                    mesh=self.mesh, allow_kernel=self.use_pallas_kernel,
                 )
                 self.fill += self.active
                 self.pos += self.active
@@ -1005,7 +1011,7 @@ class ContinuousBatcher:
     def _spec_kernel_ok(self) -> bool:
         """Same kernel-eligibility gate as _paged_decode_step (the T>1
         verify adds no constraints: it shards identically)."""
-        ok = self.block_size % 8 == 0
+        ok = self.use_pallas_kernel and self.block_size % 8 == 0
         if self.mesh is not None:
             rows = (
                 self.mesh.shape.get("data", 1)

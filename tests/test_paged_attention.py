@@ -534,3 +534,36 @@ def test_batcher_on_tensor_data_mesh_matches_unsharded():
     mesh = make_mesh(data=2, fsdp=2, tensor=2)
     got = run(mesh, shard_params(params, mesh, config))
     assert got == want
+
+
+def test_use_pallas_kernel_toggle_token_identical():
+    """The explicit gathered-view toggle (bench's A/B knob) must not
+    change tokens: kernel and gathered paths at IDENTICAL block size and
+    pool geometry agree exactly (fp32 CPU), for plain and speculative
+    batching."""
+    from jax_llama_tpu.serving import ContinuousBatcher
+
+    kw = dict(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=128,
+    )
+    config = get_config("tiny", **kw)
+    params = init_params(jax.random.PRNGKey(0), config)
+    rng = np.random.RandomState(9)
+    prompts = [list(rng.randint(1, 128, n)) for n in (7, 23)]
+
+    def run(use_kernel, spec):
+        extra = (
+            dict(draft_params=params, draft_config=config, n_draft=2)
+            if spec else {}
+        )
+        cb = ContinuousBatcher(
+            params, config, n_slots=2, max_len=128, block_size=16,
+            use_pallas_kernel=use_kernel, **extra,
+        )
+        rids = [cb.submit(p, max_new_tokens=8) for p in prompts]
+        res = cb.run_to_completion()
+        return [res[r] for r in rids]
+
+    for spec in (False, True):
+        assert run(True, spec) == run(False, spec), f"spec={spec}"

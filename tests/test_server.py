@@ -342,3 +342,102 @@ def test_http_non_finite_timeout_rejected(model):
             except urllib.error.HTTPError as e:
                 assert e.code == 400
                 assert "finite" in json.loads(e.read())["error"]
+
+
+def test_http_chat_endpoint(model):
+    """/chat frames the dialog via the chat_format, defaults stop tokens
+    to the tokenizer's stop set, strips stop ids from the decoded text,
+    and rejects malformed dialogs and chat-less servers."""
+    params, config = model
+    tok = ByteTokenizer()
+
+    class ByteChatFormat:
+        """Minimal dialog framing over the byte tokenizer (the llama3
+        ChatFormat needs a real tiktoken vocab; the server only relies on
+        encode_dialog_prompt)."""
+
+        def __init__(self, tokenizer):
+            self.tokenizer = tokenizer
+
+        def encode_dialog_prompt(self, dialog):
+            ids = [self.tokenizer.bos_id]
+            for m in dialog:
+                ids += self.tokenizer.encode(f"[{m['role']}]")
+                ids += self.tokenizer.encode(m["content"])
+            ids += self.tokenizer.encode("[assistant]")
+            return ids
+
+    fmt = ByteChatFormat(tok)
+    messages = [
+        {"role": "system", "content": "terse"},
+        {"role": "user", "content": "hi there"},
+    ]
+
+    # Reference: standalone batcher fed the same framed prompt with the
+    # tokenizer's stop set (the endpoint's default).
+    ref = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    rid = ref.submit(
+        fmt.encode_dialog_prompt(messages), max_new_tokens=8,
+        stop_tokens=tuple(tok.stop_tokens),
+    )
+    want = ref.run_to_completion()[rid]
+
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    with LLMServer(cb, tokenizer=tok, chat_format=fmt) as srv:
+        req = urllib.request.Request(
+            srv.address + "/chat",
+            data=json.dumps(
+                {"messages": messages, "max_new_tokens": 8}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            status, body = r.status, json.loads(r.read())
+        assert status == 200
+        assert body["tokens"] == want
+        stop_set = set(tok.stop_tokens)
+        assert body["text"] == tok.decode(
+            [t for t in want if t not in stop_set]
+        )
+
+        # Malformed dialogs are 400s, not loop crashes.
+        for bad in (
+            {},
+            {"messages": []},
+            {"messages": [{"role": "user"}]},
+            {"messages": "hi"},
+            # Wrong-TYPED values (OpenAI-style content parts, null, int):
+            # ChatFormat would raise AttributeError on these, which is
+            # outside the loop's caught-error set — they must be rejected
+            # at validation, not allowed to kill the serving thread.
+            {"messages": [{"role": "user",
+                           "content": [{"type": "text", "text": "hi"}]}]},
+            {"messages": [{"role": "user", "content": None}]},
+            {"messages": [{"role": 3, "content": "hi"}]},
+        ):
+            req = urllib.request.Request(
+                srv.address + "/chat", data=json.dumps(bad).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=60)
+                assert False, bad
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+
+    # A server without a chat_format refuses /chat.
+    cb2 = ContinuousBatcher(params, config, n_slots=1, max_len=64)
+    with LLMServer(cb2, tokenizer=tok) as srv:
+        req = urllib.request.Request(
+            srv.address + "/chat",
+            data=json.dumps(
+                {"messages": messages, "max_new_tokens": 4}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "chat_format" in json.loads(e.read())["error"]
