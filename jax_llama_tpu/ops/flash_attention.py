@@ -259,7 +259,7 @@ def flash_attention(
     v: jnp.ndarray,
     q_pos: jnp.ndarray,
     kv_pos: jnp.ndarray,
-    block_q: int = 512,
+    block_q: int = 1024,
     block_k: int = 2048,
     interpret: Optional[bool] = None,
     dropout_rate: float = 0.0,
@@ -278,10 +278,11 @@ def flash_attention(
       k, v: [B, S, KVH, d], H % KVH == 0 (GQA).
       q_pos: [B, T] int32 absolute query positions (pre-clamped >= 0).
       kv_pos: [B, S] int32 kv slot positions, -1 for padding/unwritten.
-      block_q, block_k: tile sizes (clamped to T / S).  Defaults were swept
-        on a v5e with run-differenced timing: (512, 2048) measures 2.7x
-        faster than (256, 512) at S=8k and 5x at S=16k (~79% of MXU peak,
-        causally counted).
+      block_q, block_k: tile sizes (clamped to T / S).  Swept on a v5e
+        with alternated run-differenced timing: (1024, 2048) beats the r2
+        default (512, 2048) by ~5% at 8k and ~7% median at 16k with the base-2
+        softmax kernel ((1024, 4096) fails VMEM); the r1 (256, 512) was
+        2.7-5x slower still.
       dropout_rate: attention-probability dropout (training; parity with
         the reference's attn_pdrop, model.py:276-288, and with
         ``ops.attention.sdpa``'s inverted-dropout semantics).  The mask is
@@ -343,7 +344,7 @@ def flash_attention_quantized(
     v_scale: jnp.ndarray,
     q_pos: jnp.ndarray,
     kv_pos: jnp.ndarray,
-    block_q: int = 512,
+    block_q: int = 1024,
     block_k: int = 2048,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:

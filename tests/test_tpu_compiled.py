@@ -102,11 +102,16 @@ def test_flash_forward_compiled_parity(S):
 
 @requires_tpu
 def test_flash_backward_compiled_parity():
-    """Compiled flash VJP (dq/dk/dv) vs the dense sdpa VJP on chip."""
+    """Compiled flash VJP (dq/dk/dv) vs the dense sdpa VJP on chip.
+
+    S=2048 so the backward kernels compile at the FULL default tile
+    (block_q=1024 — live since GQA packing doubles the row axis — AND
+    block_k=2048); smaller S silently clamps and would leave the default
+    shape Mosaic-untested."""
     from jax_llama_tpu.ops.attention import attention_bias, sdpa
     from jax_llama_tpu.ops.flash_attention import flash_attention
 
-    B, S, H, KVH, d = 1, 1024, 8, 4, 128
+    B, S, H, KVH, d = 1, 2048, 8, 4, 128
     rng = np.random.RandomState(2)
     q = jnp.asarray(rng.randn(B, S, H, d) * 0.3, jnp.bfloat16)
     k = jnp.asarray(rng.randn(B, S, KVH, d) * 0.3, jnp.bfloat16)
