@@ -54,12 +54,23 @@ def main() -> None:
     mask = jnp.ones((B, P), dtype=bool)
     key = jax.random.PRNGKey(0)
 
+    _salt = [0]
+
+    def salted_key():
+        """SALT every timed call (fold a counter into the PRNG key,
+        unused under greedy): byte-identical repeated requests can be
+        served from a cache under this environment's tunnel, silently
+        corrupting min-of-N (see ROADMAP "SALT the inputs")."""
+        _salt[0] += 1
+        return jax.random.fold_in(key, _salt[0])
+
     def run(p, max_new: int) -> float:
         gc = GenerationConfig(
             max_new_tokens=max_new, temperature=0.0, stop_tokens=()
         )
+        skey = salted_key()
         t0 = time.time()
-        out = generate(p, tokens, mask, key, config=config, gen_config=gc)
+        out = generate(p, tokens, mask, skey, config=config, gen_config=gc)
         # Sync via host transfer, NOT block_until_ready: under the axon
         # tunnel backend block_until_ready/effects_barrier return while the
         # computation is still in flight, and the [B, P+N] int32 fetch is
@@ -166,7 +177,9 @@ def main() -> None:
             )
             float(reps(pparams, toks))  # compile warmup (per k: shapes differ)
             best = float("inf")
-            for _ in range(5):  # min-of-5: same jitter policy as decode
+            for i in range(5):  # min-of-5: same jitter policy as decode
+                # Salt: vary one token per repetition (anti-caching).
+                toks = toks.at[0, 0, 0].set((i * 7 + 1) % cfg.vocab_size)
                 t0 = time.time()
                 float(reps(pparams, toks))
                 best = min(best, time.time() - t0)
@@ -184,6 +197,36 @@ def main() -> None:
 
     flash8k_s, flash8k_tf = prefill_tflops(8192, "auto")
     flash16k_s, flash16k_tf = prefill_tflops(16384, "auto")
+    flash32k_s, flash32k_tf = prefill_tflops(32768, "auto")
+
+    # ------------------------------------------------------------------
+    # Long-context decode (BASELINE config 4's 8k->32k story): B=1 with a
+    # 16k-token context — chunked flash prefill, then append-free decode
+    # over the full cache.  KV reads dominate weight reads at this length
+    # (~1.07GB cache + 1.94GB weights per step).
+    # ------------------------------------------------------------------
+    CTX, NEW = 16256, 64
+    lc_tokens = jnp.asarray(
+        rng.randint(0, config.vocab_size, (1, CTX)), jnp.int32
+    )
+    lc_mask = jnp.ones((1, CTX), dtype=bool)
+
+    def lc_run(max_new: int) -> float:
+        gc = GenerationConfig(
+            max_new_tokens=max_new, temperature=0.0, stop_tokens=(),
+            prefill_chunk=2048,
+        )
+        t0 = time.time()
+        np.asarray(generate(
+            params, lc_tokens, lc_mask, salted_key(), config=config,
+            gen_config=gc,
+        ))
+        return time.time() - t0
+
+    lc_run(NEW); lc_run(1)
+    lc_full = min(lc_run(NEW) for _ in range(3))
+    lc_short = min(lc_run(1) for _ in range(3))
+    lc_toks_per_s = (NEW - 1) / max(lc_full - lc_short, 1e-9)
 
     # ------------------------------------------------------------------
     # Continuous-batching serving throughput through the Pallas
@@ -198,7 +241,8 @@ def main() -> None:
         cb = ContinuousBatcher(
             params, config, n_slots=8, max_len=1024, block_size=128
         )
-        srng = np.random.RandomState(1)
+        _salt[0] += 1
+        srng = np.random.RandomState(1000 + _salt[0])  # salted prompts
         for _ in range(8):
             # 850 tokens pad to 7 blocks (896); +48 stays within 1024.
             cb.submit(list(srng.randint(1, config.vocab_size, 850)),
@@ -230,7 +274,8 @@ def main() -> None:
             draft_params=params, draft_config=config, n_draft=3,
             use_pallas_kernel=use_kernel,
         )
-        srng = np.random.RandomState(2)
+        _salt[0] += 1
+        srng = np.random.RandomState(2000 + _salt[0])  # salted prompts
         for _ in range(4):
             cb.submit(list(srng.randint(1, config.vocab_size, 500)),
                       max_new_tokens=48)
@@ -261,7 +306,8 @@ def main() -> None:
         )
         t0 = time.time()
         out = generate(
-            params, tokens16, mask16, key, config=config, gen_config=gc
+            params, tokens16, mask16, salted_key(), config=config,
+            gen_config=gc,
         )
         np.asarray(out)
         return time.time() - t0
@@ -278,58 +324,89 @@ def main() -> None:
     # profiler/proto stack is unavailable the bench still emits its line.
     # ------------------------------------------------------------------
     step_breakdown = None
+    device_toks_per_s = None
     try:
         import collections
         import glob
         import re
         import tempfile
 
-        tmpdir = tempfile.mkdtemp(prefix="bench_xplane_")
-        gc32 = GenerationConfig(
-            max_new_tokens=32, temperature=0.0, stop_tokens=()
-        )
-        np.asarray(generate(
-            params, tokens, mask, key, config=config, gen_config=gc32
-        ))
-        jax.profiler.start_trace(tmpdir)
-        np.asarray(generate(
-            params, tokens, mask, key, config=config, gen_config=gc32
-        ))
-        jax.profiler.stop_trace()
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-        xp = glob.glob(f"{tmpdir}/**/*.xplane.pb", recursive=True)[0]
-        xs = xplane_pb2.XSpace()
-        with open(xp, "rb") as f:
-            xs.ParseFromString(f.read())
-        plane = next(p for p in xs.planes if "TPU" in p.name)
-        sm = {k: v.name for k, v in plane.stat_metadata.items()}
-        md_name, md_src = {}, {}
-        for k, v in plane.event_metadata.items():
-            md_name[k] = v.name
-            src = next(
-                (
-                    st.str_value
-                    for st in v.stats
-                    if sm.get(st.metadata_id) == "source"
-                ),
-                "",
+        def _trace_device_ps(max_new: int):
+            """Sum of device-op time (ps) for one traced generate call,
+            bucketed by HLO source file."""
+            tmpdir = tempfile.mkdtemp(prefix="bench_xplane_")
+            gcN = GenerationConfig(
+                max_new_tokens=max_new, temperature=0.0, stop_tokens=()
             )
-            m = re.search(r"/(\w+\.py):", src)
-            md_src[k] = m.group(1) if m else "other"
-        line = next(ln for ln in plane.lines if ln.name == "XLA Ops")
-        agg = collections.Counter()
-        for e in line.events:
-            if md_name[e.metadata_id].startswith("%while"):
-                continue  # outer loops double-count their bodies
-            agg[md_src[e.metadata_id]] += e.duration_ps
-        steps = 32
+            np.asarray(generate(
+                params, tokens, mask, salted_key(), config=config,
+                gen_config=gcN,
+            ))
+            jax.profiler.start_trace(tmpdir)
+            np.asarray(generate(
+                params, tokens, mask, salted_key(), config=config,
+                gen_config=gcN,
+            ))
+            jax.profiler.stop_trace()
+            from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+            xp = glob.glob(f"{tmpdir}/**/*.xplane.pb", recursive=True)[0]
+            xs = xplane_pb2.XSpace()
+            with open(xp, "rb") as f:
+                xs.ParseFromString(f.read())
+            plane = next(p for p in xs.planes if "TPU" in p.name)
+            sm = {k: v.name for k, v in plane.stat_metadata.items()}
+            md_name, md_src = {}, {}
+            for k, v in plane.event_metadata.items():
+                md_name[k] = v.name
+                src = next(
+                    (
+                        st.str_value
+                        for st in v.stats
+                        if sm.get(st.metadata_id) == "source"
+                    ),
+                    "",
+                )
+                m = re.search(r"/(\w+\.py):", src)
+                md_src[k] = m.group(1) if m else "other"
+            line = next(ln for ln in plane.lines if ln.name == "XLA Ops")
+            agg = collections.Counter()
+            for e in line.events:
+                if md_name[e.metadata_id].startswith("%while"):
+                    continue  # outer loops double-count their bodies
+                agg[md_src[e.metadata_id]] += e.duration_ps
+            return agg
+
+        agg32 = _trace_device_ps(32)
         step_breakdown = {
-            src: round(ps / 1e6 / steps, 1)  # us per decode step
-            for src, ps in agg.most_common(8)
+            src: round(ps / 1e6 / 32, 1)  # us per decode step (32-amortized)
+            for src, ps in agg32.most_common(8)
         }
+        try:
+            # Device-time decode throughput: differencing two traced runs
+            # (32 vs 1 new tokens) cancels the prefill, leaving 31 steps
+            # of pure device-op time.  Unlike the wall-clock headline
+            # this is immune to host/tunnel jitter and any device
+            # time-sharing — wall-clock runs of IDENTICAL code have
+            # measured 2.6-3.05 ms/step across sessions while this
+            # figure stayed put to 0.01%.  A second-trace failure only
+            # loses this figure, not the breakdown above.
+            agg1 = _trace_device_ps(1)
+            step_ps = (sum(agg32.values()) - sum(agg1.values())) / 31
+            if step_ps > 0:
+                device_toks_per_s = B / (step_ps / 1e12)
+            # Differenced per-step breakdown: the 32-amortized figures
+            # above still carry prefill ops in each bucket; subtracting
+            # the 1-step trace cancels them exactly.
+            step_breakdown = {
+                src: round((ps - agg1.get(src, 0)) / 1e6 / 31, 1)
+                for src, ps in agg32.most_common(8)
+            }
+        except Exception:
+            pass
     except Exception:
         step_breakdown = None
+        device_toks_per_s = None
 
     # BASELINE.json's 50 tok/s/chip target is stated for Llama-3-70B on
     # v5p; decode is HBM-bandwidth-bound, so scale the per-chip target by
@@ -369,6 +446,11 @@ def main() -> None:
             "flash_prefill_8k_tflops": round(flash8k_tf, 1),
             "flash_prefill_16k_s": round(flash16k_s, 3),
             "flash_prefill_16k_tflops": round(flash16k_tf, 1),
+            "flash_prefill_32k_s": round(flash32k_s, 3),
+            "flash_prefill_32k_tflops": round(flash32k_tf, 1),
+            # BASELINE config 4 (long context): B=1, 16k-token context,
+            # chunked flash prefill + append-free decode over the cache.
+            "decode_tokens_per_s_ctx16k_b1": round(lc_toks_per_s, 2),
             "mxu_peak_tflops": V5E_BF16_FLOPS / 1e12 if is_v5e else None,
             "mxu_utilization_16k": (
                 round(flash16k_tf * 1e12 / V5E_BF16_FLOPS, 3)
@@ -408,6 +490,13 @@ def main() -> None:
             # Batch-16 steady-state decode (headline stays B=8 for
             # round-over-round comparability).
             "decode_tokens_per_s_b16": round(b16_toks_per_s, 2),
+            # Device-op-time decode throughput from xplane differencing
+            # (32 vs 1 new tokens): the tenancy/jitter-immune companion
+            # of the wall-clock headline — if the two disagree, this one
+            # is the chip's actual rate.
+            "decode_tokens_per_s_device_xplane": (
+                round(device_toks_per_s, 2) if device_toks_per_s else None
+            ),
             # Device-op µs per decode step bucketed by HLO source file
             # (quant.py = the projection/MLP matmul fusions, attention.py
             # = the decode attention chain, llama.py = cache/update ops,

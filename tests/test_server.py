@@ -400,6 +400,24 @@ def test_http_chat_endpoint(model):
             [t for t in want if t not in stop_set]
         )
 
+        # Streaming /chat: NDJSON token lines; stop ids carry no text.
+        req = urllib.request.Request(
+            srv.address + "/chat",
+            data=json.dumps(
+                {"messages": messages, "max_new_tokens": 8, "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            lines = [json.loads(ln) for ln in r.read().splitlines()]
+        assert lines[-1]["done"] is True
+        assert lines[-1]["tokens"] == want
+        toks_streamed = [ln["token"] for ln in lines[:-1]]
+        assert toks_streamed == want
+        for ln in lines[:-1]:
+            if ln["token"] in stop_set:
+                assert ln["text"] == ""  # protocol framing, not content
+
         # Malformed dialogs are 400s, not loop crashes.
         for bad in (
             {},
