@@ -397,10 +397,19 @@ def main() -> None:
                 device_toks_per_s = B / (step_ps / 1e12)
             # Differenced per-step breakdown: the 32-amortized figures
             # above still carry prefill ops in each bucket; subtracting
-            # the 1-step trace cancels them exactly.
+            # the 1-step trace cancels them exactly.  Rank and clamp on
+            # the DIFFERENCED values (a prefill-dominated bucket can
+            # difference to ~0 or jitter negative and must not displace a
+            # real decode bucket).
+            diffed = {
+                src: max(agg32.get(src, 0) - agg1.get(src, 0), 0) / 1e6 / 31
+                for src in set(agg32) | set(agg1)
+            }
             step_breakdown = {
-                src: round((ps - agg1.get(src, 0)) / 1e6 / 31, 1)
-                for src, ps in agg32.most_common(8)
+                src: round(us, 1)
+                for src, us in sorted(
+                    diffed.items(), key=lambda kv: -kv[1]
+                )[:8]
             }
         except Exception:
             pass

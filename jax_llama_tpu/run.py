@@ -59,11 +59,20 @@ def main() -> None:
     ap.add_argument("--http", type=int, default=None, metavar="PORT",
                     help="serve over HTTP on this port (POST /generate "
                          "with blocking or NDJSON-streaming responses, "
+                         "POST /chat for llama-3 tokenizers, "
                          "GET /metrics, /healthz) instead of the stdin "
                          "loop; 0 picks a free port")
+    ap.add_argument("--logprobs", action="store_true",
+                    help="compute per-token model logprobs so HTTP "
+                         "requests may ask for them (\"logprobs\": true)")
     ap.add_argument("--host", default="127.0.0.1",
                     help="bind address for --http")
     args = ap.parse_args()
+    if args.logprobs and args.http is None:
+        raise SystemExit(
+            "--logprobs only applies to the HTTP server (--http PORT); "
+            "the stdin/--serve and one-shot modes have no logprobs output"
+        )
 
     import jax
 
@@ -157,6 +166,7 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         max_len=config.max_seq_len, stop_tokens=stops,
         temperature=args.temperature, top_p=args.top_p,
         seed=args.seed, mesh=mesh,
+        logprobs=getattr(args, "logprobs", False),
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).

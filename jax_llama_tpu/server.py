@@ -28,7 +28,9 @@ Endpoints:
                    decode with stop ids stripped.
   POST /generate   {"prompt": [ids]} or {"text": "..."} (needs tokenizer),
                    optional max_new_tokens / temperature / top_p / top_k /
-                   seed / stop_tokens / timeout_s / stream.
+                   seed / stop_tokens / timeout_s / stream / logprobs
+                   (per-token model logprobs; needs a logprobs=True
+                   batcher — run.py --logprobs).
                    Default: blocks until the request finishes; returns
                    {"request_id", "tokens", "text"?}.
                    "stream": true streams NDJSON, one line per token
@@ -83,6 +85,10 @@ class _Pending:
     # /chat request: dialog framing on submit, stop ids stripped from the
     # decoded text fields.
     chat: bool = False
+    # "logprobs": true — per-token model logprobs in the response
+    # (requires the batcher to be constructed with logprobs=True).
+    want_lp: bool = False
+    lps: List[float] = field(default_factory=list)
 
     def fail(self, message: str, code: int) -> None:
         self.error = message
@@ -174,6 +180,7 @@ class LLMServer:
                 pending = _Pending(
                     payload=payload, stream=bool(payload.get("stream")),
                     chat=self.path == "/chat",
+                    want_lp=bool(payload.get("logprobs")),
                 )
                 timeout_s = payload.get("timeout_s")
                 if timeout_s is not None:
@@ -248,6 +255,8 @@ class LLMServer:
                     "request_id": pending.request_id,
                     "tokens": pending.tokens,
                 }
+                if pending.want_lp:
+                    out["logprobs"] = pending.lps
                 if server.tokenizer is not None:
                     out["text"] = server.tokenizer.decode(
                         server._visible(pending.tokens, pending.chat)
@@ -289,10 +298,13 @@ class LLMServer:
                             continue
                     if ev is _DONE:
                         break
-                    line: Dict[str, Any] = {"token": ev}
+                    tok, lp = ev
+                    line: Dict[str, Any] = {"token": tok}
+                    if lp is not None:
+                        line["logprob"] = lp
                     if server.tokenizer is not None:
                         line["text"] = server.tokenizer.decode(
-                            server._visible([ev], pending.chat)
+                            server._visible([tok], pending.chat)
                         )
                     if not emit(line):
                         return  # client gone; the loop reaps the request
@@ -301,6 +313,8 @@ class LLMServer:
                     "request_id": pending.request_id,
                     "tokens": pending.tokens,
                 }
+                if pending.want_lp:
+                    final["logprobs"] = pending.lps
                 if pending.timed_out:
                     final["timeout"] = True
                 if pending.error is not None:
@@ -349,6 +363,11 @@ class LLMServer:
 
     def _submit(self, p: _Pending) -> None:
         payload = p.payload
+        if p.want_lp and not getattr(self.batcher, "logprobs", False):
+            raise ValueError(
+                '"logprobs" needs a batcher constructed with '
+                "logprobs=True (run.py: --logprobs)"
+            )
         if p.chat:
             if self.chat_format is None:
                 raise ValueError(
@@ -462,13 +481,17 @@ class LLMServer:
                 self._reap()
                 if not self.batcher.pending():
                     continue
-                for rid, tok, done in self.batcher.step():
+                for ev in self.batcher.step():
+                    rid, tok, done = ev[0], ev[1], ev[2]
+                    lp = ev[3] if len(ev) > 3 else None
                     p = self._active.get(rid)
                     if p is None:
                         continue
                     p.tokens.append(tok)
+                    if p.want_lp and lp is not None:
+                        p.lps.append(lp)
                     if p.stream:
-                        p.chunks.put(tok)
+                        p.chunks.put((tok, lp if p.want_lp else None))
                     if done:
                         del self._active[rid]
                         p.finish()

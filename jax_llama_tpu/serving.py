@@ -303,13 +303,15 @@ def warped_probs_rows(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "mesh", "all_greedy", "allow_kernel"),
+    static_argnames=(
+        "config", "mesh", "all_greedy", "allow_kernel", "with_logprobs"
+    ),
     donate_argnames=("pool",),
 )
 def _paged_decode_step(
     params, pool, table, n_alloc, fill, tau, pos, active, keys,
     temperature, top_p, top_k, *, config, all_greedy=False, mesh=None,
-    allow_kernel=True,
+    allow_kernel=True, with_logprobs=False,
 ):
     """One [n_slots, 1] decode step over the paged pool.
 
@@ -372,18 +374,34 @@ def _paged_decode_step(
         else:
             keys, subs = _split_rows(keys)
             nxt = sample_rows(subs, logits[:, -1], temperature, top_p, top_k)
-        return nxt, keys, pool
+        # with_logprobs is static (trace-time specialization, like
+        # all_greedy): without it the fp32 [B, V] cast + logsumexp never
+        # enter the compiled program.
+        lp = _token_logprob(logits[:, -1], nxt) if with_logprobs else None
+        return nxt, lp, keys, pool
+
+
+def _token_logprob(logits: jnp.ndarray, tok: jnp.ndarray) -> jnp.ndarray:
+    """Model log-probability of ``tok`` under fp32 log-softmax of the raw
+    logits — temperature/top-p independent (the standard serving-API
+    definition), identical to what ``engine.score`` reports for the same
+    position.  logits: [B, V]; tok: [B] -> [B] fp32."""
+    lg = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lg, axis=-1)
+    return jnp.take_along_axis(lg, tok[:, None].astype(jnp.int32), axis=1)[
+        :, 0
+    ] - lse
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "mesh", "prefill_chunk"),
+    static_argnames=("config", "mesh", "prefill_chunk", "with_logprobs"),
     donate_argnames=("pool",),
 )
 def _paged_insert(
     params, pool, block_ids, prompt_tokens, prompt_mask, keys,
     temperature, top_p, top_k, *,
-    config, prefill_chunk=None, mesh=None,
+    config, prefill_chunk=None, mesh=None, with_logprobs=False,
 ):
     """Prefill a batch of k admitted requests and land their KV in their
     reserved blocks.
@@ -401,8 +419,8 @@ def _paged_insert(
     every P_b are block multiples, so the alignment is exact).
     Inactive (padding) rows, if any, carry all-sentinel block_ids and an
     all-False mask.
-    Returns (sampled tokens [k], prompt lengths [k], carried keys [k, 2],
-    updated pool).
+    Returns (sampled tokens [k], their model logprobs [k], prompt
+    lengths [k], carried keys [k, 2], updated pool).
     """
     with use_mesh(mesh):
         k_rows, P = prompt_tokens.shape
@@ -421,6 +439,9 @@ def _paged_insert(
             )
         keys, subkeys = _split_rows(keys)
         tau = sample_rows(subkeys, logits[:, -1], temperature, top_p, top_k)
+        tau_lp = (
+            _token_logprob(logits[:, -1], tau) if with_logprobs else None
+        )
         plen = jnp.sum(prompt_mask.astype(jnp.int32), axis=-1)
 
         L, KVH, _, _, hd = pool.k.shape
@@ -454,7 +475,7 @@ def _paged_insert(
                     to_blocks(sub.v_scale), mode="drop"
                 ),
             )
-        return tau, plen, keys, pool
+        return tau, tau_lp, plen, keys, pool
 
 
 @functools.partial(jax.jit, donate_argnames=("pos",))
@@ -779,6 +800,7 @@ class ContinuousBatcher:
         n_draft: int = 4,
         mesh=None,
         use_pallas_kernel: bool = True,
+        logprobs: bool = False,
     ):
         if config.attn_impl not in ("xla", "auto"):
             raise ValueError(
@@ -786,6 +808,14 @@ class ContinuousBatcher:
                 "(per-row cache offsets run on the xla path)"
             )
         self.spec = draft_params is not None
+        self.logprobs = logprobs
+        if logprobs and self.spec:
+            raise NotImplementedError(
+                "logprobs + speculative decoding is not implemented (the "
+                "verify pass would need to thread per-accepted-token "
+                "logprobs through the rejection rounds); use logprobs "
+                "with a plain batcher or spec without logprobs"
+            )
         if self.spec:
             if draft_config is None:
                 raise ValueError("draft_params requires draft_config")
@@ -835,6 +865,8 @@ class ContinuousBatcher:
         self.n_alloc = np.zeros((B,), np.int32)
         self.fill = np.zeros((B,), np.int32)
         self.tau = jnp.zeros((B,), jnp.int32)
+        # Model logprob of each slot's pending tau (valid while active).
+        self.tau_lp = np.zeros((B,), np.float32)
         self.pos = np.zeros((B,), np.int32)
         self.active = np.zeros((B,), bool)
         self.keys = jnp.zeros((B, 2), jnp.uint32)
@@ -954,13 +986,16 @@ class ContinuousBatcher:
             "draft_acceptance_rate": self.acceptance_rate(),
         }
 
-    def step(self) -> List[Tuple[int, int, bool]]:
+    def step(self) -> List[Tuple]:
         """One decode step for every active slot.
 
         Returns [(request_id, token, done)] for tokens emitted this step
         (one per active slot; up to ``n_draft + 1`` per slot in
-        speculative mode).  Finished slots free their blocks and queued
-        requests are admitted for the NEXT step.
+        speculative mode).  With ``logprobs=True`` each tuple carries a
+        4th element: the token's model logprob (fp32 log-softmax of the
+        raw logits — what ``engine.score`` reports for the position).
+        Finished slots free their blocks and queued requests are
+        admitted for the NEXT step.
         """
         self._admit()
         if not any(s is not None for s in self.slots.values()):
@@ -969,7 +1004,7 @@ class ContinuousBatcher:
         # Emit each active slot's current tau; free finished slots BEFORE
         # the decode so a completing request doesn't pay for one more
         # forward whose output would be discarded.
-        out: List[Tuple[int, int, bool]] = []
+        out: List[Tuple] = []
         taus = np.asarray(self.tau)
         for b, slot in self.slots.items():
             if slot is None:
@@ -981,7 +1016,12 @@ class ContinuousBatcher:
                 tok in slot.stop_tokens
                 or len(slot.emitted) >= slot.max_new
             )
-            out.append((slot.request_id, tok, done))
+            if self.logprobs:
+                out.append((
+                    slot.request_id, tok, done, float(self.tau_lp[b])
+                ))
+            else:
+                out.append((slot.request_id, tok, done))
             if done:
                 self._free_slot(b)
 
@@ -993,7 +1033,7 @@ class ContinuousBatcher:
                 all_greedy = bool(
                     np.all(self.temp_arr[self.active] == 0.0)
                 )
-                self.tau, self.keys, self.pool = _paged_decode_step(
+                self.tau, step_lp, self.keys, self.pool = _paged_decode_step(
                     self.params, self.pool,
                     jnp.array(self.table), jnp.array(self.n_alloc),
                     jnp.array(self.fill), self.tau, jnp.array(self.pos),
@@ -1002,7 +1042,12 @@ class ContinuousBatcher:
                     jnp.array(self.top_k_arr),
                     config=self.config, all_greedy=all_greedy,
                     mesh=self.mesh, allow_kernel=self.use_pallas_kernel,
+                    with_logprobs=self.logprobs,
                 )
+                if self.logprobs:
+                    # np.array (copy): asarray of a jax array is a
+                    # read-only view, and _admit writes rows in place.
+                    self.tau_lp = np.array(step_lp)
                 self.fill += self.active
                 self.pos += self.active
         self._admit()
@@ -1082,7 +1127,7 @@ class ContinuousBatcher:
         """Drain everything; returns {request_id: emitted tokens}."""
         results: Dict[int, List[int]] = {}
         while self.pending():
-            for rid, tok, done in self.step():
+            for rid, tok, *_ in self.step():
                 results.setdefault(rid, []).append(tok)
         return results
 
@@ -1176,20 +1221,20 @@ class ContinuousBatcher:
                 temps[i] = req.temperature
                 top_ps[i] = req.top_p
                 top_ks[i] = req.top_k
-            taus, plens, keys_out, self.pool = _paged_insert(
+            taus, tau_lps, plens, keys_out, self.pool = _paged_insert(
                 self.params, self.pool, jnp.asarray(bid),
                 jnp.asarray(pt), jnp.asarray(pm), jnp.asarray(keys),
                 jnp.asarray(temps), jnp.asarray(top_ps),
                 jnp.asarray(top_ks),
                 config=self.config, prefill_chunk=self.prefill_chunk,
-                mesh=self.mesh,
+                mesh=self.mesh, with_logprobs=self.logprobs,
             )
             if self.spec:
                 # Prefill the draft pool over the same reserved blocks
                 # (its sampled tokens are discarded — the target picks
                 # tau, and each row's key chain carries from the TARGET
                 # insert only).
-                _, _, _, self.draft_pool = _paged_insert(
+                _, _, _, _, self.draft_pool = _paged_insert(
                     self.draft_params, self.draft_pool, jnp.asarray(bid),
                     jnp.asarray(pt), jnp.asarray(pm), jnp.asarray(keys),
                     jnp.zeros((kb,), jnp.float32),
@@ -1201,6 +1246,8 @@ class ContinuousBatcher:
             slot_ids = free_slots[:k]
             idx = jnp.asarray(np.asarray(slot_ids, np.int32))
             self.tau = self.tau.at[idx].set(taus[:k])
+            if self.logprobs:
+                self.tau_lp[np.asarray(slot_ids)] = np.asarray(tau_lps)[:k]
             self.keys = self.keys.at[idx].set(keys_out[:k])
             plens_np = np.asarray(plens)
             for i, req in enumerate(batch):

@@ -459,3 +459,57 @@ def test_http_chat_endpoint(model):
         except urllib.error.HTTPError as e:
             assert e.code == 400
             assert "chat_format" in json.loads(e.read())["error"]
+
+
+def test_http_logprobs(model):
+    """"logprobs": true returns per-token model logprobs (blocking array
+    + per-line streaming), and is a 400 when the batcher was not built
+    with logprobs=True."""
+    import math
+
+    params, config = model
+    tok = ByteTokenizer()
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, logprobs=True
+    )
+    with LLMServer(cb, tokenizer=tok) as srv:
+        status, body = _post(
+            srv.address,
+            {"text": "hello", "max_new_tokens": 6, "logprobs": True},
+        )
+        assert status == 200
+        assert len(body["logprobs"]) == len(body["tokens"]) == 6
+        assert all(
+            isinstance(x, float) and x <= 0.0 and math.isfinite(x)
+            for x in body["logprobs"]
+        )
+
+        # Streaming: each token line carries its logprob; the final line
+        # repeats the full array.
+        req = urllib.request.Request(
+            srv.address + "/generate",
+            data=json.dumps({"text": "hello", "max_new_tokens": 6,
+                             "logprobs": True, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            lines = [json.loads(ln) for ln in r.read().splitlines()]
+        assert [ln["logprob"] for ln in lines[:-1]] == body["logprobs"]
+        assert lines[-1]["logprobs"] == body["logprobs"]
+        assert lines[-1]["tokens"] == body["tokens"]
+
+        # Without logprobs the response omits the field.
+        status, body2 = _post(
+            srv.address, {"text": "hello", "max_new_tokens": 4}
+        )
+        assert status == 200 and "logprobs" not in body2
+
+    cb2 = ContinuousBatcher(params, config, n_slots=1, max_len=32)
+    with LLMServer(cb2, tokenizer=tok) as srv:
+        try:
+            _post(srv.address,
+                  {"text": "x", "max_new_tokens": 2, "logprobs": True})
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "logprobs" in json.loads(e.read())["error"]

@@ -288,3 +288,49 @@ def test_chunked_admission_matches_single_shot(model):
                                prefill_chunk=chunk)
         rid = cb.submit(prompt, max_new_tokens=10)
         assert cb.run_to_completion()[rid] == want, f"chunk={chunk}"
+
+
+def test_logprobs_match_engine_score():
+    """With logprobs=True the batcher's per-token logprob equals
+    engine.score's teacher-forced log p(token | prefix) at the same
+    position — for greedy AND sampled slots (the definition is the raw
+    model distribution, temperature-independent)."""
+    from jax_llama_tpu.engine import score
+
+    config = get_config("tiny", **CFG)
+    params = init_params(jax.random.PRNGKey(0), config)
+    rng = np.random.RandomState(21)
+    prompts = [list(rng.randint(1, 128, n)) for n in (6, 17)]
+
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, block_size=16, logprobs=True,
+    )
+    r0 = cb.submit(prompts[0], max_new_tokens=8)                  # greedy
+    r1 = cb.submit(prompts[1], max_new_tokens=8, temperature=0.7,
+                   top_p=0.9, seed=5)                             # sampled
+    got: dict = {}
+    lps: dict = {}
+    while cb.pending():
+        for rid, tok, done, lp in cb.step():
+            got.setdefault(rid, []).append(tok)
+            lps.setdefault(rid, []).append(lp)
+
+    for rid, prompt in ((r0, prompts[0]), (r1, prompts[1])):
+        toks = got[rid]
+        full = jnp.asarray([prompt + toks], jnp.int32)
+        # score[t] = log p(full[t+1] | full[:t+1]); emitted token i sits
+        # at full position len(prompt)+i, so its score index is
+        # len(prompt)+i-1.
+        sc = np.asarray(score(params, full, config=config))[0]
+        want = [float(sc[len(prompt) + i - 1]) for i in range(len(toks))]
+        np.testing.assert_allclose(lps[rid], want, atol=1e-4, rtol=1e-4)
+
+
+def test_logprobs_spec_refusal():
+    config = get_config("tiny", **CFG)
+    params = init_params(jax.random.PRNGKey(0), config)
+    with pytest.raises(NotImplementedError, match="logprobs"):
+        ContinuousBatcher(
+            params, config, n_slots=2, max_len=64, logprobs=True,
+            draft_params=params, draft_config=config, n_draft=2,
+        )
