@@ -237,14 +237,16 @@ class LLMServer:
                         pending.disconnected = True
                         return  # the loop reaps the request
                 if pending.timed_out:
-                    self._reply_json(
-                        504,
-                        {
-                            "error": "generation timed out",
-                            "request_id": pending.request_id,
-                            "tokens": pending.tokens,
-                        },
-                    )
+                    body: Dict[str, Any] = {
+                        "error": "generation timed out",
+                        "request_id": pending.request_id,
+                        "tokens": pending.tokens,
+                    }
+                    if pending.want_lp:
+                        # Partial results keep their logprobs — the
+                        # streaming timeout final line already does.
+                        body["logprobs"] = pending.lps
+                    self._reply_json(504, body)
                     return
                 if pending.error is not None:
                     self._reply_json(
