@@ -131,41 +131,16 @@ def _saved_layout(ckptr, item_path: Path, config: LLaMAConfig) -> str:
     return "current"
 
 
-def _mv(x, src: int, dst: int):
-    """moveaxis that sees through QuantizedTensor (payload and scale
-    permute together — the scale keeps size-1 contracted dims in the same
-    axis positions, so the transform is exact for quantized trees)."""
-    from ..ops.quant import QuantizedTensor
-
-    if isinstance(x, QuantizedTensor):
-        return QuantizedTensor(
-            q=jnp.moveaxis(x.q, src, dst),
-            scale=jnp.moveaxis(x.scale, src, dst),
-        )
-    return jnp.moveaxis(x, src, dst)
-
-
-def _permute_d_axis(lp: dict, to_d_first: bool) -> dict:
-    """One home for the current-layout <-> r3 D-first axis contract
-    (qkv: D between -2 and -4; gate_up: D between -2 and -3).
-    models.llama.fuse_params' d_first branch is the same permutation for
-    plain trees reached via its own migration entry point."""
-    lp = dict(lp)
-    if to_d_first:
-        lp["qkv"] = _mv(lp["qkv"], -2, -4)
-        lp["gate_up"] = _mv(lp["gate_up"], -2, -3)
-    else:
-        lp["qkv"] = _mv(lp["qkv"], -4, -2)
-        lp["gate_up"] = _mv(lp["gate_up"], -3, -2)
-    return lp
-
-
 def _to_d_first(lp: dict) -> dict:
-    return _permute_d_axis(lp, to_d_first=True)
+    from ..models.llama import permute_d_axis
+
+    return permute_d_axis(lp, to_d_first=True)
 
 
 def _from_d_first(lp: dict) -> dict:
-    return _permute_d_axis(lp, to_d_first=False)
+    from ..models.llama import permute_d_axis
+
+    return permute_d_axis(lp, to_d_first=False)
 
 
 def _old_layout_shapes(config: LLaMAConfig, layout: str, quantized: bool) -> Any:

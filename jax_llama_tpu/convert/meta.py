@@ -6,15 +6,17 @@ convert_weights.py:52-92``), same tensor mapping contract:
   Meta tensor (torch [out, in])        shard axis  →  this framework
   ----------------------------------   ----------     ------------------------
   tok_embeddings.weight  [V, D]        1 (D)          embed.embedding [V, D]
-  layers.N.attention.wq  [H*hd, D]     0              layers.qkv[..., :G, :]
-  layers.N.attention.wk  [KVH*hd, D]   0              layers.qkv[..., G, :]
-  layers.N.attention.wv  [KVH*hd, D]   0              layers.qkv[..., G+1, :]
-                                       (qkv is the fused [L, D, KVH, G+2, hd]
-                                        decode layout, G = H // KVH; see
+  layers.N.attention.wq  [H*hd, D]     0              layers.qkv[:, :, :G]
+  layers.N.attention.wk  [KVH*hd, D]   0              layers.qkv[:, :, G]
+  layers.N.attention.wv  [KVH*hd, D]   0              layers.qkv[:, :, G+1]
+                                       (qkv is the fused
+                                        [L, KVH, G+2, D, hd] decode layout,
+                                        G = H // KVH, D second-from-last —
+                                        the scan-slice layout contract; see
                                         models.llama.fuse_qkv)
   layers.N.attention.wo  [D, H*hd]     1              layers.o  [L, H, hd, D]
-  layers.N.feed_forward.w1 [F, D]      0              layers.gate_up[:, :, 0]
-  layers.N.feed_forward.w3 [F, D]      0              layers.gate_up[:, :, 1]
+  layers.N.feed_forward.w1 [F, D]      0              layers.gate_up[:, 0]
+  layers.N.feed_forward.w3 [F, D]      0              layers.gate_up[:, 1]
   layers.N.feed_forward.w2 [D, F]      1              layers.down [L, F, D]
   layers.N.attention_norm / ffn_norm   replicated     layers.attn_norm/mlp_norm
   norm.weight                          replicated     final_norm
@@ -23,9 +25,11 @@ convert_weights.py:52-92``), same tensor mapping contract:
 
 Column-parallel weights (wq/wk/wv/w1/w3/output) concatenate along torch
 axis 0; row-parallel (wo/w2) and the embedding along axis 1; linear kernels
-transpose from torch [out, in] to [in, out].  Meta's native layout uses the
-*interleaved* RoPE pairing — exactly what ``ops.rope`` implements — so no
-head permutation is needed (unlike HF-format checkpoints).
+transpose from torch [out, in] to [in, out].  Meta's head ORDER is kept
+(query head h = kvh*G + g, no head permutation — unlike HF-format
+checkpoints), but the q/k head_dim FEATURES are permuted from Meta's
+interleaved RoPE pairing to the runtime half-split order
+(``models.llama.rope_permute``; ``split_qkv`` inverts it exactly).
 
 TPU-first differences from the reference:
   * Shards are opened with ``mmap=True`` and tensors are consumed

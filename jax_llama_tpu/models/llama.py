@@ -358,6 +358,33 @@ def split_qkv(
     )
 
 
+def permute_d_axis(lp: Dict[str, Any], to_d_first: bool) -> Dict[str, Any]:
+    """THE current-layout <-> r3 D-first axis contract, in one place
+    (qkv: D between -2 and -4; gate_up: D between -2 and -3) — used by
+    ``fuse_params`` and the checkpoint restore-time migration.
+    QuantizedTensor leaves permute payload AND scale together (the scale
+    keeps size-1 contracted dims in the same axis positions, so the
+    transform is exact for int8 trees too)."""
+    from ..ops.quant import QuantizedTensor
+
+    def mv(x, src, dst):
+        if isinstance(x, QuantizedTensor):
+            return QuantizedTensor(
+                q=jnp.moveaxis(x.q, src, dst),
+                scale=jnp.moveaxis(x.scale, src, dst),
+            )
+        return jnp.moveaxis(x, src, dst)
+
+    lp = dict(lp)
+    if to_d_first:
+        lp["qkv"] = mv(lp["qkv"], -2, -4)
+        lp["gate_up"] = mv(lp["gate_up"], -2, -3)
+    else:
+        lp["qkv"] = mv(lp["qkv"], -4, -2)
+        lp["gate_up"] = mv(lp["gate_up"], -3, -2)
+    return lp
+
+
 def fuse_params(params: Params) -> Params:
     """Migrate an old-layout param tree to the current fused layout:
     either separate q/k/v + gate/up (rounds 1-2 Orbax checkpoints) or the
@@ -372,10 +399,8 @@ def fuse_params(params: Params) -> Params:
                 and lp["gate_up"].shape[-3] == d_model):
             # r3 D-first fused layout: move D to second-from-last.
             # (D == KVH cannot alias: KVH is a head count, D the model dim.)
-            lp["qkv"] = jnp.moveaxis(lp["qkv"], -4, -2)
-            lp["gate_up"] = jnp.moveaxis(lp["gate_up"], -3, -2)
             out = dict(params)
-            out["layers"] = lp
+            out["layers"] = permute_d_axis(lp, to_d_first=False)
             return out
         return params
     lp["qkv"] = fuse_qkv(lp.pop("q"), lp.pop("k"), lp.pop("v"))

@@ -297,9 +297,11 @@ def flash_attention(
     H, KVH = q.shape[2], k.shape[2]
     assert H % KVH == 0, (H, KVH)
     group = H // KVH
+    if not 0.0 <= dropout_rate < 1.0:
+        # Validate BEFORE the >0 branch: a negative rate must raise, not
+        # silently train without dropout.
+        raise ValueError(f"dropout_rate={dropout_rate} not in [0, 1)")
     if dropout_rate > 0.0:
-        if not 0.0 < dropout_rate < 1.0:
-            raise ValueError(f"dropout_rate={dropout_rate} not in [0, 1)")
         if dropout_seed is None:
             raise ValueError("dropout_rate > 0 requires dropout_seed")
         seed = dropout_seed.reshape((1,)).astype(jnp.uint32)

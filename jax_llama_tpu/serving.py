@@ -301,6 +301,28 @@ def warped_probs_rows(
 # Jitted step programs
 # ---------------------------------------------------------------------------
 
+def _kernel_eligible(block_size, mesh, kv_heads, n_rows, draft_config=None):
+    """THE paged-kernel eligibility predicate, shared by the in-jit decode
+    step and the host-side speculative gate so the two cannot drift:
+    Mosaic's 8-sublane tiling on the block axis, and (under a mesh) KV
+    heads dividing `tensor`, rows dividing data*fsdp, no seq/stage axes.
+    """
+    ok = block_size % 8 == 0
+    if mesh is not None:
+        rows = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+        ok = ok and (
+            kv_heads % mesh.shape.get("tensor", 1) == 0
+            and n_rows % rows == 0
+            and mesh.shape.get("seq", 1) == 1
+            and mesh.shape.get("stage", 1) == 1
+        )
+        if draft_config is not None:
+            ok = ok and (
+                draft_config.kv_heads % mesh.shape.get("tensor", 1) == 0
+            )
+    return bool(ok)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -335,19 +357,13 @@ def _paged_decode_step(
     """
     with use_mesh(mesh):
         positions = jnp.where(active, pos, -1)[:, None]
-        # %8: Mosaic's sublane tiling.  Sub-128 (narrow-lane) block sizes
-        # are verified compiled on hardware — bf16 and int8 kernels match
-        # interpret mode exactly at BLK 8/16/32/64/128 on a v5e chip
-        # (regression-tested in tests/test_tpu_compiled.py).
-        use_kernel = allow_kernel and pool.block_size % 8 == 0
-        if mesh is not None:
-            rows = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
-            use_kernel &= (
-                config.kv_heads % mesh.shape.get("tensor", 1) == 0
-                and tau.shape[0] % rows == 0
-                and mesh.shape.get("seq", 1) == 1
-                and mesh.shape.get("stage", 1) == 1
-            )
+        # Sub-128 (narrow-lane) block sizes are verified compiled on
+        # hardware — bf16 and int8 kernels match interpret mode exactly at
+        # BLK 8/16/32/64/128 on a v5e chip (regression-tested in
+        # tests/test_tpu_compiled.py).
+        use_kernel = allow_kernel and _kernel_eligible(
+            pool.block_size, mesh, config.kv_heads, tau.shape[0]
+        )
         if use_kernel:
             pcache = PagedKVCache(
                 k=pool.k, v=pool.v, pos=pool.pos,
@@ -425,7 +441,6 @@ def _paged_insert(
     with use_mesh(mesh):
         k_rows, P = prompt_tokens.shape
         BLK = pool.block_size
-        NB = pool.n_blocks
         sub = init_cache(config, k_rows, max_len=P)
         positions = prompt_positions(prompt_mask)
         chunk = prefill_chunk if prefill_chunk and prefill_chunk < P else P
@@ -1054,26 +1069,14 @@ class ContinuousBatcher:
         return out
 
     def _spec_kernel_ok(self) -> bool:
-        """Same kernel-eligibility gate as _paged_decode_step (the T>1
-        verify adds no constraints: it shards identically)."""
-        ok = self.use_pallas_kernel and self.block_size % 8 == 0
-        if self.mesh is not None:
-            rows = (
-                self.mesh.shape.get("data", 1)
-                * self.mesh.shape.get("fsdp", 1)
-            )
-            ok &= (
-                self.config.kv_heads % self.mesh.shape.get("tensor", 1) == 0
-                and self.n_slots % rows == 0
-                and self.mesh.shape.get("seq", 1) == 1
-                and self.mesh.shape.get("stage", 1) == 1
-            )
-            if self.draft_config is not None:
-                ok &= (
-                    self.draft_config.kv_heads
-                    % self.mesh.shape.get("tensor", 1) == 0
-                )
-        return bool(ok)
+        """Same kernel-eligibility gate as _paged_decode_step — literally:
+        both call ``_kernel_eligible`` (the T>1 verify adds no
+        constraints, it shards identically; the draft model adds its own
+        KV-head divisibility)."""
+        return self.use_pallas_kernel and _kernel_eligible(
+            self.block_size, self.mesh, self.config.kv_heads,
+            self.n_slots, draft_config=self.draft_config,
+        )
 
     def _spec_tail(self, out: List[Tuple[int, int, bool]]) -> None:
         """Speculative remainder of a step: draft + verify, emit the
