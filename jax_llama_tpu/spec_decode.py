@@ -123,6 +123,10 @@ def _greedy(logits: jnp.ndarray) -> jnp.ndarray:
 
 
 def _spec_impl(tp, dp, prompt_tokens, prompt_mask, rng, tc, dc, gc, G):
+    # LOCKSTEP CONTRACT: serving._spec_round mirrors this round's
+    # draft-sampling and Leviathan accept/residual math for in-batcher
+    # speculation (see its docstring); change both together — the
+    # bit-identity is pinned by tests/test_serving_spec.py.
     B, P = prompt_tokens.shape
     N = gc.max_new_tokens
     total = P + N

@@ -252,3 +252,33 @@ def test_prompt_containing_eos_is_not_masked():
     assert not np.array_equal(a[:, 6:], b[:, 6:]), (
         "masking the eos position should change the continuation"
     )
+
+
+def test_generate_beyond_max_seq_len_matches_larger_config():
+    """Long-context decode: a cache longer than config.max_seq_len (the
+    bench's 16k-context path) must behave exactly like a config whose
+    max_seq_len covers the whole generation — RoPE tables are sized by
+    the reachable positions (max(2*max_seq_len, cache.max_len)), so the
+    rotation at every position is identical."""
+    import numpy as np
+
+    small = cfg_lib.tiny(max_seq_len=32)
+    big = small.replace(max_seq_len=128)
+    params = init_params(jax.random.PRNGKey(0), small)
+    B, P, N = 2, 48, 16  # prompt alone exceeds small.max_seq_len
+    rng = np.random.RandomState(3)
+    prompt = jnp.asarray(rng.randint(1, small.vocab_size, (B, P)), jnp.int32)
+    mask = jnp.ones((B, P), bool)
+    gc = GenerationConfig(
+        max_new_tokens=N, temperature=0.0, stop_tokens=(),
+        prefill_chunk=16,
+    )
+    got = np.asarray(generate(
+        params, prompt, mask, jax.random.PRNGKey(0), config=small,
+        gen_config=gc,
+    ))
+    want = np.asarray(generate(
+        params, prompt, mask, jax.random.PRNGKey(0), config=big,
+        gen_config=gc,
+    ))
+    np.testing.assert_array_equal(got, want)
