@@ -513,3 +513,95 @@ def test_http_logprobs(model):
         except urllib.error.HTTPError as e:
             assert e.code == 400
             assert "logprobs" in json.loads(e.read())["error"]
+
+
+def test_http_mixed_concurrent_load(model):
+    """Soak: 12 concurrent clients mixing blocking, streaming, chat, and
+    logprobs requests against a 3-slot batcher — every request completes
+    with a consistent body and the pool drains clean."""
+    params, config = model
+    tok = ByteTokenizer()
+
+    class ByteChatFormat:
+        def __init__(self, t):
+            self.tokenizer = t
+
+        def encode_dialog_prompt(self, dialog):
+            ids = [self.tokenizer.bos_id]
+            for m in dialog:
+                ids += self.tokenizer.encode(f"[{m['role']}]" + m["content"])
+            ids += self.tokenizer.encode("[assistant]")
+            return ids
+
+    cb = ContinuousBatcher(
+        params, config, n_slots=3, max_len=64, logprobs=True
+    )
+    total_blocks = cb.n_blocks
+    with LLMServer(
+        cb, tokenizer=tok, chat_format=ByteChatFormat(tok)
+    ) as srv:
+        results = {}
+
+        def call(i):
+            kind = i % 4
+            try:
+                if kind == 0:      # blocking /generate
+                    status, body = _post(
+                        srv.address,
+                        {"text": f"req {i}", "max_new_tokens": 5},
+                    )
+                    ok = status == 200 and len(body["tokens"]) == 5
+                elif kind == 1:    # streaming /generate + logprobs
+                    lines = _stream_lines(
+                        srv.address,
+                        {"text": f"req {i}", "max_new_tokens": 5,
+                         "stream": True, "logprobs": True},
+                    )
+                    ok = (
+                        lines[-1]["done"] is True
+                        and len(lines[-1]["tokens"]) == 5
+                        and len(lines[-1]["logprobs"]) == 5
+                        and [ln["token"] for ln in lines[:-1]]
+                        == lines[-1]["tokens"]
+                    )
+                elif kind == 2:    # blocking /chat
+                    req = urllib.request.Request(
+                        srv.address + "/chat",
+                        data=json.dumps({
+                            "messages": [
+                                {"role": "user", "content": f"hi {i}"}
+                            ],
+                            "max_new_tokens": 5,
+                        }).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=300) as r:
+                        body = json.loads(r.read())
+                        ok = r.status == 200 and len(body["tokens"]) <= 5
+                else:              # blocking /generate + logprobs
+                    status, body = _post(
+                        srv.address,
+                        {"prompt": [2 + i, 7, 11], "max_new_tokens": 5,
+                         "logprobs": True, "temperature": 0.6,
+                         "seed": i},
+                    )
+                    ok = (
+                        status == 200
+                        and len(body["logprobs"]) == len(body["tokens"]) == 5
+                    )
+                results[i] = ok
+            except Exception as e:  # noqa: BLE001 — fail the test, not the thread
+                results[i] = f"{type(e).__name__}: {e}"
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not any(t.is_alive() for t in threads)
+        assert all(v is True for v in results.values()), results
+
+    # Everything released: full block pool, no occupied slots.
+    assert len(cb.free_blocks) == total_blocks
+    assert all(s is None for s in cb.slots.values())
