@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 from pathlib import Path
 from typing import Any, Optional, Tuple
 
@@ -124,7 +125,20 @@ def _saved_layout(ckptr, item_path: Path, config: LLaMAConfig) -> str:
         if isinstance(qkv_md, dict):  # QuantizedTensor: {q, scale} subtree
             qkv_md = qkv_md["q"]
         qkv_shape = tuple(qkv_md.shape)
-    except Exception:
+    except Exception as e:
+        # Fall back to "current", but say so: if the checkpoint really is
+        # a legacy layout whose metadata read transiently failed, the
+        # restore below will die with an Orbax shape mismatch — this line
+        # is what points the reader at the metadata problem instead of at
+        # a "corrupt checkpoint".
+        logging.getLogger(__name__).warning(
+            "checkpoint layout detection skipped (metadata read failed: "
+            "%s: %s); assuming current layout — if restore now fails "
+            "with a shape mismatch, the checkpoint may be a legacy "
+            "layout whose metadata could not be read",
+            type(e).__name__,
+            e,
+        )
         return "current"
     if len(qkv_shape) == 5 and qkv_shape[1] == config.dim:
         return "d_first"

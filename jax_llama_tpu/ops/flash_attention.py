@@ -71,6 +71,17 @@ def _dropout_keep(seed, b, h, qi, ki, bq, bk, rate):
     element (r, c) keeps with probability 1 - rate.  Three mixes: one per
     (batch, head), one per row [bq, 1], one elementwise [bq, bk] — the
     per-element VPU cost is a handful of integer ops.
+
+    Row and column enter the element hash JOINTLY (xor of the mixed row
+    word with the odd-multiplied column, not ``mix(row_word + col)``):
+    an additive column would make every row a shifted window into one
+    1-D keep sequence, so row pairs whose mixed words land within S of
+    each other would share diagonal runs of mask bits.  Remaining
+    statistical caveat (documented, accepted): the per-call seed is a
+    single uint32, so across ~65k training steps per layer seeds
+    birthday-collide and those steps reuse a mask plane; this biases
+    long-horizon mask statistics only — fwd/bwd bit-consistency and
+    per-step correctness are unaffected.
     """
     base = _mix32(
         seed
@@ -86,7 +97,7 @@ def _dropout_keep(seed, b, h, qi, ki, bq, bk, rate):
     cols = jax.lax.broadcasted_iota(jnp.uint32, (1, bk), 1) + (
         ki * bk
     ).astype(jnp.uint32)
-    bits = _mix32(_mix32(base + rows) + cols)  # [bq, bk]
+    bits = _mix32(_mix32(base ^ rows) ^ (cols * jnp.uint32(0x9E3779B9)))
     threshold = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
     return bits >= threshold
 

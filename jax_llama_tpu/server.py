@@ -85,6 +85,10 @@ class _Pending:
     # /chat request: dialog framing on submit, stop ids stripped from the
     # decoded text fields.
     chat: bool = False
+    # Client sent its own "stop_tokens": the tokenizer's stop set is no
+    # longer protocol framing for this request, so _visible must not
+    # strip it from decoded text (it may legitimately appear mid-stream).
+    stops_overridden: bool = False
     # "logprobs": true — per-token model logprobs in the response
     # (requires the batcher to be constructed with logprobs=True).
     want_lp: bool = False
@@ -261,7 +265,7 @@ class LLMServer:
                     out["logprobs"] = pending.lps
                 if server.tokenizer is not None:
                     out["text"] = server.tokenizer.decode(
-                        server._visible(pending.tokens, pending.chat)
+                        server._visible(pending.tokens, pending)
                     )
                 self._reply_json(200, out)
 
@@ -306,7 +310,7 @@ class LLMServer:
                         line["logprob"] = lp
                     if server.tokenizer is not None:
                         line["text"] = server.tokenizer.decode(
-                            server._visible([tok], pending.chat)
+                            server._visible([tok], pending)
                         )
                     if not emit(line):
                         return  # client gone; the loop reaps the request
@@ -354,11 +358,14 @@ class LLMServer:
 
     # -- serving loop (sole owner of the batcher) ---------------------------
 
-    def _visible(self, tokens: List[int], chat: bool) -> List[int]:
-        """Tokens to DECODE for a reply: /chat strips the stop ids (the
-        eot/eos framing is protocol, not assistant text); /generate
-        returns everything verbatim."""
-        if not chat:
+    def _visible(self, tokens: List[int], p: "_Pending") -> List[int]:
+        """Tokens to DECODE for a reply: /chat strips the tokenizer's stop
+        ids (the eot/eos framing is protocol, not assistant text);
+        /generate returns everything verbatim.  A /chat request that sent
+        its own "stop_tokens" is also verbatim — the tokenizer's stop set
+        is not framing for it, and a mid-stream eot the client asked to
+        generate past must survive into "text"."""
+        if not p.chat or p.stops_overridden:
             return list(tokens)
         stops = set(getattr(self.tokenizer, "stop_tokens", None) or ())
         return [t for t in tokens if t not in stops]
@@ -420,6 +427,7 @@ class LLMServer:
             kwargs["stop_tokens"] = tuple(
                 int(t) for t in payload["stop_tokens"]
             )
+            p.stops_overridden = True
         elif p.chat:
             # Dialog completions stop at the tokenizer's stop set
             # (llama3: end_of_text + eot_id) unless overridden.

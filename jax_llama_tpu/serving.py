@@ -555,7 +555,7 @@ def _spec_round(
     Returns (outs [B, G+1], acc [B], carried keys [B, 2], pools): the
     host emits ``outs[:acc+1]`` per row and rewinds fill to +acc+1, so
     rejected drafts cost no pool capacity.
-    
+
     LOCKSTEP CONTRACT: the draft-sampling and Leviathan accept/residual
     math here mirrors ``spec_decode._spec_impl`` (same 4-way key split
     topology, accept rule u*q < p, residual max(p-q, 0) resample with the

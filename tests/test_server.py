@@ -418,6 +418,29 @@ def test_http_chat_endpoint(model):
             if ln["token"] in stop_set:
                 assert ln["text"] == ""  # protocol framing, not content
 
+        # A /chat that sends its own "stop_tokens" decodes verbatim: the
+        # tokenizer's stop set is not protocol framing for that request,
+        # so a stop id the client generated past must survive in "text"
+        # (it still appears in "tokens" either way).
+        ref2 = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+        rid2 = ref2.submit(
+            fmt.encode_dialog_prompt(messages), max_new_tokens=8,
+            stop_tokens=(),
+        )
+        want2 = ref2.run_to_completion()[rid2]
+        req = urllib.request.Request(
+            srv.address + "/chat",
+            data=json.dumps(
+                {"messages": messages, "max_new_tokens": 8,
+                 "stop_tokens": []}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            body = json.loads(r.read())
+        assert body["tokens"] == want2
+        assert body["text"] == tok.decode(want2)  # verbatim, stop ids kept
+
         # Malformed dialogs are 400s, not loop crashes.
         for bad in (
             {},
