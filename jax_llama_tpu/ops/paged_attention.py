@@ -218,9 +218,10 @@ def paged_pool_attention(
     probability-level for V) — the pool streams at one byte per element
     plus fp32 scales.
 
-    Returns (out [B, KVH, T*G, d] normalized over the pool slots,
+    Returns (out [B, KVH, T*G, d] fp32, normalized over the pool slots,
     lse [B, KVH, T*G] fp32 row logsumexp) for the caller's
-    new-token merge.
+    new-token merge (fp32 end-to-end through the merge — see the
+    out_shape note in the kernel call).
     """
     B, KVH, TG, d = q.shape
     NB, BLK = pool_pos.shape
@@ -320,7 +321,14 @@ def paged_pool_attention(
             ],
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((B, KVH, TG8, d), q.dtype),
+            # fp32: the caller's new-token merge rescales this by
+            # exp(lse - m_tot) and divides by the joint denominator — a
+            # bf16 round HERE is one more rounding than the gathered
+            # path's single joint softmax takes, and it measurably
+            # widens the T=1-vs-T=G+1 numerical gap that flips greedy
+            # argmax at near-ties (speculative self-draft acceptance).
+            # Decode-sized output: the extra bytes are noise.
+            jax.ShapeDtypeStruct((B, KVH, TG8, d), jnp.float32),
             jax.ShapeDtypeStruct((B, KVH, TG8, _LANES), jnp.float32),
         ),
         compiler_params=pltpu.CompilerParams(

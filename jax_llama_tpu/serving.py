@@ -28,8 +28,9 @@ TPU-native mechanics:
     block table directly (scalar prefetch), so the pool is read ONCE per
     step and no contiguous view is ever materialized (int8 pools fold
     their dequant scales in-kernel).  Speculative rounds run the same
-    kernel: T=1 paged steps for the draft chain and ONE multi-token
-    (T = n_draft+1) kernel pass for the verify.  A gathered-view
+    kernel, always at the verify shape: every draft-chain step replays
+    the growing block through one T = n_draft+1 multi-token pass over
+    the base pool, and the verify is one more.  A gathered-view
     fallback (per-row virtually-contiguous cache + the model's
     per-row-offset forward) remains for kernel-incompatible meshes
     (kv_heads % tensor != 0, n_slots % (data*fsdp) != 0, or active
@@ -54,6 +55,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from .config import LLaMAConfig
 from .engine import prompt_positions
@@ -66,6 +68,7 @@ from .models.llama import (
 )
 from .ops.attention import NEG_INF
 from .parallel.mesh import use_mesh
+from .spec_decode import draft_categorical, leviathan_verify, place_extra
 
 
 # ---------------------------------------------------------------------------
@@ -518,7 +521,7 @@ def _cache_into_pool(pool: BlockPool, pcache: PagedKVCache) -> BlockPool:
     jax.jit,
     static_argnames=(
         "t_config", "d_config", "n_draft", "all_greedy", "use_kernel",
-        "mesh",
+        "mesh", "with_logprobs",
     ),
     donate_argnames=("t_pool", "d_pool"),
 )
@@ -526,6 +529,7 @@ def _spec_round(
     t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau, pos,
     active, keys, temperature, top_p, top_k, *,
     t_config, d_config, n_draft, all_greedy, use_kernel, mesh=None,
+    with_logprobs=False,
 ):
     """One speculative round for every active slot — greedy or sampled
     verification, per-row policies.
@@ -537,33 +541,32 @@ def _spec_round(
     table/fill serves the two pools.
 
     ``use_kernel`` (static) routes every forward through the Pallas
-    paged-attention kernel: the draft chain runs T=1 paged steps and the
-    verify is one T=G+1 multi-token kernel pass, so neither pool is ever
-    gathered into a contiguous view (the gathered path moved both pools'
-    bytes 3× per round).  The gathered fallback remains for
-    kernel-incompatible meshes / block sizes.
+    paged-attention kernel, always at the verify shape: each draft-chain
+    step is one T=G+1 multi-token kernel pass replaying the growing
+    block over the BASE pool, and the verify is one more — so neither
+    pool is ever gathered into a contiguous view (the gathered path
+    moved both pools' bytes 3× per round).  The gathered fallback
+    remains for kernel-incompatible meshes / block sizes.
 
     ``all_greedy`` (static) compiles the pure-argmax verification with no
     RNG traffic.  Otherwise verification is per-row Leviathan rejection
-    sampling (``spec_decode``'s math with traced per-row policies): each
-    sampled row consumes its own key chain exactly as a standalone B=1
-    seeded ``generate_speculative`` of that request would — same split
-    topology, same warp math — so its emitted tokens are bit-identical;
-    greedy rows (temperature 0) take the exact-argmax path inside the
-    same program.
+    sampling — the SAME ``spec_decode.leviathan_verify`` /
+    ``draft_categorical`` / ``place_extra`` implementation the standalone
+    engine traces, with traced per-row policies and per-row key chains
+    (vmapped draws): each sampled row consumes its keys exactly as a
+    standalone B=1 seeded ``generate_speculative`` of that request would
+    — same split topology, same warp math — so its emitted tokens are
+    bit-identical (pinned by tests/test_serving_spec.py); greedy rows
+    (temperature 0) take the exact-argmax path inside the same program.
 
-    Returns (outs [B, G+1], acc [B], carried keys [B, 2], pools): the
-    host emits ``outs[:acc+1]`` per row and rewinds fill to +acc+1, so
-    rejected drafts cost no pool capacity.
-
-    LOCKSTEP CONTRACT: the draft-sampling and Leviathan accept/residual
-    math here mirrors ``spec_decode._spec_impl`` (same 4-way key split
-    topology, accept rule u*q < p, residual max(p-q, 0) resample with the
-    1e-12 mass fallback) — that is what makes a sampled slot emit
-    bit-identically to its standalone seeded ``generate_speculative``.
-    Change either copy only together; the equivalence is pinned by
-    tests/test_serving_spec.py (sampled-slot bit-identity).
-
+    Returns (outs [B, G+1], acc [B], lps, carried keys [B, 2], pools):
+    the host emits ``outs[:acc+1]`` per row and rewinds fill to +acc+1,
+    so rejected drafts cost no pool capacity.  ``with_logprobs`` (static)
+    additionally returns lps [B, G+1] — the fp32 log-softmax of the raw
+    TARGET logits at each emitted offset (``_token_logprob``'s
+    definition; the verify pass already computes every position's
+    logits, so this is one gather + logsumexp, no extra forward) —
+    otherwise lps is None.
     """
     G = n_draft
     B = tau.shape[0]
@@ -581,73 +584,96 @@ def _spec_round(
                 splits[:, 0], splits[:, 1], splits[:, 2], splits[:, 3]
             )
 
-        if use_kernel:
-            d_state = d_pool
-        else:
+        if not use_kernel:
             t_view = _gather_cache(t_pool, table, n_alloc, fill)
-            d_state = _gather_cache(d_pool, table, n_alloc, fill)
+            d_view = _gather_cache(d_pool, table, n_alloc, fill)
 
-        # --- 1. draft chain: propose d_1 .. d_G ---
-        def draft_one(carry, j):
-            state, tok, kd = carry
-            pp = jnp.where(active, pos + j, -1)[:, None]
+        # --- 1. draft chain: propose d_1 .. d_G by REPLAYING the block ---
+        # Every chain step re-processes the growing block
+        # [tau, d_1..d_j, pads] through ONE verify-shaped T=G+1 forward
+        # over the BASE pool (read-only — fill unchanged, returned cache
+        # discarded, so the writes are dead code XLA eliminates): token
+        # j's logits come from the same program shape and the same
+        # softmax source split (pool slots via the kernel ∪ in-step
+        # tokens via the merge) as the target verify below.  In
+        # self-draft the chain is then the SAME compiled function of the
+        # same pool bytes as the verify, so greedy acceptance is exact —
+        # the r3 T=1 incremental chain's tile shapes wobbled ~1 bf16
+        # ulp/layer against the T=G+1 verify (shape-dependent merge
+        # einsum tilings; the pool kernel itself is bit-exact across T),
+        # flipping near-tie argmaxes: measured 0.92-0.95 kernel-path
+        # acceptance vs 0.97-0.99 gathered.  Cost is a wash: G drafting
+        # forwards + one KV-landing pass (below) replaces G incremental
+        # steps + the d_G catch-up step, and the kernel's padded query
+        # tile (TG8) is the same geometry for T=1 and T=G+1.
+        jj = jnp.arange(G + 1, dtype=jnp.int32)[None, :]
+        block_pos = jnp.where(
+            active[:, None], pos[:, None] + jj, -1
+        ).astype(jnp.int32)
+        block0 = jnp.concatenate(
+            [tau[:, None], jnp.zeros((B, G), jnp.int32)], axis=1
+        )
+
+        def draft_step(carry, j):
+            buf, kd = carry
+            # The WHOLE block runs live every step (positions consecutive,
+            # mask uniform — paged_forward's T>1 contract; mixed-liveness
+            # rows would be folded to inactive).  Correctness: row j
+            # attends only tokens 0..j (causal), so the not-yet-drafted
+            # placeholder tokens beyond j cannot reach row j's logits —
+            # and the uniform mask makes each chain step the literally
+            # identical program to the verify pass below.
+            step_mask = jnp.broadcast_to(active[:, None], buf.shape)
             if use_kernel:
-                pcache = _pool_as_cache(state, table, fill + j)
-                lg, pcache = forward(
-                    d_params, tok[:, None], pp, d_config, cache=pcache,
-                    attn_mask=active[:, None],
+                pcache = _pool_as_cache(d_pool, table, fill)
+                lg, _ = forward(
+                    d_params, buf, block_pos, d_config, cache=pcache,
+                    attn_mask=step_mask,
                 )
-                state = _cache_into_pool(state, pcache)
             else:
-                lg, state = forward(
-                    d_params, tok[:, None], pp, d_config, cache=state,
-                    attn_mask=active[:, None],
+                lg, _ = forward(
+                    d_params, buf, block_pos, d_config, cache=d_view,
+                    attn_mask=step_mask,
                 )
-            greedy_nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+            lgj = lax.dynamic_slice_in_dim(lg, j, 1, axis=1)[:, 0]  # [B, V]
+            greedy_nxt = jnp.argmax(lgj, axis=-1).astype(jnp.int32)
             if all_greedy:
                 nxt = greedy_nxt
                 q = jnp.zeros((B, V), jnp.float32)  # unused
             else:
-                # Mirror of _spec_impl.draft_one: key, sub = split(key);
-                # categorical(sub, log(q + 1e-30)).
+                # Row-wise _spec_impl.draft_one: key, sub = split(key);
+                # draft_categorical(sub, q).
                 kd, sub = _split_rows(kd)
-                q = warped_probs_rows(lg[:, -1], temperature, top_p, top_k)
-                sampled_nxt = jax.vmap(
-                    lambda key, row: jax.random.categorical(
-                        key, jnp.log(row + 1e-30)
-                    )
-                )(sub, q).astype(jnp.int32)
+                q = warped_probs_rows(lgj, temperature, top_p, top_k)
+                sampled_nxt = jax.vmap(draft_categorical)(sub, q)
                 nxt = jnp.where(temperature <= 0.0, greedy_nxt, sampled_nxt)
-            return (state, nxt, kd), (nxt, q)
+            buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, j + 1))
+            return (buf, kd), q
 
-        (d_state, d_last, _), (drafts, qprobs) = jax.lax.scan(
-            draft_one, (d_state, tau, k_draft),
-            jnp.arange(G, dtype=jnp.int32),
+        (block, _), qprobs = jax.lax.scan(
+            draft_step, (block0, k_draft), jnp.arange(G, dtype=jnp.int32)
         )
-        drafts = jnp.swapaxes(drafts, 0, 1)  # [B, G]
-        qprobs = jnp.swapaxes(qprobs, 0, 1)  # [B, G, V]
-        # Catch-up: land d_G's KV so a fully-accepted round leaves no hole
-        # at pos+G (same reasoning as generate_speculative's extra forward).
-        pp_g = jnp.where(active, pos + G, -1)[:, None]
+        drafts = block[:, 1:]                 # [B, G]
+        qprobs = jnp.swapaxes(qprobs, 0, 1)   # [B, G, V]
+        # Land the block's KV in the draft pool: one verify-shaped pass
+        # (replaces the old per-step writes + d_G catch-up step).
         if use_kernel:
-            pcache = _pool_as_cache(d_state, table, fill + G)
+            pcache = _pool_as_cache(d_pool, table, fill)
             _, pcache = forward(
-                d_params, d_last[:, None], pp_g, d_config, cache=pcache,
-                attn_mask=active[:, None], compute_logits=False,
+                d_params, block, block_pos, d_config, cache=pcache,
+                attn_mask=jnp.broadcast_to(active[:, None], block.shape),
+                compute_logits=False,
             )
-            d_pool = _cache_into_pool(d_state, pcache)
+            d_pool = _cache_into_pool(d_pool, pcache)
         else:
-            _, d_state = forward(
-                d_params, d_last[:, None], pp_g, d_config, cache=d_state,
-                attn_mask=active[:, None], compute_logits=False,
+            _, d_view = forward(
+                d_params, block, block_pos, d_config, cache=d_view,
+                attn_mask=jnp.broadcast_to(active[:, None], block.shape),
+                compute_logits=False,
             )
 
         # --- 2. one target pass over [tau, d_1 .. d_G] ---
-        block = jnp.concatenate([tau[:, None], drafts], axis=1)
-        j = jnp.arange(G + 1, dtype=jnp.int32)[None, :]
-        block_pos = jnp.where(
-            active[:, None], pos[:, None] + j, -1
-        ).astype(jnp.int32)
+        j = jj
         if use_kernel:
             # The T=G+1 multi-token kernel pass: the target pool streams
             # ONCE for the whole verify.
@@ -672,42 +698,26 @@ def _spec_round(
         if all_greedy:
             outs, acc = greedy_outs, greedy_acc
         else:
-            # Per-row Leviathan rejection sampling (spec_decode._spec_impl
-            # with traced policies); greedy rows selected per-row below.
+            # Per-row Leviathan rejection sampling — the shared
+            # spec_decode core with traced policies and vmapped draws;
+            # greedy rows selected per-row below.
             pprobs = warped_probs_rows(t_logits, temperature, top_p, top_k)
-            p_d = jnp.take_along_axis(
-                pprobs[:, :G], drafts[..., None], axis=-1
-            )[..., 0]
-            q_d = jnp.take_along_axis(
-                qprobs, drafts[..., None], axis=-1
-            )[..., 0]
             u = jax.vmap(lambda k: jax.random.uniform(k, (G,)))(k_accept)
-            accept = u * q_d < p_d
-            acc_s = jnp.sum(
-                jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
-            )
-            resid = jnp.maximum(pprobs[:, :G] - qprobs, 0.0)
-            cand = jnp.concatenate([resid, pprobs[:, G:]], axis=1)
-            dist = jnp.take_along_axis(
-                cand, acc_s[:, None, None], axis=1
-            )[:, 0]
-            mass = jnp.sum(dist, axis=-1, keepdims=True)
-            p_at = jnp.take_along_axis(
-                pprobs, acc_s[:, None, None], axis=1
-            )[:, 0]
-            dist = jnp.where(mass > 1e-12, dist, p_at)
-            extra = jax.vmap(
-                lambda key, row: jax.random.categorical(
-                    key, jnp.log(row + 1e-30)
-                )
-            )(k_extra, dist).astype(jnp.int32)
-            outs_s = jnp.concatenate(
-                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1
-            )
-            outs_s = outs_s.at[jnp.arange(B), acc_s].set(extra)
+            acc_s, dist = leviathan_verify(pprobs, qprobs, drafts, u)
+            extra = jax.vmap(draft_categorical)(k_extra, dist)
+            outs_s = place_extra(drafts, acc_s, extra)
             is_greedy = temperature <= 0.0
             outs = jnp.where(is_greedy[:, None], greedy_outs, outs_s)
             acc = jnp.where(is_greedy, greedy_acc, acc_s)
+
+        if with_logprobs:
+            # t_logits[:, j] is the target's raw distribution the token
+            # emitted at offset j was drawn/verified from.
+            lps = _token_logprob(
+                t_logits.reshape(B * (G + 1), V), outs.reshape(-1)
+            ).reshape(B, G + 1)
+        else:
+            lps = None
 
         # --- 4. commit: invalidate rejected slots.  Slot j holds
         # block[j] (= tau for j=0, d_j after), valid iff j <= acc; the
@@ -735,8 +745,8 @@ def _spec_round(
                 pos=t_view.pos.at[rows, cols].set(patched, mode="drop"),
             )
             d_view = dataclasses.replace(
-                d_state,
-                pos=d_state.pos.at[rows, cols].set(patched, mode="drop"),
+                d_view,
+                pos=d_view.pos.at[rows, cols].set(patched, mode="drop"),
             )
             t_pool = _scatter_back(
                 t_pool, t_view, table, fill, active, T=G + 1
@@ -744,7 +754,7 @@ def _spec_round(
             d_pool = _scatter_back(
                 d_pool, d_view, table, fill, active, T=G + 1
             )
-        return outs, acc, keys_out, t_pool, d_pool
+        return outs, acc, lps, keys_out, t_pool, d_pool
 
 
 # ---------------------------------------------------------------------------
@@ -833,13 +843,6 @@ class ContinuousBatcher:
             )
         self.spec = draft_params is not None
         self.logprobs = logprobs
-        if logprobs and self.spec:
-            raise NotImplementedError(
-                "logprobs + speculative decoding is not implemented (the "
-                "verify pass would need to thread per-accepted-token "
-                "logprobs through the rejection rounds); use logprobs "
-                "with a plain batcher or spec without logprobs"
-            )
         if self.spec:
             if draft_config is None:
                 raise ValueError("draft_params requires draft_config")
@@ -1087,12 +1090,12 @@ class ContinuousBatcher:
             self.n_slots, draft_config=self.draft_config,
         )
 
-    def _spec_tail(self, out: List[Tuple[int, int, bool]]) -> None:
+    def _spec_tail(self, out: List[Tuple]) -> None:
         """Speculative remainder of a step: draft + verify, emit the
-        accepted prefix (appended to ``out``), rewind fills past rejected
-        slots."""
+        accepted prefix (appended to ``out``, with per-token logprobs
+        when ``logprobs=True``), rewind fills past rejected slots."""
         all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
-        outs, acc, self.keys, self.pool, self.draft_pool = _spec_round(
+        outs, acc, lps, self.keys, self.pool, self.draft_pool = _spec_round(
             self.params, self.draft_params, self.pool, self.draft_pool,
             jnp.array(self.table), jnp.array(self.n_alloc),
             jnp.array(self.fill), self.tau, jnp.array(self.pos),
@@ -1102,9 +1105,12 @@ class ContinuousBatcher:
             t_config=self.config, d_config=self.draft_config,
             n_draft=self.n_draft, all_greedy=all_greedy,
             use_kernel=self._spec_kernel_ok(), mesh=self.mesh,
+            with_logprobs=self.logprobs,
         )
         outs = np.asarray(outs)
         acc = np.asarray(acc)
+        if self.logprobs:
+            lps = np.asarray(lps)
         new_tau = np.zeros((self.n_slots,), np.int32)
         for b, slot in self.slots.items():
             if slot is None:
@@ -1124,13 +1130,23 @@ class ContinuousBatcher:
                     tok in slot.stop_tokens
                     or len(slot.emitted) >= slot.max_new
                 )
-                out.append((slot.request_id, tok, done))
+                if self.logprobs:
+                    out.append((
+                        slot.request_id, tok, done, float(lps[b, i])
+                    ))
+                else:
+                    out.append((slot.request_id, tok, done))
                 if done:
                     break
             if done:
                 self._free_slot(b)
             else:
                 new_tau[b] = outs[b, a]
+                if self.logprobs:
+                    # The pending token's logprob travels with it: emitted
+                    # at the next step() from tau_lp, exactly like the
+                    # plain batcher's sampled-but-unemitted tau.
+                    self.tau_lp[b] = float(lps[b, a])
                 self.fill[b] += a + 1
                 self.pos[b] += a + 1
         self.tau = jnp.asarray(new_tau)

@@ -122,11 +122,75 @@ def _greedy(logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# Shared speculative math
+#
+# ONE implementation of the Leviathan draft-draw / accept / residual rules,
+# traced into both jit contexts that need it: the standalone engine below
+# (B-wide keys, static policies) and the serving batcher's ``_spec_round``
+# (per-row key chains, traced per-row policies, vmapped draws).  Sharing the
+# math is what makes a sampled serving slot emit bit-identically to a
+# standalone B=1 seeded ``generate_speculative`` of the same request — the
+# equivalence is pinned by tests/test_serving_spec.py.
+# ---------------------------------------------------------------------------
+
+def draft_categorical(key, probs):
+    """One categorical draw from a post-warp distribution — the draft
+    proposal and replacement/bonus draw.  ``log(probs + 1e-30)`` keeps
+    zero-probability (warped-out) tokens unreachable without -inf NaN
+    traps.  Works B-wide (probs [B, V], one key) and under vmap (probs
+    [V], per-row key) — ``jax.random.categorical`` draws the same bits
+    for both shapes, which the serving bit-identity relies on."""
+    return jax.random.categorical(
+        key, jnp.log(probs + 1e-30), axis=-1
+    ).astype(jnp.int32)
+
+
+def leviathan_verify(pprobs, qprobs, drafts, u):
+    """Leviathan-style rejection of a drafted block.
+
+    pprobs: [B, G+1, V] post-warp target distributions (position j is the
+      distribution AFTER consuming block token j, i.e. the one draft j+1
+      was checked against; position G is the bonus distribution).
+    qprobs: [B, G, V] post-warp draft distributions.
+    drafts: [B, G] proposed tokens.  u: [B, G] uniforms.
+
+    Draft ``d ~ q`` is accepted iff ``u * q(d) < p(d)`` (probability
+    min(1, p/q)); ``acc`` is the length of the accepted prefix.  Returns
+    (acc [B], dist [B, V]) where ``dist`` is the distribution for the
+    token at offset ``acc``: the residual ``norm(relu(p - q))`` at the
+    first rejection, or the bonus ``p_G`` on full acceptance.  Residual
+    mass 0 means p <= q everywhere (p == q): rejection was probability-0
+    but float rounding can reach it — fall back to p.
+    """
+    G = drafts.shape[1]
+    p_d = jnp.take_along_axis(
+        pprobs[:, :G], drafts[..., None], axis=-1
+    )[..., 0]  # [B, G]
+    q_d = jnp.take_along_axis(qprobs, drafts[..., None], axis=-1)[..., 0]
+    accept = u * q_d < p_d
+    acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    resid = jnp.maximum(pprobs[:, :G] - qprobs, 0.0)  # [B, G, V]
+    cand = jnp.concatenate([resid, pprobs[:, G:]], axis=1)
+    dist = jnp.take_along_axis(cand, acc[:, None, None], axis=1)[:, 0]
+    mass = jnp.sum(dist, axis=-1, keepdims=True)
+    p_at = jnp.take_along_axis(pprobs, acc[:, None, None], axis=1)[:, 0]
+    dist = jnp.where(mass > 1e-12, dist, p_at)
+    return acc, dist
+
+
+def place_extra(drafts, acc, extra):
+    """Emitted block [B, G+1]: accepted drafts at offsets j < acc, the
+    replacement/bonus token at offset acc (offsets past acc are dead —
+    callers only consume outs[:, :acc+1])."""
+    B = drafts.shape[0]
+    outs = jnp.concatenate(
+        [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1
+    )
+    return outs.at[jnp.arange(B), acc].set(extra)
+
+
 def _spec_impl(tp, dp, prompt_tokens, prompt_mask, rng, tc, dc, gc, G):
-    # LOCKSTEP CONTRACT: serving._spec_round mirrors this round's
-    # draft-sampling and Leviathan accept/residual math for in-batcher
-    # speculation (see its docstring); change both together — the
-    # bit-identity is pinned by tests/test_serving_spec.py.
     B, P = prompt_tokens.shape
     N = gc.max_new_tokens
     total = P + N
@@ -188,8 +252,7 @@ def _spec_impl(tp, dp, prompt_tokens, prompt_mask, rng, tc, dc, gc, G):
             if sampled:
                 key, sub = jax.random.split(key)
                 q = warped_probs(lg[:, -1], gc.temperature, gc.top_p, gc.top_k)
-                nxt = jax.random.categorical(sub, jnp.log(q + 1e-30), axis=-1)
-                nxt = nxt.astype(jnp.int32)
+                nxt = draft_categorical(sub, q)
             else:
                 q = jnp.zeros((B, dc.vocab_size), jnp.float32)  # unused
                 nxt = _greedy(lg[:, -1])
@@ -221,47 +284,17 @@ def _spec_impl(tp, dp, prompt_tokens, prompt_mask, rng, tc, dc, gc, G):
         )
         # --- 3. verification ---
         if sampled:
-            # Leviathan rejection sampling.  pprobs/qprobs are both
-            # post-warp, so acceptance min(1, p/q) + residual resampling
-            # reproduce the target's sampled distribution exactly.
+            # Leviathan rejection sampling (shared core).  pprobs/qprobs
+            # are both post-warp, so acceptance min(1, p/q) + residual
+            # resampling reproduce the target's sampled distribution
+            # exactly.
             pprobs = warped_probs(
                 t_logits, gc.temperature, gc.top_p, gc.top_k
             )  # [B, G+1, V]
-            p_d = jnp.take_along_axis(
-                pprobs[:, :G], drafts[..., None], axis=-1
-            )[..., 0]  # [B, G]
-            q_d = jnp.take_along_axis(
-                qprobs, drafts[..., None], axis=-1
-            )[..., 0]
             u = jax.random.uniform(k_accept, (B, G))
-            accept = u * q_d < p_d
-            acc = jnp.sum(
-                jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
-            )
-            # Replacement dist at the first rejection, bonus dist (= p_G)
-            # on full acceptance; index both with acc in one gather.
-            resid = jnp.maximum(pprobs[:, :G] - qprobs, 0.0)  # [B, G, V]
-            cand = jnp.concatenate([resid, pprobs[:, G:]], axis=1)
-            dist = jnp.take_along_axis(
-                cand, acc[:, None, None], axis=1
-            )[:, 0]  # [B, V]
-            mass = jnp.sum(dist, axis=-1, keepdims=True)
-            p_at = jnp.take_along_axis(
-                pprobs, acc[:, None, None], axis=1
-            )[:, 0]
-            # Residual mass 0 means p <= q everywhere (p == q): rejection
-            # was probability-0 but float rounding can reach here — fall
-            # back to p.
-            dist = jnp.where(mass > 1e-12, dist, p_at)
-            extra = jax.random.categorical(
-                k_extra, jnp.log(dist + 1e-30), axis=-1
-            ).astype(jnp.int32)
-            # outs[:, j] = emitted token at offset j: accepted drafts for
-            # j < acc, the replacement/bonus at j == acc.
-            outs = jnp.concatenate(
-                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1
-            )
-            outs = outs.at[jnp.arange(B), acc].set(extra)
+            acc, dist = leviathan_verify(pprobs, qprobs, drafts, u)
+            extra = draft_categorical(k_extra, dist)
+            outs = place_extra(drafts, acc, extra)
         else:
             outs = _greedy(t_logits)  # [B, G+1]; outs[:, j] follows block[:, j]
             # Accept the matching draft prefix (+1 correction/bonus).

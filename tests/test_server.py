@@ -538,6 +538,37 @@ def test_http_logprobs(model):
             assert "logprobs" in json.loads(e.read())["error"]
 
 
+def test_http_logprobs_with_speculative_batcher(model):
+    """"logprobs": true works over a speculative batcher (self-draft):
+    the tokens match a plain batcher's and each gets a finite logprob —
+    the verify pass supplies logprobs for multi-token emission."""
+    import math
+
+    params, config = model
+    tok = ByteTokenizer()
+    plain = ContinuousBatcher(params, config, n_slots=1, max_len=64)
+    prid = plain.submit(tok.encode("hello", bos=True, eos=False),
+                        max_new_tokens=8)
+    want = plain.run_to_completion()[prid]
+
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, logprobs=True,
+        draft_params=params, draft_config=config, n_draft=3,
+    )
+    with LLMServer(cb, tokenizer=tok) as srv:
+        status, body = _post(
+            srv.address,
+            {"text": "hello", "max_new_tokens": 8, "logprobs": True},
+        )
+        assert status == 200
+        assert body["tokens"] == want
+        assert len(body["logprobs"]) == 8
+        assert all(
+            isinstance(x, float) and x <= 0.0 and math.isfinite(x)
+            for x in body["logprobs"]
+        )
+
+
 def test_http_mixed_concurrent_load(model):
     """Soak: 12 concurrent clients mixing blocking, streaming, chat, and
     logprobs requests against a 3-slot batcher — every request completes

@@ -326,11 +326,3 @@ def test_logprobs_match_engine_score():
         np.testing.assert_allclose(lps[rid], want, atol=1e-4, rtol=1e-4)
 
 
-def test_logprobs_spec_refusal():
-    config = get_config("tiny", **CFG)
-    params = init_params(jax.random.PRNGKey(0), config)
-    with pytest.raises(NotImplementedError, match="logprobs"):
-        ContinuousBatcher(
-            params, config, n_slots=2, max_len=64, logprobs=True,
-            draft_params=params, draft_config=config, n_draft=2,
-        )

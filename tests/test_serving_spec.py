@@ -139,6 +139,54 @@ def test_spec_batcher_sampled_matches_standalone(models):
     assert results[r0] == want
 
 
+def test_spec_batcher_logprobs_match_engine_score(models):
+    """logprobs=True composes with speculative decoding: every emitted
+    token's logprob equals ``engine.score``'s teacher-forced
+    log p(token | prefix) at the same position — for greedy AND sampled
+    slots, whether the token was emitted from an accepted draft prefix,
+    a rejection replacement/bonus, or the carried tau.  Tokens themselves
+    stay identical to the logprobs=False batcher (the logprob read is
+    pure observation)."""
+    import jax.numpy as jnp
+
+    from jax_llama_tpu.engine import score
+
+    params, config, draft_params, draft_config = models
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 128, size=n).tolist() for n in (6, 9)]
+
+    def run(logprobs):
+        cb = ContinuousBatcher(
+            params, config, n_slots=2, max_len=64, logprobs=logprobs,
+            draft_params=draft_params, draft_config=draft_config,
+            n_draft=3,
+        )
+        r0 = cb.submit(prompts[0], max_new_tokens=8)  # greedy
+        r1 = cb.submit(
+            prompts[1], max_new_tokens=8, temperature=0.7, top_p=0.9,
+            seed=7,
+        )
+        got, lps = {}, {}
+        while cb.pending():
+            for rid, tok, done, *rest in cb.step():
+                got.setdefault(rid, []).append(tok)
+                if rest:
+                    lps.setdefault(rid, []).append(rest[0])
+        return r0, r1, got, lps
+
+    r0, r1, got, lps = run(True)
+    p0, p1, got_plain, _ = run(False)
+    assert got[r0] == got_plain[p0] and got[r1] == got_plain[p1]
+
+    for rid, prompt in ((r0, prompts[0]), (r1, prompts[1])):
+        toks = got[rid]
+        assert len(lps[rid]) == len(toks)
+        full = jnp.asarray([prompt + toks], jnp.int32)
+        sc = np.asarray(score(params, full, config=config))[0]
+        want = [float(sc[len(prompt) + i - 1]) for i in range(len(toks))]
+        np.testing.assert_allclose(lps[rid], want, atol=1e-4, rtol=1e-4)
+
+
 def test_spec_batcher_sampled_only_batch(models):
     """Two sampled slots with different seeds/policies, no greedy rows:
     each must reproduce its standalone seeded run."""
