@@ -20,7 +20,14 @@ from .generation import LLaMA
 from .serving import ContinuousBatcher
 from .server import LLMServer
 from .spec_decode import generate_speculative
-from .models import KVCache, forward, init_cache, init_params, param_count
+from .models import (
+    AuxOutput,
+    KVCache,
+    forward,
+    init_cache,
+    init_params,
+    param_count,
+)
 from .ops.quant import QuantizedTensor, quantize_params
 from .parallel import auto_mesh, constrain, make_mesh, use_mesh
 from .tokenizers import ByteTokenizer
@@ -39,6 +46,7 @@ __all__ = [
     "LLMServer",
     "LLaMA",
     "ByteTokenizer",
+    "AuxOutput",
     "KVCache",
     "forward",
     "init_cache",

@@ -1,4 +1,5 @@
 from .llama import (
+    AuxOutput,
     KVCache,
     forward,
     fuse_params,
@@ -10,6 +11,6 @@ from .llama import (
 )
 
 __all__ = [
-    "KVCache", "forward", "fuse_params", "fuse_qkv", "init_cache",
-    "init_params", "param_count", "split_qkv",
+    "AuxOutput", "KVCache", "forward", "fuse_params", "fuse_qkv",
+    "init_cache", "init_params", "param_count", "split_qkv",
 ]

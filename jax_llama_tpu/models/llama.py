@@ -445,18 +445,28 @@ def _block(
     paged_table: Optional[jnp.ndarray] = None,
     paged_qpos: Optional[jnp.ndarray] = None,
     ring_new_pos: Optional[jnp.ndarray] = None,
+    output_attentions: bool = False,
 ) -> Tuple[jnp.ndarray, ...]:
     """One pre-norm transformer block. x: [B, T, D].  ``impl`` is the
     RESOLVED attention implementation (forward maps "auto" to "flash" or
     "xla" per call based on T).
 
-    Returns (x, cache_k, cache_v, cache_k_scale, cache_v_scale).  On the
+    Returns (x, cache_k, cache_v, cache_k_scale, cache_v_scale), plus a
+    trailing [B, H, T, S] post-softmax probability array when
+    ``output_attentions`` (xla path only — the flash/ring/paged kernels
+    never materialize the weights; forward routes accordingly).  On the
     xla cached path cache_k/v are just this step's new projections (the
     caller writes them once, outside the layer scan) and the scales pass
     through untouched; on the flash cached path they are the fully
     updated per-layer cache (+ updated scales when int8)."""
     B, T, D = x.shape
     adt = x.dtype
+    if output_attentions and impl != "xla":
+        raise NotImplementedError(
+            f"output_attentions requires the xla attention path "
+            f"(got impl={impl!r}); forward() forces it when asked"
+        )
+    attn_weights = None
 
     # --- attention ---
     h = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
@@ -515,12 +525,16 @@ def _block(
                 q, cache_k, cache_v, k, v, bias, bias_new,
                 softmax_dtype=softmax_dtype,
                 k_scale=cache_k_scale, v_scale=cache_v_scale,
+                return_weights=output_attentions,
             )
         else:
             attn = sdpa_cached(
                 q, cache_k.astype(adt), cache_v.astype(adt), k, v,
                 bias, bias_new, softmax_dtype=softmax_dtype,
+                return_weights=output_attentions,
             )
+        if output_attentions:
+            attn, attn_weights = attn
         # ys: just this step's projections; forward writes them into the
         # cache once, outside the scan.
         cache_k, cache_v = k, v
@@ -581,10 +595,20 @@ def _block(
             kk, vv = k, v
         if impl == "ring" and cache_k is None:
             # Sequence-parallel path (training / scoring / cache-free
-            # prefill): ring over the seq mesh axis.
+            # prefill): ring over the seq mesh axis.  attn_pdrop composes:
+            # the mask is a position-keyed counter hash (ring.dropout_keep)
+            # — invariant to chunking and ring layout by construction.
             from ..parallel.ring import ring_sdpa
 
-            attn = ring_sdpa(q, kk, vv, positions, slot_pos)
+            attn = ring_sdpa(
+                q, kk, vv, positions, slot_pos,
+                dropout_rng=(
+                    jax.random.fold_in(dropout_rng, 0)
+                    if dropout_rng is not None and config.attn_pdrop > 0.0
+                    else None
+                ),
+                dropout_rate=config.attn_pdrop,
+            )
         elif impl in ("flash", "ring"):
             if dropout_rng is not None and config.attn_pdrop > 0.0:
                 # In-kernel probability dropout: the mask is generated
@@ -610,7 +634,10 @@ def _block(
                     else None
                 ),
                 dropout_rate=config.attn_pdrop,
+                return_weights=output_attentions,
             )
+            if output_attentions:
+                attn, attn_weights = attn
 
     attn_out = qeinsum(attn, lp["o"], "bthk,hkd->btd", adt)
     attn_out = constrain(attn_out, "data", "seq", None)
@@ -634,7 +661,42 @@ def _block(
             jax.random.fold_in(dropout_rng, 2), down, config.resid_pdrop
         )
     x = x + down
+    if output_attentions:
+        return x, cache_k, cache_v, cache_k_scale, cache_v_scale, attn_weights
     return x, cache_k, cache_v, cache_k_scale, cache_v_scale
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["hidden_states", "last_hidden_state", "attentions"],
+    meta_fields=[],
+)
+@dataclasses.dataclass(frozen=True)
+class AuxOutput:
+    """Optional eval/interp outputs of ``forward`` — capability parity
+    with the reference's ``output_hidden_states`` / ``output_attentions``
+    (reference model.py:488-494) and its head-less ``FlaxLLaMAModel``
+    (model.py:745).
+
+    hidden_states: [L+1, B, T, D] (or None).  Entries 0..L-1 are each
+      block's INPUT (entry 0 = the embedding output), entry L is the
+      POST-final-norm hidden state — the reference's exact collection
+      points (model.py:580-581 per-block, :663-666 final norm appended).
+      Stacked into one array rather than a Python tuple: TPU-idiomatic
+      (one transfer), and ``aux.hidden_states[i]`` reads the same way.
+    last_hidden_state: [B, T, D] post-final-norm hidden state
+      (== hidden_states[-1]) — what the reference's base model without
+      the LM head returns.  Present whenever aux is requested, so
+      ``forward(..., compute_logits=False, output_hidden_states=True)``
+      IS the head-less model call.
+    attentions: [L, B, H, T, S] post-softmax attention probabilities
+      (or None unless ``output_attentions``).  S spans the cache slots
+      then the step's new tokens on the cached path.
+    """
+
+    hidden_states: Optional[jnp.ndarray]
+    last_hidden_state: jnp.ndarray
+    attentions: Optional[jnp.ndarray]
 
 
 def forward(
@@ -646,7 +708,9 @@ def forward(
     attn_mask: Optional[jnp.ndarray] = None,
     compute_logits: bool = True,
     dropout_rng: Optional[jax.Array] = None,
-) -> Tuple[Optional[jnp.ndarray], Optional[KVCache]]:
+    output_hidden_states: bool = False,
+    output_attentions: bool = False,
+):
     """Run the transformer.
 
     Args:
@@ -671,13 +735,32 @@ def forward(
         requires cache=None) at the config's embd/resid/attn_pdrop rates
         (reference capability: config.py:85-87, model.py:166-168,296-299).
         None, or all rates zero, means fully deterministic.
+      output_hidden_states / output_attentions: ALSO return an
+        ``AuxOutput`` (see its docstring) — the eval/interp/debug
+        surface, parity with the reference's flags (model.py:488-494).
+        The layer stack unrolls for the collection (compile time O(L),
+        per-layer arrays are real outputs — not the hot path), and
+        ``output_attentions`` forces the xla attention path (the
+        flash/ring/paged kernels never materialize the [B, H, T, S]
+        weights; the xla path is the one that computes them anyway).
+        Not supported on paged caches (a serving path) or stage > 1
+        (pipeline) meshes.
     Returns:
       (logits [B, T, V] in config.logits_dtype, updated cache or None);
-      logits is None when compute_logits=False.
+      logits is None when compute_logits=False.  When either output
+      flag is set, a third ``AuxOutput`` element is appended:
+      (logits, cache, aux).
     """
+    collect = output_hidden_states or output_attentions
     if isinstance(cache, PagedKVCache):
         if dropout_rng is not None:
             raise ValueError("dropout_rng is training-only (paged decode)")
+        if collect:
+            raise NotImplementedError(
+                "output_hidden_states/output_attentions are not supported "
+                "on the paged (serving) path; use a plain KVCache or a "
+                "cache-free forward"
+            )
         return paged_forward(
             params, tokens, positions, config, cache,
             attn_mask=attn_mask, compute_logits=compute_logits,
@@ -735,12 +818,15 @@ def forward(
         # scales — and generates dropout masks — in-kernel.)
         must_xla = cache is not None and cache.per_row_index
         impl = "flash" if T > 8 and not must_xla else "xla"
-    if dropout_rng is not None and config.attn_pdrop > 0.0 and impl == "ring":
-        raise NotImplementedError(
-            "attn_pdrop does not compose with ring (seq-sharded) attention "
-            "— the chunked ring accumulation has no in-kernel dropout; "
-            "train with attn_impl='flash'/'xla'/'auto' or attn_pdrop=0"
-        )
+    if output_attentions:
+        if impl == "ring":
+            raise NotImplementedError(
+                "output_attentions does not compose with ring "
+                "(seq-sharded) attention — the chunked accumulation "
+                "never materializes the weights; use "
+                "attn_impl='xla'/'auto'/'flash'"
+            )
+        impl = "xla"  # the only path that materializes [B, H, T, S]
     bias_new = None
     ring_cached = False
     if cache is not None and impl == "ring":
@@ -828,6 +914,12 @@ def forward(
             "decode with a KV cache is not supported on a stage > 1 mesh; "
             "generation meshes keep stage == 1 (use data/tensor axes)"
         )
+    if collect and pp_stages > 1:
+        raise NotImplementedError(
+            "output_hidden_states/output_attentions are not supported on "
+            "stage > 1 (pipeline) meshes — per-layer outputs live on "
+            "their stage group; run the eval forward on a stage == 1 mesh"
+        )
     if pp_stages > 1:
         # Pipeline-parallel block stack (training / scoring).  Embed, final
         # norm, and the LM head stay outside — auto-sharded, replicated
@@ -886,7 +978,12 @@ def forward(
         )
     new_k_scale = cache.k_scale if cache is not None else None
     new_v_scale = cache.v_scale if cache is not None else None
-    if config.scan_layers and pp_stages <= 1:
+    hs: list = []     # per-block inputs (collect only)
+    attns: list = []  # per-block attention probabilities (collect only)
+    # Collection runs on the UNROLLED stack: per-layer arrays are real
+    # outputs, so a scan would have to carry them as ys anyway — and the
+    # O(L) compile is fine for an eval/interp surface.
+    if config.scan_layers and pp_stages <= 1 and not collect:
         if cache is not None and cache.quantized:
             # Scales ride the scan alongside the int8 payload.  On the
             # xla path the returned ck/cv are this step's projections and
@@ -954,10 +1051,15 @@ def forward(
             cv = cache.v[i] if cache is not None else None
             cks = cache.k_scale[i] if cache is not None and cache.quantized else None
             cvs = cache.v_scale[i] if cache is not None and cache.quantized else None
-            x, ck, cv, cks, cvs = block(
+            if output_hidden_states:
+                hs.append(x)
+            x, ck, cv, cks, cvs, *aw = block(
                 x, layer_params, ck, cv, cks, cvs,
                 unroll_rngs[i] if unroll_rngs is not None else None,
+                output_attentions=output_attentions,
             )
+            if output_attentions:
+                attns.append(aw[0])
             new_ks.append(ck)
             new_vs.append(cv)
             new_kss.append(cks)
@@ -1024,13 +1126,24 @@ def forward(
 
     logits = lm_head_logits(params, x, config) if compute_logits else None
 
+    aux = None
+    if collect:
+        final_h = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+        aux = AuxOutput(
+            hidden_states=(
+                jnp.stack(hs + [final_h]) if output_hidden_states else None
+            ),
+            last_hidden_state=final_h,
+            attentions=jnp.stack(attns) if output_attentions else None,
+        )
+
     if cache is not None:
         new_cache = KVCache(
             k=new_k, v=new_v, pos=slot_pos, index=cache.index + T,
             k_scale=new_k_scale, v_scale=new_v_scale,
         )
-        return logits, new_cache
-    return logits, None
+        return (logits, new_cache, aux) if collect else (logits, new_cache)
+    return (logits, None, aux) if collect else (logits, None)
 
 
 def paged_forward(

@@ -76,7 +76,8 @@ def sdpa_cached(
     softmax_dtype: jnp.dtype = jnp.float32,
     k_scale: Optional[jnp.ndarray] = None,
     v_scale: Optional[jnp.ndarray] = None,
-) -> jnp.ndarray:
+    return_weights: bool = False,
+):
     """Append-free cached attention: softmax over the (immutable) cache and
     the step's new KV jointly, concatenated at the *scores* level.
 
@@ -100,8 +101,12 @@ def sdpa_cached(
         both contractions: QK scores are rescaled after the dot, and
         v_scale folds into the softmax weights before the PV dot — the
         int8 payload goes straight into the MXU, never dequantized in HBM.
+      return_weights: also return the post-softmax probabilities
+        [B, H, T, S + T] (columns: cache slots then the step's new
+        tokens; pre-v_scale-fold) — the eval/interp surface, parity with
+        the reference's ``output_attentions`` (model.py:299).
     Returns:
-      [B, T, H, D] in q.dtype.
+      [B, T, H, D] in q.dtype; ``(out, weights)`` with return_weights.
     """
     b, t, h, d = q.shape
     kvh = k_cache.shape[2]
@@ -134,7 +139,10 @@ def sdpa_cached(
     ) + jnp.einsum(
         "bkgts,bskd->btkgd", w2, v_new, preferred_element_type=jnp.float32
     )
-    return out.reshape(b, t, h, d).astype(q.dtype)
+    out = out.reshape(b, t, h, d).astype(q.dtype)
+    if return_weights:
+        return out, w.reshape(b, h, t, w.shape[-1])
+    return out
 
 
 def sdpa(
@@ -145,7 +153,8 @@ def sdpa(
     softmax_dtype: jnp.dtype = jnp.float32,
     dropout_rng: Optional[jax.Array] = None,
     dropout_rate: float = 0.0,
-) -> jnp.ndarray:
+    return_weights: bool = False,
+):
     """Scaled dot-product attention with GQA.
 
     Args:
@@ -155,8 +164,11 @@ def sdpa(
       dropout_rng, dropout_rate: attention-probability dropout (training
         only; parity with the reference's attn_pdrop, model.py:276-288).
         Inverted scaling keeps the expectation unchanged.
+      return_weights: also return the post-softmax (pre-dropout)
+        probabilities [B, H, T, S] — the eval/interp surface, parity
+        with the reference's ``output_attentions`` (model.py:299).
     Returns:
-      [B, T, H, D] in q.dtype.
+      [B, T, H, D] in q.dtype; ``(out, weights)`` with return_weights.
     """
     b, t, h, d = q.shape
     kvh = k.shape[2]
@@ -177,9 +189,13 @@ def sdpa(
         scores = scores + bias[:, :, None]  # [B,1,T,S] -> [B,1,1,T,S]
     scores = scores.astype(softmax_dtype)
     weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    probs = weights
     if dropout_rng is not None and dropout_rate > 0.0:
         weights = dropout(dropout_rng, weights, dropout_rate)
     out = jnp.einsum(
         "bkgts,bskd->btkgd", weights, v, preferred_element_type=jnp.float32
     )
-    return out.reshape(b, t, h, d).astype(q.dtype)
+    out = out.reshape(b, t, h, d).astype(q.dtype)
+    if return_weights:
+        return out, probs.reshape(b, h, t, probs.shape[-1])
+    return out

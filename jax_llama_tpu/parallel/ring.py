@@ -50,7 +50,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import attention_bias, repeat_kv, sdpa
-from ..ops.flash_attention import MASK_VALUE
+from ..ops.flash_attention import MASK_VALUE, _mix32
 from .mesh import current_mesh
 
 BATCH_AXES = ("data", "fsdp")
@@ -61,11 +61,62 @@ BATCH_AXES = ("data", "fsdp")
 RING_CHUNK = 512
 
 
-def _fold_chunk(qt, q_pos, kc, vc, pc, m, l, acc, *, scale):
+def dropout_base(seed, B, H, b_off, h_off):
+    """Per-(global batch, global head) hash base [B, H] uint32 — the same
+    keying scheme as the flash kernels' ``_dropout_keep`` (one mix per
+    plane), with global indices supplied by the caller so every device of
+    a data/fsdp/tensor-sharded mesh draws an independent plane."""
+    gb = (
+        jnp.asarray(b_off, jnp.uint32)
+        + jnp.arange(B, dtype=jnp.uint32)[:, None]
+    )
+    gh = (
+        jnp.asarray(h_off, jnp.uint32)
+        + jnp.arange(H, dtype=jnp.uint32)[None, :]
+    )
+    return _mix32(
+        jnp.asarray(seed, jnp.uint32)
+        ^ _mix32(
+            gb * jnp.uint32(0x9E3779B9)
+            + gh * jnp.uint32(0x85EBCA6B)
+            + jnp.uint32(1)
+        )
+    )
+
+
+def dropout_keep(base, q_pos, kv_pos, rate):
+    """Deterministic keep mask [B, H, T, C] for attention-probability
+    dropout under ring attention.
+
+    Keyed on ABSOLUTE (query position, kv position) — the coordinates
+    that ride the shards — so the mask is a pure function of the global
+    (row, column) pair and survives chunking, ring rotation, and any
+    seq-mesh layout by construction (the property the flash kernels get
+    from global tile indices).  Row and column enter the element hash
+    jointly (xor + odd multiply), same rationale as ``_dropout_keep``.
+    base: [B, H] (``dropout_base``); q_pos: [B, T]; kv_pos: [B, C].
+    """
+    rows = q_pos.astype(jnp.uint32)[:, None, :, None]
+    cols = kv_pos.astype(jnp.uint32)[:, None, None, :]
+    bits = _mix32(
+        _mix32(base[:, :, None, None] ^ rows)
+        ^ (cols * jnp.uint32(0x9E3779B9))
+    )
+    threshold = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
+    return bits >= threshold
+
+
+def _fold_chunk(qt, q_pos, kc, vc, pc, m, l, acc, *, scale,
+                dropout_rate=0.0, drop_base=None):
     """Fold one kv chunk into the running online-softmax state.
 
     qt: [B, H, T, d]; kc, vc: [B, C, KVH, d]; pc: [B, C];
     m, l: [B, H, T] f32; acc: [B, H, T, d] f32.
+
+    With ``dropout_rate`` > 0 the acc-side probabilities are
+    inverted-dropout masked (``dropout_keep``) while ``l`` keeps the full
+    sum — exactly dropout applied to the post-softmax weights w = p / l,
+    the flash kernels' (and sdpa's) semantics, chunkwise.
     """
     group = qt.shape[1] // kc.shape[2]
     kr = repeat_kv(kc, group)  # [B, C, H, d]
@@ -82,15 +133,20 @@ def _fold_chunk(qt, q_pos, kc, vc, pc, m, l, acc, *, scale):
     alpha = jnp.exp(m - m_new)  # [B, H, T]
     p = jnp.exp(s - m_new[..., None])  # [B, H, T, C] f32
     l = alpha * l + jnp.sum(p, axis=-1)
+    if dropout_rate > 0.0:
+        keep = dropout_keep(drop_base, q_pos, pc, dropout_rate)
+        p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+    else:
+        p_acc = p
     acc = alpha[..., None] * acc + jnp.einsum(
-        "bhts,bshd->bhtd", p.astype(vr.dtype), vr,
+        "bhts,bshd->bhtd", p_acc.astype(vr.dtype), vr,
         preferred_element_type=jnp.float32,
     )
     return m_new, l, acc
 
 
 def _accumulate(qt, q_pos, k, v, kv_pos, m, l, acc, *, scale,
-                chunk: int = RING_CHUNK):
+                chunk: int = RING_CHUNK, dropout_rate=0.0, drop_base=None):
     """Fold one KV shard into the running state, chunk by chunk.
 
     Memory: O(B·H·T·chunk) per step of the scan (the dense predecessor
@@ -126,7 +182,12 @@ def _accumulate(qt, q_pos, k, v, kv_pos, m, l, acc, *, scale,
     def body(carry, xs):
         m, l, acc = carry
         kc, vc, pc = xs
-        m, l, acc = _fold_chunk(qt, q_pos, kc, vc, pc, m, l, acc, scale=scale)
+        # The dropout mask is a pure function of (base, positions), so the
+        # checkpointed backward rebuilds it bit-identically for free.
+        m, l, acc = _fold_chunk(
+            qt, q_pos, kc, vc, pc, m, l, acc, scale=scale,
+            dropout_rate=dropout_rate, drop_base=drop_base,
+        )
         return (m, l, acc), None
 
     (m, l, acc), _ = lax.scan(
@@ -144,12 +205,22 @@ def ring_attention(
     *,
     axis_name: str = "seq",
     axis_size: int,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
+    b_off=0,
+    h_off=0,
 ) -> jnp.ndarray:
     """Per-device body (call under shard_map): local q attends to all KV
     shards as they rotate around the ring.
 
     q: [B, T_local, H, d]; k, v: [B, S_local, KVH, d];
     q_pos: [B, T_local]; kv_pos: [B, S_local].  Returns [B, T_local, H, d].
+
+    ``dropout_rate`` > 0 (training): attention-probability dropout via a
+    position-keyed counter hash (``dropout_keep``) — the mask depends only
+    on (seed, global batch/head, absolute row/column position), so it is
+    identical for every chunking and every ring layout; ``b_off``/``h_off``
+    are this device's global batch/head offsets (0 off-mesh).
     """
     B, T, H, d = q.shape
     scale = 1.0 / (d ** 0.5)
@@ -157,13 +228,18 @@ def ring_attention(
     m = jnp.full((B, H, T), MASK_VALUE, dtype=jnp.float32)
     l = jnp.zeros((B, H, T), dtype=jnp.float32)
     acc = jnp.zeros((B, H, T, d), dtype=jnp.float32)
+    drop_base = (
+        dropout_base(dropout_seed, B, H, b_off, h_off)
+        if dropout_rate > 0.0 else None
+    )
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     def body(_, carry):
         k, v, kv_pos, m, l, acc = carry
         m, l, acc = _accumulate(
-            qt, q_pos, k, v, kv_pos, m, l, acc, scale=scale
+            qt, q_pos, k, v, kv_pos, m, l, acc, scale=scale,
+            dropout_rate=dropout_rate, drop_base=drop_base,
         )
         k, v, kv_pos = (
             lax.ppermute(x, axis_name, perm) for x in (k, v, kv_pos)
@@ -177,7 +253,10 @@ def ring_attention(
         k, v, kv_pos, m, l, acc = lax.fori_loop(
             0, axis_size - 1, body, (k, v, kv_pos, m, l, acc)
         )
-    m, l, acc = _accumulate(qt, q_pos, k, v, kv_pos, m, l, acc, scale=scale)
+    m, l, acc = _accumulate(
+        qt, q_pos, k, v, kv_pos, m, l, acc, scale=scale,
+        dropout_rate=dropout_rate, drop_base=drop_base,
+    )
 
     out = acc / l[..., None]
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, T, H, d]
@@ -191,17 +270,66 @@ def ring_sdpa(
     kv_pos: jnp.ndarray,
     *,
     axis_name: str = "seq",
+    dropout_rng=None,
+    dropout_rate: float = 0.0,
 ) -> jnp.ndarray:
     """Mesh-aware entry point: shard_map over the active mesh's ``seq`` axis
     (batch over data/fsdp, heads over tensor stay local per device).  Falls
     back to dense sdpa when no mesh is active or seq == 1.
+
+    ``dropout_rng`` + ``dropout_rate`` > 0 enable attention-probability
+    dropout (training).  On a seq > 1 mesh the mask is the position-keyed
+    counter hash (``dropout_keep``) — sharding-layout-invariant; the
+    seq == 1 fallback uses ``sdpa``'s jax.random mask (different draw,
+    same distribution — masks are not required to match across meshes,
+    only within one program's fwd/bwd, which both schemes guarantee).
     """
     mesh = current_mesh()
     n = mesh.shape.get(axis_name, 1) if mesh is not None else 1
     if n == 1:
         bias = attention_bias(q_pos, kv_pos, kv_pos >= 0)
-        return sdpa(q, k, v, bias)
+        return sdpa(
+            q, k, v, bias,
+            dropout_rng=dropout_rng if dropout_rate > 0.0 else None,
+            dropout_rate=dropout_rate,
+        )
 
+    with_drop = dropout_rng is not None and dropout_rate > 0.0
+    B, _, H, _ = q.shape
+    b_local = B
+    for a in BATCH_AXES:
+        b_local //= mesh.shape.get(a, 1)
+    h_local = H // mesh.shape.get("tensor", 1)
+
+    def body(q, k, v, q_pos, kv_pos, seed):
+        if with_drop:
+            # Global batch/head offsets of this device's shard — mesh
+            # axes are all manual under shard_map, so axis_index is
+            # available whether or not the axis is sharded here (0 when
+            # the axis is absent from a custom mesh entirely).
+            def _idx(a):
+                return (
+                    lax.axis_index(a) if a in mesh.axis_names
+                    else jnp.zeros((), jnp.int32)
+                )
+
+            bi = _idx(BATCH_AXES[0]) * mesh.shape.get(
+                BATCH_AXES[1], 1
+            ) + _idx(BATCH_AXES[1])
+            b_off = bi * b_local
+            h_off = _idx("tensor") * h_local
+        else:
+            b_off = h_off = 0
+        return ring_attention(
+            q, k, v, q_pos, kv_pos, axis_name=axis_name, axis_size=n,
+            dropout_rate=dropout_rate if with_drop else 0.0,
+            dropout_seed=seed[0], b_off=b_off, h_off=h_off,
+        )
+
+    seed = (
+        jax.random.bits(dropout_rng, (1,), "uint32")
+        if with_drop else jnp.zeros((1,), jnp.uint32)
+    )
     spec4 = P(BATCH_AXES, axis_name, "tensor", None)
     spec2 = P(BATCH_AXES, axis_name)
     # check_vma=False: the fori_loop carry starts from freshly-created
@@ -209,13 +337,13 @@ def ring_sdpa(
     # first ppermute, which the varying-manual-axes checker rejects even
     # though the program is correct.
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, axis_size=n),
+        body,
         mesh=mesh,
-        in_specs=(spec4, spec4, spec4, spec2, spec2),
+        in_specs=(spec4, spec4, spec4, spec2, spec2, P(None)),
         out_specs=spec4,
         check_vma=False,
     )
-    return fn(q, k, v, q_pos, kv_pos)
+    return fn(q, k, v, q_pos, kv_pos, seed)
 
 
 # ---------------------------------------------------------------------------

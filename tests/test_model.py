@@ -97,3 +97,69 @@ def test_param_count_tiny():
 def test_gqa_group_validation():
     with pytest.raises(AssertionError):
         cfg_lib.tiny(n_heads=4, n_kv_heads=3).validate()
+
+
+def test_aux_outputs_surface():
+    """forward(..., output_hidden_states/output_attentions) — the
+    eval/interp surface: hidden-state stack semantics (per-block inputs +
+    post-final-norm), attention rows summing to 1 over attendable slots,
+    logits unchanged, cached-decode aux consistent with the cache-free
+    forward at the same positions, and the documented refusals."""
+    from jax_llama_tpu.models import init_cache
+    from jax_llama_tpu.models.llama import PagedKVCache  # noqa: F401
+
+    params = init_params(jax.random.PRNGKey(5), CFG)
+    T = 10
+    tokens = jnp.asarray(np.random.RandomState(9).randint(
+        0, CFG.vocab_size, size=(2, T)
+    ))
+    positions = jnp.tile(jnp.arange(T)[None, :], (2, 1))
+
+    logits, _, aux = forward(
+        params, tokens, positions, CFG,
+        output_hidden_states=True, output_attentions=True,
+    )
+    L, H, D = CFG.n_layers, CFG.n_heads, CFG.dim
+    assert aux.hidden_states.shape == (L + 1, 2, T, D)
+    assert aux.attentions.shape == (L, 2, H, T, T)
+    np.testing.assert_array_equal(
+        np.asarray(aux.last_hidden_state), np.asarray(aux.hidden_states[-1])
+    )
+    # Rows are distributions over the causal prefix.
+    sums = np.asarray(aux.attentions.astype(jnp.float32)).sum(-1)
+    np.testing.assert_allclose(sums, 1.0, atol=1e-3)
+    causal = np.triu(np.ones((T, T), bool), k=1)
+    assert np.all(np.asarray(aux.attentions)[..., causal] == 0.0)
+    # Flags are pure observation: logits identical to the plain forward
+    # (both run the unrolled xla stack here).
+    plain, _ = forward(
+        params, tokens, positions,
+        CFG.replace(scan_layers=False, attn_impl="xla"),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(plain), atol=1e-5, rtol=1e-5
+    )
+
+    # Cached decode: step t's aux equals the cache-free forward's values
+    # at column t (same math, append-free path).
+    cache = init_cache(CFG, 2, max_len=T)
+    step_h = []
+    for t in range(4):
+        _, cache, aux_t = forward(
+            params, tokens[:, t:t + 1], positions[:, t:t + 1], CFG,
+            cache=cache, output_hidden_states=True, output_attentions=True,
+        )
+        assert aux_t.attentions.shape == (L, 2, H, 1, T + 1)
+        step_h.append(np.asarray(aux_t.hidden_states[:, :, 0]))
+    full = np.asarray(aux.hidden_states)
+    np.testing.assert_allclose(
+        np.stack(step_h, axis=2), full[:, :, :4], atol=2e-4, rtol=1e-4
+    )
+
+    # Refusals: ring attention never materializes weights; paged caches
+    # are a serving path.
+    with pytest.raises(NotImplementedError, match="ring"):
+        forward(
+            params, tokens, positions, CFG.replace(attn_impl="ring"),
+            output_attentions=True,
+        )

@@ -179,6 +179,46 @@ def test_left_padded_batch_matches(models):
         )
 
 
+def test_hidden_states_and_attentions_match_reference(models):
+    """The aux output surface (forward(..., output_hidden_states=True,
+    output_attentions=True)) reproduces the reference's exact collection
+    points (model.py:580-581 per-block inputs, :663-666 final norm
+    appended, :299 per-layer post-softmax weights) with shared weights —
+    and requesting aux does not change the logits."""
+    ref, ref_params, params, config = models
+    rng = np.random.RandomState(4)
+    tokens = jnp.asarray(rng.randint(0, VOCAB, size=(2, 12)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(12, dtype=jnp.int32), (2, 12))
+
+    mine, _, aux = forward(
+        params, tokens, positions, config,
+        output_hidden_states=True, output_attentions=True,
+    )
+    theirs = ref(
+        tokens, params=ref_params,
+        output_hidden_states=True, output_attentions=True,
+    )
+
+    assert aux.hidden_states.shape == (LAYERS + 1, 2, 12, DIM)
+    for i in range(LAYERS + 1):
+        _assert_close(
+            aux.hidden_states[i], theirs.hidden_states[i],
+            what=f"hidden_states[{i}]",
+        )
+    _assert_close(
+        aux.last_hidden_state, theirs.hidden_states[-1],
+        what="last_hidden_state (base model without head)",
+    )
+    assert aux.attentions.shape == (LAYERS, 2, HEADS, 12, 12)
+    for i in range(LAYERS):
+        _assert_close(
+            aux.attentions[i], theirs.attentions[i], what=f"attentions[{i}]"
+        )
+
+    plain, _ = forward(params, tokens, positions, config)
+    _assert_close(mine, plain, what="logits unaffected by aux flags")
+
+
 def test_cached_decode_matches_for_20_steps(models):
     ref, ref_params, params, config = models
     rng = np.random.RandomState(2)

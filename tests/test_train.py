@@ -161,12 +161,17 @@ def test_dropout_refusals():
     with pytest.raises(ValueError, match="training-only"):
         forward(params, tokens, pos, DROP_CFG, cache=cache,
                 dropout_rng=jax.random.PRNGKey(0))
-    # attn_pdrop composes with the flash kernel (in-kernel mask); the ring
-    # (seq-sharded) accumulation is the one attention path that refuses.
+    # attn_pdrop composes with every attention path: flash generates its
+    # mask in-kernel, ring hashes absolute positions chunkwise (tested on
+    # a seq=2 mesh in test_ring.py); off-mesh "ring" falls back to sdpa
+    # and must run, stay finite, and be deterministic per key.
     ring_cfg = DROP_CFG.replace(attn_impl="ring")
-    with pytest.raises(NotImplementedError, match="ring"):
-        forward(params, tokens, pos, ring_cfg,
-                dropout_rng=jax.random.PRNGKey(0))
+    lr1, _ = forward(params, tokens, pos, ring_cfg,
+                     dropout_rng=jax.random.PRNGKey(0))
+    lr2, _ = forward(params, tokens, pos, ring_cfg,
+                     dropout_rng=jax.random.PRNGKey(0))
+    assert np.isfinite(np.asarray(lr1, np.float32)).all()
+    np.testing.assert_array_equal(np.asarray(lr1), np.asarray(lr2))
     # "auto" resolves to flash at prefill lengths even under attn_pdrop
     # (the kernel generates its own mask); both impls stay finite,
     # deterministic per key, and distinct across keys.
