@@ -42,6 +42,10 @@ MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
 _LANES = 128  # TPU lane width
 _SUBLANES = 8  # TPU sublane width (fp32/int32)
+# Forward-kernel k-tile sub-tiling factor (software pipeline: sub-tile
+# i+1's MXU dot overlaps sub-tile i's VPU exp/mask work).  Swept on chip;
+# tiles not divisible by this fall back to a single sub-tile.
+_KSUB = 4
 
 
 def _mix32(x):
@@ -63,8 +67,12 @@ def _mix32(x):
     return x
 
 
-def _dropout_keep(seed, b, h, qi, ki, bq, bk, rate):
+def _dropout_keep(seed, b, h, row0, col0, bq, bk, rate):
     """Deterministic keep-mask tile [bq, bk] for probability dropout.
+    ``row0``/``col0`` are the tile's GLOBAL element offsets (callers pass
+    tile_index * tile_size — plus any sub-tile offset), so the hash is a
+    pure function of global (row, column) and every tiling of the same
+    plane draws identical bits.
 
     Keyed on (seed, batch, head, global row, global column) so any kernel
     that knows its tile coordinates rebuilds the exact same Bernoulli draw;
@@ -91,11 +99,11 @@ def _dropout_keep(seed, b, h, qi, ki, bq, bk, rate):
             + jnp.uint32(1)
         )
     )
-    rows = jax.lax.broadcasted_iota(jnp.uint32, (bq, 1), 0) + (
-        qi * bq
+    rows = jax.lax.broadcasted_iota(jnp.uint32, (bq, 1), 0) + jnp.asarray(
+        row0
     ).astype(jnp.uint32)
-    cols = jax.lax.broadcasted_iota(jnp.uint32, (1, bk), 1) + (
-        ki * bk
+    cols = jax.lax.broadcasted_iota(jnp.uint32, (1, bk), 1) + jnp.asarray(
+        col0
     ).astype(jnp.uint32)
     bits = _mix32(_mix32(base ^ rows) ^ (cols * jnp.uint32(0x9E3779B9)))
     threshold = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
@@ -167,64 +175,117 @@ def _flash_kernel(
     @pl.when(block_live)
     def _compute():
         q = q_ref[0, 0]  # [bq, d]
+        bq = q.shape[0]
+        bk = k_ref.shape[2]
+        # Software pipeline: the k tile is processed as ``nsub`` sub-tiles
+        # so VPU softmax work and MXU dots of DIFFERENT sub-tiles are
+        # dataflow-independent and Mosaic can overlap them — the r3
+        # single-tile body serialized dot -> mask/max/exp -> dot, idling
+        # the MXU through every exp sweep (kernel-only ~56% MXU at 16k
+        # while the model's plain matmuls run ~90%).  Structure: all QK
+        # dots issue first (each sub-tile's mask/scale select overlaps the
+        # NEXT sub-tile's dot), one joint row max (same m as the
+        # single-tile form — the online-softmax state update stays
+        # once-per-tile), then each sub-tile's exp2 overlaps the previous
+        # sub-tile's PV dot.
+        # Quantized keeps the single-tile body: the per-sub-tile [1, ksub]
+        # dequant-scale slices hit the same unsupported Mosaic layout as
+        # narrow position slices, and the int8 path is inference
+        # long-context decode — the pipeline win targets bf16
+        # prefill/training.
+        nsub = (
+            _KSUB
+            if (bk % _KSUB == 0 and bk > _KSUB and not quantized)
+            else 1
+        )
+        ksub = bk // nsub
         if quantized:
             # int8 KV: cast the payload tile to the compute dtype in VMEM
             # (int8 magnitudes <= 127 are exact in bf16) and fold the
             # per-slot dequant scale into the SCORES — constant along d,
             # it commutes with the contraction, so HBM only ever streams
             # the int8 bytes (half the cache traffic of bf16).
-            k = k_ref[0, 0].astype(q.dtype)
+            # NB: folding the scale into q outside the kernel was tried
+            # and measured ~15% SLOWER on v5e (A/B, min-of-5
+            # differencing) — the fused multiply here rides the MXU
+            # output for free.
             ksc = k_scale_ref[0, 0, :1, :]  # [1, bk] fp32
         else:
-            k = k_ref[0, 0]  # [bk, d]
             ksc = None
-        # NB: folding the scale into q outside the kernel was tried and
-        # measured ~15% SLOWER on v5e (A/B, min-of-5 differencing) — the
-        # fused multiply here rides the MXU output for free.
         # The online softmax runs in BASE 2: log2(e) is pre-folded into
         # `scale` (see _flash_forward), so the per-element transcendental
         # is a bare exp2 — the VPU's native exponent — instead of exp's
         # exp2(x·log2e) with its extra wide multiply.  exp2(s2 - m2)
         # equals exp(s - m) exactly in the mask limit too (MASK_VALUE is
         # a huge negative in either base).
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk], base-2 domain
-        if quantized:
-            s = s * ksc
-        s = jnp.where(kp <= qp, s, MASK_VALUE)
+        # Full-width mask compare once (narrow sub-tile broadcasts of the
+        # 1-row position plane hit unsupported Mosaic layouts), sliced
+        # per sub-tile below.
+        allowed = kp <= qp  # [bq, bk]
+        s_parts = []
+        for i in range(nsub):
+            cols = slice(i * ksub, (i + 1) * ksub)
+            kb = k_ref[0, 0, cols, :]
+            if quantized:
+                kb = kb.astype(q.dtype)
+            s_i = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [bq, ksub], base-2 domain
+            if quantized:
+                s_i = s_i * ksc[:, cols]
+            s_parts.append(
+                jnp.where(allowed[:, cols], s_i, MASK_VALUE)
+            )
 
         m_prev = m_ref[:, :1]  # [bq, 1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_cur = s_parts[0].max(axis=-1, keepdims=True)
+        for s_i in s_parts[1:]:
+            m_cur = jnp.maximum(m_cur, s_i.max(axis=-1, keepdims=True))
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp2(m_prev - m_new)  # [bq, 1] rescale of old state
-        p = jnp.exp2(s - m_new)  # [bq, bk]
 
-        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        if dropout_rate > 0.0:
-            # Probability dropout (training): the final output is
-            # acc / l, so zeroing entries of the acc-side p while keeping
-            # the denominator's p intact is EXACTLY inverted dropout
-            # applied to the post-softmax weights w = p / l — the xla
-            # path's semantics (ops.attention.sdpa), blockwise.
-            keep = _dropout_keep(
-                seed_ref[0], bi, hi, qi, ki, *p.shape, dropout_rate,
+        l_add = None
+        acc_add = None
+        for i in range(nsub):
+            cols = slice(i * ksub, (i + 1) * ksub)
+            p = jnp.exp2(s_parts[i] - m_new)  # [bq, ksub]
+            ps = jnp.sum(p, axis=-1, keepdims=True)
+            l_add = ps if l_add is None else l_add + ps
+            if dropout_rate > 0.0:
+                # Probability dropout (training): the final output is
+                # acc / l, so zeroing entries of the acc-side p while
+                # keeping the denominator's p intact is EXACTLY inverted
+                # dropout applied to the post-softmax weights w = p / l —
+                # the xla path's semantics (ops.attention.sdpa),
+                # blockwise.  Global element offsets key the hash, so the
+                # sub-tiling draws the identical bits the (untiled)
+                # backward kernels rebuild.
+                keep = _dropout_keep(
+                    seed_ref[0], bi, hi, qi * bq, ki * bk + i * ksub,
+                    bq, ksub, dropout_rate,
+                )
+                p_acc = jnp.where(keep, p, 0.0) * (
+                    1.0 / (1.0 - dropout_rate)
+                )
+            else:
+                p_acc = p
+            if quantized:
+                # v_scale folds into the (tiny) probabilities, mirroring
+                # sdpa_cached's weights-level folding on the XLA path.
+                pv = (p_acc * v_scale_ref[0, 0, :1, cols]).astype(q.dtype)
+                vb = v_ref[0, 0, cols, :].astype(q.dtype)
+            else:
+                pv = p_acc.astype(v_ref.dtype)
+                vb = v_ref[0, 0, cols, :]
+            d_i = jax.lax.dot_general(
+                pv, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-            p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
-        else:
-            p_acc = p
-        if quantized:
-            # v_scale folds into the (tiny) probabilities, mirroring
-            # sdpa_cached's weights-level folding on the XLA path.
-            pv = (p_acc * v_scale_ref[0, 0, :1, :]).astype(q.dtype)
-            vb = v_ref[0, 0].astype(q.dtype)
-        else:
-            pv = p_acc.astype(v_ref.dtype)
-            vb = v_ref[0, 0]
-        acc_ref[:] = alpha * acc_ref[:] + jax.lax.dot_general(
-            pv, vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            acc_add = d_i if acc_add is None else acc_add + d_i
+
+        l_new = alpha * l_ref[:, :1] + l_add
+        acc_ref[:] = alpha * acc_ref[:] + acc_add
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -270,7 +331,7 @@ def flash_attention(
     v: jnp.ndarray,
     q_pos: jnp.ndarray,
     kv_pos: jnp.ndarray,
-    block_q: int = 1024,
+    block_q: int = 2048,
     block_k: int = 2048,
     interpret: Optional[bool] = None,
     dropout_rate: float = 0.0,
@@ -290,10 +351,12 @@ def flash_attention(
       q_pos: [B, T] int32 absolute query positions (pre-clamped >= 0).
       kv_pos: [B, S] int32 kv slot positions, -1 for padding/unwritten.
       block_q, block_k: tile sizes (clamped to T / S).  Swept on a v5e
-        with alternated run-differenced timing: (1024, 2048) beats the r2
-        default (512, 2048) by ~5% at 8k and ~7% median at 16k with the base-2
-        softmax kernel ((1024, 4096) fails VMEM); the r1 (256, 512) was
-        2.7-5x slower still.
+        with xplane device-time measurement (r4): with the sub-tiled
+        software pipeline (_KSUB) and the 64 MiB scoped-vmem budget,
+        (2048, 2048) runs the 16k forward at 66% MXU vs 56.5% for the r3
+        (1024, 2048) default, and wins the fwd+bwd step too; larger
+        tiles ((1024, 4096)+) lose it again — diagonal dead work and DMA
+        overtake the per-step saving.
       dropout_rate: attention-probability dropout (training; parity with
         the reference's attn_pdrop, model.py:276-288, and with
         ``ops.attention.sdpa``'s inverted-dropout semantics).  The mask is
@@ -357,7 +420,7 @@ def flash_attention_quantized(
     v_scale: jnp.ndarray,
     q_pos: jnp.ndarray,
     kv_pos: jnp.ndarray,
-    block_q: int = 1024,
+    block_q: int = 2048,
     block_k: int = 2048,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
@@ -604,6 +667,12 @@ def _flash_forward(
         # block DMA against compute — measured ~4x slower at 16k context.
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+            # The default 16 MiB scoped-vmem budget blocks the larger
+            # tiles (s lives at [block_q, block_k] fp32); v5e VMEM is
+            # 128 MiB, and 64 MiB leaves ample room for the pipeline's
+            # double buffers while unlocking (1024, 4096)-class tiles —
+            # fewer grid steps, less per-step overhead.
+            vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=interpret,
     )(*prefetch, *operands)
@@ -672,9 +741,11 @@ def _flash_dq_kernel(
             # Jacobian's weighted sum Σ_k w_k (D_k dp_k) is exactly
             # rowsum(dO ∘ O) — the SAME delta as the no-dropout case — so
             # only dp needs masking.  The mask is rebuilt bit-identically
-            # from the tile's grid coordinates (same hash as the forward).
+            # from the tile's GLOBAL element offsets (same hash as the
+            # forward — tiling-independent by construction).
             keep = _dropout_keep(
-                seed_ref[0], bi, hi, qi, ki, *p.shape, dropout_rate,
+                seed_ref[0], bi, hi, qi * p.shape[0], ki * p.shape[1],
+                *p.shape, dropout_rate,
             )
             dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
         ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
@@ -717,10 +788,12 @@ def _flash_dkv_kernel(
         ) * scale  # [bq, bk]
         p = jnp.where(kp <= qp, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
         if dropout_rate > 0.0:
-            # Same tile coordinates as the forward/dQ kernels — NOTE the
-            # grid here is (B, H, nk, nq), so qi/ki swap program ids.
+            # Same global element offsets as the forward/dQ kernels —
+            # NOTE the grid here is (B, H, nk, nq), so qi/ki swap
+            # program ids.
             keep = _dropout_keep(
-                seed_ref[0], bi, hi, qi, ki, *p.shape, dropout_rate,
+                seed_ref[0], bi, hi, qi * p.shape[0], ki * p.shape[1],
+                *p.shape, dropout_rate,
             )
             inv = 1.0 / (1.0 - dropout_rate)
             p_v = jnp.where(keep, p, 0.0) * inv  # dV sees dropped weights
@@ -835,6 +908,10 @@ def _flash_backward(
                 dimension_semantics=(
                     "parallel", "parallel", "parallel", "arbitrary"
                 ),
+                # Same raised scoped-vmem budget as the forward: the
+                # (2048, 2048) default tiles exceed the 16 MiB default
+                # here too (s/p intermediates at [block_q, block_k] fp32).
+                vmem_limit_bytes=64 * 1024 * 1024,
             ),
             interpret=interpret,
         )
