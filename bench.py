@@ -325,28 +325,22 @@ def main() -> None:
     # ------------------------------------------------------------------
     step_breakdown = None
     device_toks_per_s = None
+    hbm_ceiling_tps = None
+    hbm_ceiling_gbps = None
+    hbm_ceiling_tps_int8 = None
+    lc_serving = None
     try:
         import collections
         import glob
         import re
         import tempfile
 
-        def _trace_device_ps(max_new: int):
-            """Sum of device-op time (ps) for one traced generate call,
-            bucketed by HLO source file."""
+        def _traced_op_agg(thunk, by_source: bool):
+            """Run `thunk` under a profiler trace; return device-op time
+            (ps) aggregated by HLO source file (by_source) or op name."""
             tmpdir = tempfile.mkdtemp(prefix="bench_xplane_")
-            gcN = GenerationConfig(
-                max_new_tokens=max_new, temperature=0.0, stop_tokens=()
-            )
-            np.asarray(generate(
-                params, tokens, mask, salted_key(), config=config,
-                gen_config=gcN,
-            ))
             jax.profiler.start_trace(tmpdir)
-            np.asarray(generate(
-                params, tokens, mask, salted_key(), config=config,
-                gen_config=gcN,
-            ))
+            thunk()
             jax.profiler.stop_trace()
             from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
@@ -374,8 +368,25 @@ def main() -> None:
             for e in line.events:
                 if md_name[e.metadata_id].startswith("%while"):
                     continue  # outer loops double-count their bodies
-                agg[md_src[e.metadata_id]] += e.duration_ps
+                key = md_src if by_source else md_name
+                agg[key[e.metadata_id]] += e.duration_ps
             return agg
+
+        def _trace_device_ps(max_new: int):
+            """Sum of device-op time (ps) for one traced generate call,
+            bucketed by HLO source file."""
+            gcN = GenerationConfig(
+                max_new_tokens=max_new, temperature=0.0, stop_tokens=()
+            )
+
+            def go():
+                np.asarray(generate(
+                    params, tokens, mask, salted_key(), config=config,
+                    gen_config=gcN,
+                ))
+
+            go()  # warmup outside the trace
+            return _traced_op_agg(go, by_source=True)
 
         agg32 = _trace_device_ps(32)
         step_breakdown = {
@@ -413,6 +424,115 @@ def main() -> None:
             }
         except Exception:
             pass
+
+        # --------------------------------------------------------------
+        # MEASURED HBM ceiling: stream the exact bytes the roofline model
+        # counts (every non-embedding weight leaf once + a bf16 buffer
+        # sized to the KV read at mean context) through fp32 sum
+        # reductions, and take pure device time from the trace.  This
+        # turns the decode denominator into an observed number: on this
+        # chip pure streaming reads move at ~90% of the 819 GB/s
+        # nameplate (leaf granularity; a single contiguous 2 GB sum
+        # reaches ~92%), so "decode / measured ceiling" is the honest
+        # utilization — the modeled figure understates it by ~10%.
+        # --------------------------------------------------------------
+        try:
+            leaves = [
+                leaf
+                for path, leaf in jax.tree_util.tree_leaves_with_path(
+                    params
+                )
+                if "embed" not in jax.tree_util.keystr(path)
+            ]
+            mean_ctx = P + (N + 1) / 2
+            kv_entries = int(
+                2 * config.n_layers * B * mean_ctx
+                * config.kv_heads * config.head_dim
+            )
+            kv_buf = jax.random.normal(
+                jax.random.PRNGKey(2), (kv_entries,), dtype=jnp.bfloat16
+            )
+
+            @jax.jit
+            def _stream(ls, kv):
+                acc = jnp.float32(0)
+                for leaf in ls:
+                    acc += jnp.sum(leaf.astype(jnp.float32))
+                return acc + jnp.sum(kv.astype(jnp.float32))
+
+            def _stream_ceiling(ls):
+                nbytes = sum(
+                    l.size * l.dtype.itemsize for l in ls
+                ) + kv_buf.size * 2
+                float(_stream(ls, kv_buf))  # warmup
+                agg = _traced_op_agg(
+                    lambda: float(_stream(ls, kv_buf)), by_source=False
+                )
+                t = sum(agg.values()) / 1e12
+                return B / t, nbytes / t / 1e9
+
+            hbm_ceiling_tps, hbm_ceiling_gbps = _stream_ceiling(leaves)
+            qleaves = [
+                leaf
+                for path, leaf in jax.tree_util.tree_leaves_with_path(
+                    qparams
+                )
+                if "embed" not in jax.tree_util.keystr(path)
+            ]
+            hbm_ceiling_tps_int8, _ = _stream_ceiling(qleaves)
+        except Exception:
+            pass
+
+        # --------------------------------------------------------------
+        # LONG-CONTEXT paged serving (VERDICT r3 item 8): the paged
+        # kernel is the declared long-context decode path, so measure it
+        # there — 2 slots at an 8k and a 16k context, kernel vs gathered
+        # view at IDENTICAL pool geometry.  Wall tok/s would be tunnel-
+        # bound (~100 ms dispatch vs ~10 ms device per step — the paths
+        # would read identical), so the figure that carries the
+        # comparison is device-op ms per decode step from an xplane
+        # trace of 8 steps.
+        # --------------------------------------------------------------
+        try:
+            lc_cfg = config.replace(max_seq_len=16384)
+
+            def lc_serve_device_ms(ctx: int, use_kernel: bool) -> float:
+                cb = ContinuousBatcher(
+                    params, lc_cfg, n_slots=2, max_len=ctx + 64,
+                    block_size=128, prefill_chunk=2048,
+                    use_pallas_kernel=use_kernel,
+                )
+                _salt[0] += 1
+                srng = np.random.RandomState(4000 + _salt[0])
+                for _ in range(2):
+                    cb.submit(
+                        list(srng.randint(1, config.vocab_size, ctx)),
+                        max_new_tokens=33,
+                    )
+                cb.step()   # admission (chunked prefills) + first decode
+                cb.step()   # decode-step compile warmup
+                agg = _traced_op_agg(
+                    lambda: [cb.step() for _ in range(8)], by_source=True
+                )
+                while cb.pending():
+                    cb.step()
+                return sum(agg.values()) / 8 / 1e9
+
+            lc_serving = {}
+            # 16256 = 127 blocks of 128: the padded prompt + 33 new
+            # tokens stays within the 16384 per-request capacity.
+            for ctx, label in ((8192, "8k"), (16256, "16k")):
+                for use_kernel, path in ((True, "kernel"),
+                                         (False, "gathered")):
+                    ms = lc_serve_device_ms(ctx, use_kernel)
+                    lc_serving[f"{label}_{path}_device_ms_per_step"] = (
+                        round(ms, 2)
+                    )
+                    lc_serving[f"{label}_{path}_device_tokens_per_s"] = (
+                        round(2 / ms * 1e3, 1)
+                    )
+        except Exception:
+            lc_serving = None
     except Exception:
         step_breakdown = None
         device_toks_per_s = None
@@ -450,6 +570,26 @@ def main() -> None:
             "decode_roofline_tokens_per_s_int8": (
                 round(roofline_tps(1.0), 1) if is_v5e else None
             ),
+            # MEASURED ceiling (VERDICT r3 item 2): device time to stream
+            # the modeled step bytes through sum reductions, from an
+            # xplane trace.  The observed streaming rate on this chip is
+            # ~90% of nameplate, so this is the real denominator;
+            # decode_vs_measured_ceiling uses the jitter-immune xplane
+            # decode rate as numerator.
+            "hbm_ceiling_measured_tokens_per_s": (
+                round(hbm_ceiling_tps, 1) if hbm_ceiling_tps else None
+            ),
+            "hbm_ceiling_measured_gbps": (
+                round(hbm_ceiling_gbps, 1) if hbm_ceiling_gbps else None
+            ),
+            "hbm_ceiling_measured_tokens_per_s_int8": (
+                round(hbm_ceiling_tps_int8, 1)
+                if hbm_ceiling_tps_int8 else None
+            ),
+            "decode_vs_measured_ceiling": (
+                round(device_toks_per_s / hbm_ceiling_tps, 3)
+                if device_toks_per_s and hbm_ceiling_tps else None
+            ),
             # Compiled Pallas flash kernel, long-prompt prefill (B=1).
             "flash_prefill_8k_s": round(flash8k_s, 3),
             "flash_prefill_8k_tflops": round(flash8k_tf, 1),
@@ -477,6 +617,11 @@ def main() -> None:
             ),
             # 8 submits -> ONE batched prefill dispatch + first decode.
             "burst_admission_s": round(admit_s, 3),
+            # Long-context paged serving (2 slots, 8k/16k contexts):
+            # device-op ms per decode step, kernel vs gathered view at
+            # identical pool geometry (xplane; wall would be tunnel-
+            # bound and read identical on both paths).
+            "long_context_serving": lc_serving,
             # Speculative serving (self-draft, n_draft=3): Pallas path
             # (T=1 draft steps + multi-token verify kernel) vs the
             # gathered-view fallback at IDENTICAL pool geometry.  NOTE:

@@ -208,6 +208,66 @@ def paged_write_indices(
     return blk, safe % block_size, safe
 
 
+def paged_pool_write(
+    plane: jnp.ndarray,
+    upd: jnp.ndarray,
+    blk: jnp.ndarray,
+    off: jnp.ndarray,
+) -> jnp.ndarray:
+    """Land per-(row, token) pool updates via an unrolled chain of
+    ``dynamic_update_slice`` ops instead of one batched scatter.
+
+    Why not ``plane.at[:, :, blk, off].set(upd, mode="drop")``: XLA:TPU's
+    scatter emitter assigns the [L, KVH, NB, BLK, d] operand a KVH-minor
+    layout (the scattered [L, KVH, d] slabs become contiguous), and since
+    the rest of the program — the Pallas paged-attention kernel included —
+    wants the default layout, every decode step materialized FOUR
+    full-pool layout copies (in + back, k and v): ~3.2 ms/step on the
+    bench pool, dwarfing the attention kernel itself (xplane-measured,
+    r4).  B*T unrolled dynamic_update_slices keep the pool in its default
+    layout, update in place on the donated buffer, and move only the
+    ~tens of KB actually being written.
+
+    Drop semantics: ``paged_write_indices`` marks dead (row, token) pairs
+    with the sentinel block id NB, which a scatter would drop but
+    ``dynamic_update_slice`` silently CLAMPS.  Each update therefore
+    re-reads the (clamped) target slab and selects it back for dead
+    pairs — ``dynamic_slice`` clamps identically, so the dead write is an
+    exact in-place no-op.
+
+    plane: [L, KVH, NB, BLK, d] payload, [L, KVH, NB, BLK] scale, or
+      [NB, BLK] position plane — the (NB, BLK) axes sit at (-3, -2),
+      (-2, -1) and (0, 1) respectively, derived from ndim.
+    upd: matching [L, KVH, B, T, d] / [L, KVH, B, T] / [B, T].
+    blk, off: [B, T] int32 physical coordinates (sentinel NB = drop).
+    """
+    B, T = blk.shape
+    if plane.ndim == 5:
+        L, KVH, NB, BLK, d = plane.shape
+        nb_ax, slab = 2, (L, KVH, 1, 1, d)
+        pick = lambda b, t: upd[:, :, b, t][:, :, None, None, :]
+    elif plane.ndim == 4:
+        L, KVH, NB, BLK = plane.shape
+        nb_ax, slab = 2, (L, KVH, 1, 1)
+        pick = lambda b, t: upd[:, :, b, t][:, :, None, None]
+    else:
+        NB, BLK = plane.shape
+        nb_ax, slab = 0, (1, 1)
+        pick = lambda b, t: upd[b, t][None, None]
+    live = blk < NB  # off is always in range (contract above)
+    zero = jnp.int32(0)
+    for b in range(B):
+        for t in range(T):
+            start = (
+                (zero,) * nb_ax + (blk[b, t], off[b, t])
+                + (zero,) * (plane.ndim - nb_ax - 2)
+            )
+            cur = lax.dynamic_slice(plane, start, slab)
+            u = jnp.where(live[b, t], pick(b, t).astype(plane.dtype), cur)
+            plane = lax.dynamic_update_slice(plane, u, start)
+    return plane
+
+
 def lm_head_logits(
     params: Params, x: jnp.ndarray, config: LLaMAConfig
 ) -> jnp.ndarray:
@@ -1278,25 +1338,22 @@ def paged_forward(
     upd_v = jnp.moveaxis(new_v, 3, 1)
     new_cache = dataclasses.replace(
         cache,
-        k=cache.k.at[:, :, blk_idx, off].set(
-            upd_k.astype(cache.k.dtype), mode="drop"
-        ),
-        v=cache.v.at[:, :, blk_idx, off].set(
-            upd_v.astype(cache.v.dtype), mode="drop"
-        ),
-        pos=cache.pos.at[blk_idx, off].set(
-            jnp.where(active[:, None], positions, -1), mode="drop"
+        k=paged_pool_write(cache.k, upd_k, blk_idx, off),
+        v=paged_pool_write(cache.v, upd_v, blk_idx, off),
+        pos=paged_pool_write(
+            cache.pos, jnp.where(active[:, None], positions, -1),
+            blk_idx, off,
         ),
     )
     if cache.quantized:
         # ys carried each layer's new int8 payload + its scales.
         new_cache = dataclasses.replace(
             new_cache,
-            k_scale=cache.k_scale.at[:, :, blk_idx, off].set(
-                jnp.moveaxis(nks, 3, 1), mode="drop"
+            k_scale=paged_pool_write(
+                cache.k_scale, jnp.moveaxis(nks, 3, 1), blk_idx, off
             ),
-            v_scale=cache.v_scale.at[:, :, blk_idx, off].set(
-                jnp.moveaxis(nvs, 3, 1), mode="drop"
+            v_scale=paged_pool_write(
+                cache.v_scale, jnp.moveaxis(nvs, 3, 1), blk_idx, off
             ),
         )
     return logits, new_cache

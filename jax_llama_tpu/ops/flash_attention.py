@@ -420,7 +420,7 @@ def flash_attention_quantized(
     v_scale: jnp.ndarray,
     q_pos: jnp.ndarray,
     kv_pos: jnp.ndarray,
-    block_q: int = 2048,
+    block_q: int = 1024,
     block_k: int = 2048,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
@@ -432,6 +432,12 @@ def flash_attention_quantized(
     (scores-level for K, probability-level for V, matching
     ``ops.attention.sdpa_cached``'s folding), so HBM streams the int8
     payload, never a dequantized copy.
+
+    Default tiles stay at the r3-swept (1024, 2048): the int8 body is
+    excluded from the bf16 path's sub-tiled software pipeline (narrow
+    scale slices hit an unsupported Mosaic layout), so the (2048, 2048)
+    default that pipeline justified does not transfer — larger q tiles
+    only add diagonal dead work to the unpipelined body.
 
     Args:
       q: [B, T, H, d] activation dtype.

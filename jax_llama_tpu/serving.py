@@ -64,6 +64,7 @@ from .models.llama import (
     PagedKVCache,
     forward,
     init_cache,
+    paged_pool_write,
     paged_write_indices,
 )
 from .ops.attention import NEG_INF
@@ -188,22 +189,27 @@ def _scatter_back(
     nk = jnp.moveaxis(view.k[:, rows, safe_cols], 3, 1)   # [L, KVH, B, T, hd]
     nv = jnp.moveaxis(view.v[:, rows, safe_cols], 3, 1)
     npos = view.pos[rows, safe_cols]       # [B, T]
+    # paged_pool_write = unrolled in-place dynamic_update_slices; the
+    # batched scatter form forced four full-pool layout copies per step
+    # (see its docstring).
     new = dataclasses.replace(
         pool,
-        k=pool.k.at[:, :, blk, off].set(nk, mode="drop"),
-        v=pool.v.at[:, :, blk, off].set(nv, mode="drop"),
-        pos=pool.pos.at[blk, off].set(npos, mode="drop"),
+        k=paged_pool_write(pool.k, nk, blk, off),
+        v=paged_pool_write(pool.v, nv, blk, off),
+        pos=paged_pool_write(pool.pos, npos, blk, off),
     )
     if pool.quantized:
         new = dataclasses.replace(
             new,
-            k_scale=pool.k_scale.at[:, :, blk, off].set(
+            k_scale=paged_pool_write(
+                pool.k_scale,
                 jnp.moveaxis(view.k_scale[:, rows, safe_cols], 3, 1),
-                mode="drop",
+                blk, off,
             ),
-            v_scale=pool.v_scale.at[:, :, blk, off].set(
+            v_scale=paged_pool_write(
+                pool.v_scale,
                 jnp.moveaxis(view.v_scale[:, rows, safe_cols], 3, 1),
-                mode="drop",
+                blk, off,
             ),
         )
     return new
@@ -731,11 +737,11 @@ def _spec_round(
             )
             t_pool = dataclasses.replace(
                 t_pool,
-                pos=t_pool.pos.at[blk_i, off_i].set(patched, mode="drop"),
+                pos=paged_pool_write(t_pool.pos, patched, blk_i, off_i),
             )
             d_pool = dataclasses.replace(
                 d_pool,
-                pos=d_pool.pos.at[blk_i, off_i].set(patched, mode="drop"),
+                pos=paged_pool_write(d_pool.pos, patched, blk_i, off_i),
             )
         else:
             rows = jnp.arange(B, dtype=jnp.int32)[:, None]
@@ -1245,7 +1251,15 @@ class ContinuousBatcher:
                     req.seed if req.seed is not None
                     else (self.seed * 1000003 + req.rid) & 0x7FFFFFFF
                 )
-                keys[i] = np.asarray(jax.random.PRNGKey(seed))
+                # Host-built threefry key words: under the default
+                # (x64-disabled) seed canonicalization PRNGKey(seed) is
+                # exactly [0, seed & 0xFFFFFFFF] (parity-tested).  The
+                # obvious np.asarray(jax.random.PRNGKey(seed)) is a
+                # device round-trip PER REQUEST — ~100 ms of tunnel
+                # latency each here, which silently handed back the
+                # entire batched-prefill admission win (measured: 8
+                # admissions cost ~800 ms in key fetches alone).
+                keys[i, 1] = np.uint32(seed & 0xFFFFFFFF)
                 temps[i] = req.temperature
                 top_ps[i] = req.top_p
                 top_ks[i] = req.top_k
