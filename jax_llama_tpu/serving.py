@@ -1251,14 +1251,20 @@ class ContinuousBatcher:
                     req.seed if req.seed is not None
                     else (self.seed * 1000003 + req.rid) & 0x7FFFFFFF
                 )
-                # Host-built threefry key words: under the default
-                # (x64-disabled) seed canonicalization PRNGKey(seed) is
-                # exactly [0, seed & 0xFFFFFFFF] (parity-tested).  The
-                # obvious np.asarray(jax.random.PRNGKey(seed)) is a
-                # device round-trip PER REQUEST — ~100 ms of tunnel
-                # latency each here, which silently handed back the
-                # entire batched-prefill admission win (measured: 8
-                # admissions cost ~800 ms in key fetches alone).
+                # Host-built threefry key words: the obvious
+                # np.asarray(jax.random.PRNGKey(seed)) is a device
+                # round-trip PER REQUEST — ~100 ms of tunnel latency
+                # each here, which silently handed back the entire
+                # batched-prefill admission win (measured: 8 admissions
+                # cost ~800 ms in key fetches alone).  Under the default
+                # (x64-disabled) canonicalization PRNGKey(seed) is
+                # exactly [0, seed & 0xFFFFFFFF] (parity-tested); with
+                # x64 enabled threefry_seed keeps the high word too, so
+                # mirror it — otherwise an embedding application that
+                # flips jax_enable_x64 would silently fork the batcher's
+                # sampled streams from standalone seeded generates.
+                if jax.config.jax_enable_x64:
+                    keys[i, 0] = np.uint32((seed >> 32) & 0xFFFFFFFF)
                 keys[i, 1] = np.uint32(seed & 0xFFFFFFFF)
                 temps[i] = req.temperature
                 top_ps[i] = req.top_p
