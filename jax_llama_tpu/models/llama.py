@@ -504,6 +504,8 @@ def _block(
     paged_pos: Optional[jnp.ndarray] = None,
     paged_table: Optional[jnp.ndarray] = None,
     paged_qpos: Optional[jnp.ndarray] = None,
+    paged_pools: Optional[Tuple[jnp.ndarray, ...]] = None,
+    paged_layer: Optional[jnp.ndarray] = None,
     ring_new_pos: Optional[jnp.ndarray] = None,
     output_attentions: bool = False,
 ) -> Tuple[jnp.ndarray, ...]:
@@ -599,21 +601,26 @@ def _block(
         # cache once, outside the scan.
         cache_k, cache_v = k, v
     elif impl == "paged":
-        # Paged decode: cache_k/cache_v are the layer's block pool
-        # [KVH, NB, BLK, hd]; the Pallas kernel walks the block table in
-        # its index maps (pool read once, no gathered view) and the new
-        # token's slot merges at the softmax level.  Pool stays immutable
-        # through the scan — paged_forward scatters the ys once per step.
-        # int8 pools fold their scales in-kernel; the step's projections
-        # get quantized for the scatter but merge at full precision
-        # (matching sdpa_cached's treatment of same-step tokens).
+        # Paged decode: ``paged_pools`` is the FULL [L, KVH, NB, BLK, hd]
+        # block pool (+ scales when int8) bound once outside the layer
+        # scan, and ``paged_layer`` (the scan's loop index) selects the
+        # plane inside the kernel's index maps — slicing pool[i] here
+        # would materialize each layer's whole plane as the custom-call
+        # operand, ~3x the kernel's own time at 16k contexts (r4,
+        # xplane).  The new token's slot merges at the softmax level.
+        # Pool stays immutable through the scan — paged_forward scatters
+        # the ys once per step.  int8 pools fold their scales in-kernel;
+        # the step's projections get quantized for the scatter but merge
+        # at full precision (matching sdpa_cached's treatment of
+        # same-step tokens).
         from ..ops.paged_attention import paged_decode_attention
 
+        pool_k, pool_v, pool_ks, pool_vs = paged_pools
         attn = paged_decode_attention(
-            q, k, v, cache_k, cache_v, paged_pos, paged_table, paged_qpos,
-            k_scale=cache_k_scale, v_scale=cache_v_scale,
+            q, k, v, pool_k, pool_v, paged_pos, paged_table, paged_qpos,
+            k_scale=pool_ks, v_scale=pool_vs, layer=paged_layer,
         )
-        if cache_k_scale is not None:
+        if pool_ks is not None:
             k, cache_k_scale = quantize_kv(k)
             v, cache_v_scale = quantize_kv(v)
         cache_k, cache_v = k, v
@@ -1284,38 +1291,38 @@ def paged_forward(
         paged_pos=cache.pos,
         paged_table=cache.table,
         paged_qpos=q_pos_row,
+        # The FULL pool rides the scan as an invariant closure operand;
+        # the kernel selects its layer plane via the scan index below
+        # (slicing per layer here materialized each plane as a copy —
+        # see the paged branch of _block).
+        paged_pools=(cache.k, cache.v, cache.k_scale, cache.v_scale),
     )
 
     lp = params["layers"]
     nks = nvs = None
-    if config.scan_layers and cache.quantized:
+    layer_idx = jnp.arange(config.n_layers, dtype=jnp.int32)
+    if config.scan_layers:
         def scan_fn(carry, xs):
-            layer_params, ck, cv, cks, cvs = xs
-            y, ck, cv, cks, cvs = block(carry, layer_params, ck, cv, cks, cvs)
-            return y, (ck, cv, cks, cvs)
+            layer_params, li = xs
+            y, ck, cv, cks, cvs = block(
+                carry, layer_params, None, None, paged_layer=li
+            )
+            ys = (ck, cv, cks, cvs) if cache.quantized else (ck, cv)
+            return y, ys
 
-        x, (new_k, new_v, nks, nvs) = lax.scan(
-            scan_fn, x,
-            (lp, cache.k, cache.v, cache.k_scale, cache.v_scale),
-            unroll=config.scan_unroll,
+        x, ys = lax.scan(
+            scan_fn, x, (lp, layer_idx), unroll=config.scan_unroll
         )
-    elif config.scan_layers:
-        def scan_fn(carry, xs):
-            layer_params, ck, cv = xs
-            y, ck, cv, _, _ = block(carry, layer_params, ck, cv)
-            return y, (ck, cv)
-
-        x, (new_k, new_v) = lax.scan(
-            scan_fn, x, (lp, cache.k, cache.v), unroll=config.scan_unroll
-        )
+        if cache.quantized:
+            new_k, new_v, nks, nvs = ys
+        else:
+            new_k, new_v = ys
     else:
         new_ks, new_vs, sks, svs = [], [], [], []
         for i in range(config.n_layers):
             layer_params = jax.tree.map(lambda a: a[i], lp)
             x, ck, cv, cks, cvs = block(
-                x, layer_params, cache.k[i], cache.v[i],
-                cache.k_scale[i] if cache.quantized else None,
-                cache.v_scale[i] if cache.quantized else None,
+                x, layer_params, None, None, paged_layer=layer_idx[i]
             )
             new_ks.append(ck)
             new_vs.append(cv)

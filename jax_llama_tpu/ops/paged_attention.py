@@ -48,12 +48,13 @@ def _paged_kernel(
     qpos_ref,   # [B] int32 scalar-prefetch: FIRST token's query position
     #             (-1 = inactive row; token t sits at qpos + t)
     bound_ref,  # [B] int32 scalar-prefetch: live-block grid bound per row
+    layer_ref,  # [1] int32 scalar-prefetch: pool layer this call reads
     q_ref,      # [1, KVH, TG8, d] — sublane row r = t*group + g
-    k_ref,      # [KVH, 1, BLK, d] (int8 when quantized)
-    v_ref,      # [KVH, 1, BLK, d] (int8 when quantized)
+    k_ref,      # [1, KVH, 1, BLK, d] (int8 when quantized)
+    v_ref,      # [1, KVH, 1, BLK, d] (int8 when quantized)
     pos_ref,    # [1, 1, BLK] int32 slot positions of the block
     *rest,      # [k_scale_ref, v_scale_ref] when quantized
-    #             ([KVH, 1, 1, BLK] fp32); o_ref; lse_ref; scratch
+    #             ([1, KVH, 1, 1, BLK] fp32); o_ref; lse_ref; scratch
     scale: float,
     n_blocks: int,
     kvh: int,
@@ -132,10 +133,10 @@ def _paged_kernel(
                 # the scores / probability level — the same commuting
                 # trick as flash_attention_quantized, so HBM streams the
                 # int8 bytes.
-                k = k_ref[h, 0].astype(q.dtype)
-                ksc = k_scale_ref[h, 0, :1, :]  # [1, BLK] fp32
+                k = k_ref[0, h, 0].astype(q.dtype)
+                ksc = k_scale_ref[0, h, 0, :1, :]  # [1, BLK] fp32
             else:
-                k = k_ref[h, 0]
+                k = k_ref[0, h, 0]
                 ksc = None
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
@@ -166,11 +167,11 @@ def _paged_kernel(
                 (tg8, l_ref.shape[1]),
             )
             if quantized:
-                pv = (p * v_scale_ref[h, 0, :1, :]).astype(q.dtype)
-                vb = v_ref[h, 0].astype(q.dtype)
+                pv = (p * v_scale_ref[0, h, 0, :1, :]).astype(q.dtype)
+                vb = v_ref[0, h, 0].astype(q.dtype)
             else:
                 pv = p.astype(v_ref.dtype)
-                vb = v_ref[h, 0]
+                vb = v_ref[0, h, 0]
             acc_ref[sl] = alpha * acc_ref[sl] + jax.lax.dot_general(
                 pv, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -197,17 +198,27 @@ def _round_up(n: int, m: int) -> int:
 @functools.partial(jax.jit, static_argnames=("t_tokens", "interpret"))
 def paged_pool_attention(
     q: jnp.ndarray,        # [B, KVH, T*G, d]  (packed queries, r = t*G + g)
-    k_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
-    v_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
+    k_pool: jnp.ndarray,   # [L, KVH, NB, BLK, d] (or [KVH, NB, BLK, d])
+    v_pool: jnp.ndarray,   # [L, KVH, NB, BLK, d]
     pool_pos: jnp.ndarray,  # [NB, BLK] int32 (-1 = invalid slot)
     table: jnp.ndarray,    # [B, MB] int32 physical block ids (NB = unused)
     q_pos: jnp.ndarray,    # [B] int32 first token's position (-1 = inactive)
-    k_scale: Optional[jnp.ndarray] = None,  # [KVH, NB, BLK] fp32 (int8 pool)
+    k_scale: Optional[jnp.ndarray] = None,  # [L, KVH, NB, BLK] fp32 (int8)
     v_scale: Optional[jnp.ndarray] = None,
     t_tokens: int = 1,
+    layer: Optional[jnp.ndarray] = None,    # int32 layer index into L
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Attend each row's table-mapped pool blocks; no gather, pool read once.
+
+    The pool carries its LAYER axis and ``layer`` (a traced scalar — the
+    layer scan's loop index) selects the plane inside the kernel's index
+    maps.  Slicing ``pool[layer]`` at the caller instead would
+    materialize a full copy of the layer's plane as the custom-call
+    operand — 2 planes × L layers × plane-bytes of pure copy traffic per
+    decode step, which at 16k context cost ~3× the kernel itself
+    (xplane-measured r4: 4.7 of 9.3 ms/step).  A 4-D pool (single plane)
+    is accepted for compatibility and reads layer 0.
 
     With ``t_tokens`` > 1 each row carries T queries at CONSECUTIVE
     positions (token t at ``q_pos + t`` — the speculative-verify /
@@ -223,10 +234,27 @@ def paged_pool_attention(
     new-token merge (fp32 end-to-end through the merge — see the
     out_shape note in the kernel call).
     """
+    if k_pool.ndim == 4:
+        k_pool, v_pool = k_pool[None], v_pool[None]
+        if k_scale is not None:
+            k_scale, v_scale = k_scale[None], v_scale[None]
+        layer = None
+    # A multi-layer pool without a layer index would silently attend
+    # layer 0 everywhere — fail at trace time instead.
+    assert k_pool.shape[0] == 1 or layer is not None, (
+        "multi-layer pool requires the `layer` index"
+    )
+    layer_arr = (
+        jnp.zeros((1,), jnp.int32) if layer is None
+        else jnp.asarray(layer, jnp.int32).reshape(1)
+    )
     B, KVH, TG, d = q.shape
     NB, BLK = pool_pos.shape
     MB = table.shape[1]
-    assert k_pool.shape == (KVH, NB, BLK, d), (k_pool.shape, (KVH, NB, BLK, d))
+    L = k_pool.shape[0]
+    assert k_pool.shape == (L, KVH, NB, BLK, d), (
+        k_pool.shape, (L, KVH, NB, BLK, d)
+    )
     assert TG % t_tokens == 0, (TG, t_tokens)
     group = TG // t_tokens
     quantized = k_scale is not None
@@ -269,36 +297,36 @@ def paged_pool_attention(
         mb = jnp.minimum(mb, jnp.maximum(bound[b] - 1, 0))
         return jnp.minimum(tbl[b * MB + mb], NB - 1)
 
-    def kv_map(b, mb, tbl, qpos, bound):
-        return (0, _clamp_mb(b, mb, tbl, bound), 0, 0)
+    def kv_map(b, mb, tbl, qpos, bound, layer):
+        return (layer[0], 0, _clamp_mb(b, mb, tbl, bound), 0, 0)
 
-    def pos_map(b, mb, tbl, qpos, bound):
+    def pos_map(b, mb, tbl, qpos, bound, layer):
         return (_clamp_mb(b, mb, tbl, bound), 0, 0)
 
-    def q_map(b, mb, tbl, qpos, bound):
+    def q_map(b, mb, tbl, qpos, bound, layer):
         return (b, 0, 0, 0)
 
     in_specs = [
         pl.BlockSpec((1, KVH, TG8, d), q_map),
-        pl.BlockSpec((KVH, 1, BLK, d), kv_map),
-        pl.BlockSpec((KVH, 1, BLK, d), kv_map),
+        pl.BlockSpec((1, KVH, 1, BLK, d), kv_map),
+        pl.BlockSpec((1, KVH, 1, BLK, d), kv_map),
         pl.BlockSpec((1, 1, BLK), pos_map),
     ]
     operands = [qg, k_pool, v_pool, pos_r]
     if quantized:
-        # Narrow-sublane scale planes [KVH, NB, 1, BLK]: free expand_dims
-        # views of the long-lived pool scales — NOT sublane-replicated
-        # copies, which would re-materialize (and stream) 8x the scale
-        # bytes per layer per step on the path this kernel exists to
-        # make bandwidth-lean.
-        def scale_map(b, mb, tbl, qpos, bound):
-            return (0, _clamp_mb(b, mb, tbl, bound), 0, 0)
+        # Narrow-sublane scale planes [L, KVH, NB, 1, BLK]: free
+        # expand_dims views of the long-lived pool scales — NOT sublane-
+        # replicated copies, which would re-materialize (and stream) 8x
+        # the scale bytes per layer per step on the path this kernel
+        # exists to make bandwidth-lean.
+        def scale_map(b, mb, tbl, qpos, bound, layer):
+            return (layer[0], 0, _clamp_mb(b, mb, tbl, bound), 0, 0)
 
-        scale_spec = pl.BlockSpec((KVH, 1, 1, BLK), scale_map)
+        scale_spec = pl.BlockSpec((1, KVH, 1, 1, BLK), scale_map)
         in_specs += [scale_spec, scale_spec]
         operands += [
-            k_scale.astype(jnp.float32)[:, :, None, :],
-            v_scale.astype(jnp.float32)[:, :, None, :],
+            k_scale.astype(jnp.float32)[:, :, :, None, :],
+            v_scale.astype(jnp.float32)[:, :, :, None, :],
         ]
 
     out, lse = pl.pallas_call(
@@ -307,7 +335,7 @@ def paged_pool_attention(
             t_tokens=t_tokens, group=group, quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=4,
             grid=(B, MB),
             in_specs=in_specs,
             out_specs=(
@@ -335,7 +363,7 @@ def paged_pool_attention(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(tbl_flat, q_pos, bound, *operands)
+    )(tbl_flat, q_pos, bound, layer_arr, *operands)
     return out[:, :, :TG, :], lse[:, :, :TG, 0]
 
 
@@ -343,13 +371,14 @@ def paged_decode_attention(
     q: jnp.ndarray,        # [B, T, H, d] — this step's queries
     k_new: jnp.ndarray,    # [B, T, KVH, d] — this step's projections
     v_new: jnp.ndarray,    # [B, T, KVH, d]
-    k_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
-    v_pool: jnp.ndarray,   # [KVH, NB, BLK, d]
+    k_pool: jnp.ndarray,   # [L, KVH, NB, BLK, d] (or [KVH, NB, BLK, d])
+    v_pool: jnp.ndarray,   # [L, KVH, NB, BLK, d]
     pool_pos: jnp.ndarray,  # [NB, BLK]
     table: jnp.ndarray,    # [B, MB]
     q_pos: jnp.ndarray,    # [B] FIRST token's position (-1 = inactive row)
-    k_scale: Optional[jnp.ndarray] = None,  # [KVH, NB, BLK] (int8 pool)
+    k_scale: Optional[jnp.ndarray] = None,  # [L, KVH, NB, BLK] (int8 pool)
     v_scale: Optional[jnp.ndarray] = None,
+    layer: Optional[jnp.ndarray] = None,    # int32 index into L
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """One decode step of attention over (pool blocks ∪ the step's T new
@@ -402,21 +431,35 @@ def paged_decode_attention(
             rows = row_axes if row_axes else None
             tens = "tensor" if tp > 1 else None
             head4 = P(rows, None, tens, None)
-            pool4 = P(tens, None, None, None)
-            args = [q, k_new, v_new, k_pool, v_pool, pool_pos, table, q_pos]
+            pooled = (
+                P(None, tens, None, None, None) if k_pool.ndim == 5
+                else P(tens, None, None, None)
+            )
+            scale_spec = (
+                P(None, tens, None, None) if k_pool.ndim == 5
+                else P(tens, None, None)
+            )
+            layer_op = (
+                jnp.zeros((), jnp.int32) if layer is None
+                else jnp.asarray(layer, jnp.int32).reshape(())
+            )
+            args = [
+                q, k_new, v_new, k_pool, v_pool, pool_pos, table, q_pos,
+                layer_op,
+            ]
             in_specs = [
-                head4, head4, head4, pool4, pool4, P(None, None),
-                P(rows, None), P(rows),
+                head4, head4, head4, pooled, pooled, P(None, None),
+                P(rows, None), P(rows), P(),
             ]
             if k_scale is not None:
                 args += [k_scale, v_scale]
-                in_specs += [P(tens, None, None), P(tens, None, None)]
+                in_specs += [scale_spec, scale_spec]
 
             def body(q, k_new, v_new, k_pool, v_pool, pool_pos, table,
-                     q_pos, k_scale=None, v_scale=None):
+                     q_pos, layer, k_scale=None, v_scale=None):
                 return _paged_decode_local(
                     q, k_new, v_new, k_pool, v_pool, pool_pos, table,
-                    q_pos, k_scale, v_scale, interpret,
+                    q_pos, k_scale, v_scale, layer, interpret,
                 )
 
             fn = jax.shard_map(
@@ -427,13 +470,13 @@ def paged_decode_attention(
 
     return _paged_decode_local(
         q, k_new, v_new, k_pool, v_pool, pool_pos, table, q_pos,
-        k_scale, v_scale, interpret,
+        k_scale, v_scale, layer, interpret,
     )
 
 
 def _paged_decode_local(
     q, k_new, v_new, k_pool, v_pool, pool_pos, table, q_pos,
-    k_scale, v_scale, interpret,
+    k_scale, v_scale, layer, interpret,
 ):
     """Single-shard body of ``paged_decode_attention`` (also the whole op
     when no mesh is active)."""
@@ -448,7 +491,8 @@ def _paged_decode_local(
     qg = jnp.swapaxes(q5, 1, 2).reshape(B, KVH, T * G, d)
     out_pool, lse = paged_pool_attention(
         qg, k_pool, v_pool, pool_pos, table, q_pos,
-        k_scale=k_scale, v_scale=v_scale, t_tokens=T, interpret=interpret,
+        k_scale=k_scale, v_scale=v_scale, t_tokens=T, layer=layer,
+        interpret=interpret,
     )
     out_pool = out_pool.reshape(B, KVH, T, G, d)
     lse = lse.reshape(B, KVH, T, G)

@@ -868,9 +868,20 @@ class ContinuousBatcher:
         self.use_pallas_kernel = use_pallas_kernel
         self.n_slots = n_slots
         self.max_len = max_len or config.max_seq_len
-        self.block_size = block_size or min(
-            128, max(16, self.max_len // 16)
-        )
+        if block_size is None:
+            # Larger blocks raise the kernel's DMA efficiency (it
+            # fetches one [KVH, BLK, d] tile per table entry; at a 16k
+            # context the decode step measured 8.9 -> 5.8 ms/step going
+            # 128 -> 512) at the cost of allocation granularity, which
+            # only matters when slots are short.  Tiered default:
+            # capacity-friendly 128 short, bandwidth-friendly up long.
+            if self.max_len >= 16384:
+                block_size = 512
+            elif self.max_len >= 8192:
+                block_size = 256
+            else:
+                block_size = min(128, max(16, self.max_len // 16))
+        self.block_size = block_size
         self.blocks_per_slot = -(-self.max_len // self.block_size)
         self.n_blocks = n_blocks or n_slots * self.blocks_per_slot
         self.default_stop = frozenset(int(s) for s in stop_tokens)
