@@ -197,3 +197,67 @@ def test_model_decode_on_chip_flash_vs_xla():
     # bf16 near-ties can legitimately flip a late token; require the
     # first half of the generations to agree exactly.
     assert (out_auto[:, : 32 + 4] == out_xla[:, : 32 + 4]).all()
+
+
+@requires_tpu
+def test_paged_decode_step_no_full_pool_copies_compiled():
+    """Two r4 wins, pinned against regression in the COMPILED decode
+    step's optimized HLO:
+
+    * the batched pool scatter used to make XLA:TPU relayout the whole
+      KV pool to a KVH-minor layout and back every step (four full-pool
+      copies, ~3.2 ms/step at bench scale) — replaced by
+      ``paged_pool_write``'s in-place dynamic_update_slice chain;
+    * the layer scan used to materialize every layer's pool plane as a
+      dynamic-slice copy feeding the kernel's custom-call operand
+      (~3x the kernel's own time at 16k) — replaced by the
+      layer-indexed kernel reading the full pool in place.
+
+    Either regression reappears as a `copy` / dynamic-slice fusion of a
+    pool-sized [L, KVH, NB, BLK, d] (or one-layer [KVH, NB, BLK, d])
+    array in the HLO text, so assert there is none.
+    """
+    import re
+
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.serving import ContinuousBatcher
+
+    # bf16 params: the serving dtype.  (An fp32 pool additionally gets a
+    # pair of async memory-space staging copies from XLA:TPU that are
+    # unrelated to either regression guarded here.)
+    cfg = get_config(
+        "tiny", dim=256, n_layers=4, n_heads=4, n_kv_heads=2,
+        vocab_size=512, max_seq_len=256, param_dtype="bfloat16",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cb = ContinuousBatcher(params, cfg, n_slots=4, max_len=256,
+                           block_size=32)
+    rng = np.random.RandomState(5)
+    for _ in range(4):
+        cb.submit(list(rng.randint(1, cfg.vocab_size, 100)),
+                  max_new_tokens=4)
+    cb.step()  # admission; decode-step program now has concrete args
+
+    from jax_llama_tpu import serving as srv
+
+    L, KVH = cfg.n_layers, cfg.kv_heads
+    NB, BLK = cb.pool.pos.shape
+    d = cfg.head_dim
+    lowered = srv._paged_decode_step.lower(
+        cb.params, cb.pool, jnp.array(cb.table), jnp.array(cb.n_alloc),
+        jnp.array(cb.fill), cb.tau, jnp.array(cb.pos),
+        jnp.array(cb.active), cb.keys, jnp.array(cb.temp_arr),
+        jnp.array(cb.top_p_arr), jnp.array(cb.top_k_arr),
+        config=cb.config, all_greedy=True, mesh=None, allow_kernel=True,
+        with_logprobs=False,
+    )
+    txt = lowered.compile().as_text()
+    pool_shape = rf"{L},{KVH},{NB},{BLK},{d}"
+    plane_shape = rf"{KVH},{NB},{BLK},{d}"
+    offenders = [
+        line.strip()[:140]
+        for line in txt.splitlines()
+        if re.search(rf"(copy|dynamic-slice)[^=]*=[^=]*\[({pool_shape}|{plane_shape})\]", line)
+        or (" copy(" in line and f"[{pool_shape}]" in line)
+    ]
+    assert not offenders, offenders
