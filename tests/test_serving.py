@@ -339,3 +339,22 @@ def test_host_threefry_key_layout():
         expect = np.asarray(jax.random.PRNGKey(seed))
         host = np.array([0, seed & 0xFFFFFFFF], np.uint32)
         assert (expect == host).all(), (seed, expect, host)
+
+
+def test_block_size_tiered_default():
+    """The default block size trades allocation granularity for kernel
+    DMA efficiency as capacity grows (on-chip swept r4: 16k serving
+    decode 8.9 -> 5.8 ms/step going 128 -> 512); explicit block_size
+    still wins."""
+    cfg = get_config(
+        "tiny", dim=64, n_layers=2, n_heads=2, n_kv_heads=1,
+        vocab_size=128, max_seq_len=16384,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    for max_len, expect in ((512, 32), (2048, 128), (8192, 256),
+                            (16384, 512)):
+        cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=max_len)
+        assert cb.block_size == expect, (max_len, cb.block_size)
+    cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=16384,
+                           block_size=64)
+    assert cb.block_size == 64
