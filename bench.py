@@ -544,11 +544,13 @@ def main() -> None:
         # --------------------------------------------------------------
         # Training step throughput (the subsystem the reference lacks
         # entirely): one AdamW step on the bench model, B=4 x S=2048,
-        # bf16 params, per-block remat (remat=False OOMs this chip at
-        # 1B scale), flash-attention VJP.  Device time from a trace of
-        # ONE donated step; MFU counts fwd 2NT + bwd 4NT matmul flops
-        # plus 3x the causal attention flops — remat recompute is NOT
-        # counted as useful work (standard MFU convention).
+        # bf16 params, per-block remat with the default "dots" policy
+        # (save matmul outputs; remat=False OOMs this chip at 1B scale,
+        # full recompute costs +13%), flash-attention VJP.  Device time
+        # from a trace of ONE donated step; MFU counts fwd 2NT + bwd 4NT
+        # matmul flops plus 3x the causal attention flops — remat
+        # recompute is NOT counted as useful work (standard MFU
+        # convention).
         # --------------------------------------------------------------
         try:
             from jax_llama_tpu.train import (

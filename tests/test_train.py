@@ -203,3 +203,31 @@ def test_dropout_refusals():
 
     logits = run(sp, tb, jnp.tile(p16, (2, 1)), jax.random.PRNGKey(0))
     assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
+def test_remat_policies_identical_gradients():
+    """remat_policy changes WHAT is recomputed, never the math: loss and
+    gradients must be bit-identical across "dots" / "full" / no remat on
+    the fp32 CPU path."""
+    results = {}
+    for label, kw in (
+        ("none", dict(remat=False)),
+        ("full", dict(remat=True, remat_policy="full")),
+        ("dots", dict(remat=True, remat_policy="dots")),
+    ):
+        config = cfg_lib.get_config(
+            "tiny", dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            vocab_size=128, max_seq_len=32, **kw,
+        )
+        params = init_params(jax.random.PRNGKey(0), config)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 128, (2, 32)), jnp.int32
+        )
+        loss, grads = jax.value_and_grad(lm_loss)(params, toks, config)
+        results[label] = (float(loss), jax.tree_util.tree_leaves(grads))
+    base_loss, base_grads = results["none"]
+    for label in ("full", "dots"):
+        loss, grads = results[label]
+        assert loss == base_loss, (label, loss, base_loss)
+        for a, b in zip(grads, base_grads):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), label
