@@ -331,47 +331,10 @@ def main() -> None:
     lc_serving = None
     train_metrics = None
     try:
-        import collections
-        import glob
-        import re
-        import tempfile
 
-        def _traced_op_agg(thunk, by_source: bool):
-            """Run `thunk` under a profiler trace; return device-op time
-            (ps) aggregated by HLO source file (by_source) or op name."""
-            tmpdir = tempfile.mkdtemp(prefix="bench_xplane_")
-            jax.profiler.start_trace(tmpdir)
-            thunk()
-            jax.profiler.stop_trace()
-            from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-            xp = glob.glob(f"{tmpdir}/**/*.xplane.pb", recursive=True)[0]
-            xs = xplane_pb2.XSpace()
-            with open(xp, "rb") as f:
-                xs.ParseFromString(f.read())
-            plane = next(p for p in xs.planes if "TPU" in p.name)
-            sm = {k: v.name for k, v in plane.stat_metadata.items()}
-            md_name, md_src = {}, {}
-            for k, v in plane.event_metadata.items():
-                md_name[k] = v.name
-                src = next(
-                    (
-                        st.str_value
-                        for st in v.stats
-                        if sm.get(st.metadata_id) == "source"
-                    ),
-                    "",
-                )
-                m = re.search(r"/(\w+\.py):", src)
-                md_src[k] = m.group(1) if m else "other"
-            line = next(ln for ln in plane.lines if ln.name == "XLA Ops")
-            agg = collections.Counter()
-            for e in line.events:
-                if md_name[e.metadata_id].startswith("%while"):
-                    continue  # outer loops double-count their bodies
-                key = md_src if by_source else md_name
-                agg[key[e.metadata_id]] += e.duration_ps
-            return agg
+        # The framework's own measurement primitive (see its docstring
+        # for the tunnel-vs-device rationale).
+        from jax_llama_tpu.utils.profiling import device_op_times
 
         def _trace_device_ps(max_new: int):
             """Sum of device-op time (ps) for one traced generate call,
@@ -387,7 +350,7 @@ def main() -> None:
                 ))
 
             go()  # warmup outside the trace
-            return _traced_op_agg(go, by_source=True)
+            return device_op_times(go, by="source")
 
         agg32 = _trace_device_ps(32)
         step_breakdown = {
@@ -466,8 +429,8 @@ def main() -> None:
                     l.size * l.dtype.itemsize for l in ls
                 ) + kv_buf.size * 2
                 float(_stream(ls, kv_buf))  # warmup
-                agg = _traced_op_agg(
-                    lambda: float(_stream(ls, kv_buf)), by_source=False
+                agg = device_op_times(
+                    lambda: float(_stream(ls, kv_buf)), by="op"
                 )
                 t = sum(agg.values()) / 1e12
                 return B / t, nbytes / t / 1e9
@@ -483,6 +446,15 @@ def main() -> None:
             hbm_ceiling_tps_int8, _ = _stream_ceiling(qleaves)
         except Exception:
             pass
+        finally:
+            # Drop the probe buffers AND the int8 param copy (the
+            # ceiling probe is its last consumer): together ~1.1 GB of
+            # HBM the later sections — the 6 GB training state
+            # especially — need.  In a finally so a failure above can't
+            # leak them into the training section and masquerade as an
+            # unrelated training OOM.
+            leaves = qleaves = kv_buf = None  # noqa: F841
+            qparams = None  # noqa: F841
 
         # --------------------------------------------------------------
         # LONG-CONTEXT paged serving (VERDICT r3 item 8): the paged
@@ -516,8 +488,8 @@ def main() -> None:
                     )
                 cb.step()   # admission (chunked prefills) + first decode
                 cb.step()   # decode-step compile warmup
-                agg = _traced_op_agg(
-                    lambda: [cb.step() for _ in range(8)], by_source=True
+                agg = device_op_times(
+                    lambda: [cb.step() for _ in range(8)], by="source"
                 )
                 while cb.pending():
                     cb.step()
@@ -564,9 +536,14 @@ def main() -> None:
             tcfg = config.replace(
                 max_seq_len=2048, remat=True, attn_impl="flash"
             )
-            tparams = jlt.init_params(jax.random.PRNGKey(3), tcfg)
+            # Reuse the bench params as the training params: values are
+            # random either way, and a second 2 GB init pushed this
+            # section over the chip's HBM alongside the 6 GB train
+            # state.  train_step DONATES the state, so this must stay
+            # the LAST section that touches `params` (it is: every
+            # other consumer runs above).
             topt = make_optimizer()
-            tstate = init_train_state(tparams, topt)
+            tstate = init_train_state(params, topt)
             TB, TS = 4, 2048
             ttoks = jnp.asarray(
                 rng.randint(0, config.vocab_size, (TB, TS)), jnp.int32
@@ -583,7 +560,7 @@ def main() -> None:
                 )
                 float(tl)
 
-            tagg = _traced_op_agg(_one_step, by_source=False)
+            tagg = device_op_times(_one_step, by="op")
             t_dev = sum(tagg.values()) / 1e12
             n_mat = n_params - embed_entries
             tflops = (
@@ -599,8 +576,12 @@ def main() -> None:
                     if is_v5e else None
                 ),
             }
-        except Exception:
-            train_metrics = None
+        except Exception as e:  # keep the bench's one-line contract,
+            # but leave a diagnosable trace instead of a silent null
+            # (an OOM here once hid behind "training": null).
+            train_metrics = {
+                "error": f"{type(e).__name__}: {str(e)[:160]}"
+            }
     except Exception:
         step_breakdown = None
         device_toks_per_s = None

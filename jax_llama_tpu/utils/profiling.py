@@ -10,10 +10,15 @@ BASELINE.json metric).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
+import glob
+import re
+import shutil
+import tempfile
 import time
-from typing import Iterator, Optional
+from typing import Callable, Dict, Iterator, Optional
 
 import jax
 
@@ -53,6 +58,69 @@ class Timer:
 def _block_on_pending() -> None:
     # effects_barrier waits for all dispatched-but-unfinished computations.
     jax.effects_barrier()
+
+
+def device_op_times(
+    thunk: Callable[[], None],
+    *,
+    by: str = "op",
+    device_substr: str = "TPU",
+) -> Dict[str, int]:
+    """Run ``thunk`` under a profiler trace and return device-op time in
+    PICOSECONDS aggregated by HLO op name (``by="op"``) or by the source
+    file XLA attributes the op to (``by="source"``).
+
+    This is the measurement primitive behind every perf number in
+    bench.py/ROADMAP.md: wall-clock timing of a single dispatch in a
+    tunneled/dev environment measures the dispatch overhead, not the op
+    (a 13 ms kernel reads as ~110 ms), while device-op durations from
+    the xplane are stable to ~0.01% run-to-run.  Caller contract: warm
+    the thunk (compile) BEFORE calling, or the trace will be dominated
+    by compilation; outer ``%while`` ops are dropped so loop bodies are
+    not double-counted.
+
+    Requires the TensorFlow profiler protos (`tensorflow.tsl`); raises
+    ImportError where unavailable.
+    """
+    assert by in ("op", "source"), by
+    tmpdir = tempfile.mkdtemp(prefix="jlt_xplane_")
+    try:
+        # trace() stops the profiler even when thunk raises — a leaked
+        # active profiler would fail every later start_trace in the
+        # process, cascading one failure into many.
+        with trace(tmpdir):
+            thunk()
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+        path = glob.glob(f"{tmpdir}/**/*.xplane.pb", recursive=True)[0]
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            space.ParseFromString(f.read())
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    plane = next(p for p in space.planes if device_substr in p.name)
+    stat_names = {k: v.name for k, v in plane.stat_metadata.items()}
+    op_name, op_src = {}, {}
+    for k, v in plane.event_metadata.items():
+        op_name[k] = v.name
+        src = next(
+            (
+                st.str_value
+                for st in v.stats
+                if stat_names.get(st.metadata_id) == "source"
+            ),
+            "",
+        )
+        m = re.search(r"/(\w+\.py):", src)
+        op_src[k] = m.group(1) if m else "other"
+    line = next(ln for ln in plane.lines if ln.name == "XLA Ops")
+    agg: Dict[str, int] = collections.Counter()
+    key = op_name if by == "op" else op_src
+    for e in line.events:
+        if op_name[e.metadata_id].startswith("%while"):
+            continue  # outer loops double-count their bodies
+        agg[key[e.metadata_id]] += e.duration_ps
+    return agg
 
 
 @dataclasses.dataclass

@@ -261,3 +261,26 @@ def test_paged_decode_step_no_full_pool_copies_compiled():
         or (" copy(" in line and f"[{pool_shape}]" in line)
     ]
     assert not offenders, offenders
+
+
+@requires_tpu
+def test_device_op_times_compiled():
+    """utils.profiling.device_op_times — the measurement primitive behind
+    every bench/ROADMAP perf number — attributes device time to a known
+    dominant op, in both aggregation modes, on a real trace."""
+    from jax_llama_tpu.utils.profiling import device_op_times
+
+    a = jnp.ones((1024, 1024), jnp.bfloat16)
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    float(f(a))  # compile outside the trace
+    by_op = device_op_times(lambda: float(f(a)), by="op")
+    assert by_op and all(v >= 0 for v in by_op.values())
+    # The matmul fusion dominates a trace whose only work is a matmul.
+    top = max(by_op, key=by_op.get)
+    assert "fusion" in top or "convolution" in top or "dot" in top, top
+    by_src = device_op_times(lambda: float(f(a)), by="source")
+    assert sum(by_src.values()) > 0
