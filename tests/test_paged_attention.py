@@ -567,3 +567,43 @@ def test_use_pallas_kernel_toggle_token_identical():
 
     for spec in (False, True):
         assert run(True, spec) == run(False, spec), f"spec={spec}"
+
+
+def test_paged_pool_write_matches_scatter_drop_semantics():
+    """paged_pool_write (the DUS chain that replaced the batched scatter
+    to kill XLA:TPU's full-pool layout copies) must match
+    ``plane.at[..., blk, off].set(upd, mode="drop")`` exactly — including
+    dropped sentinel coordinates — on all three plane ranks."""
+    from jax_llama_tpu.models.llama import paged_pool_write
+
+    rng = np.random.RandomState(0)
+    L, KVH, NB, BLK, d = 3, 2, 5, 8, 16
+    B, T = 4, 2
+    # DISTINCT live (blk, off) pairs: with duplicate targets the scatter
+    # reference's write order is unspecified while the DUS chain is
+    # last-write-wins, so equality would hinge on the seed.  (Callers
+    # never produce duplicate live coordinates: paged_write_indices maps
+    # each (row, token) to its own slot.)
+    flat = rng.choice(NB * BLK, size=B * T, replace=False)
+    blk = jnp.asarray(flat // BLK, jnp.int32).reshape(B, T)
+    off = jnp.asarray(flat % BLK, jnp.int32).reshape(B, T)
+    # Row 2 entirely dead; one more dead (row, token) pair.
+    blk = blk.at[2].set(NB).at[0, 1].set(NB)
+
+    plane5 = jnp.asarray(rng.randn(L, KVH, NB, BLK, d), jnp.float32)
+    upd5 = jnp.asarray(rng.randn(L, KVH, B, T, d), jnp.float32)
+    want5 = plane5.at[:, :, blk, off].set(upd5, mode="drop")
+    got5 = paged_pool_write(plane5, upd5, blk, off)
+    assert np.array_equal(np.asarray(got5), np.asarray(want5))
+
+    plane4 = jnp.asarray(rng.randn(L, KVH, NB, BLK), jnp.float32)
+    upd4 = jnp.asarray(rng.randn(L, KVH, B, T), jnp.float32)
+    want4 = plane4.at[:, :, blk, off].set(upd4, mode="drop")
+    got4 = paged_pool_write(plane4, upd4, blk, off)
+    assert np.array_equal(np.asarray(got4), np.asarray(want4))
+
+    plane2 = jnp.asarray(rng.randint(-5, 99, (NB, BLK)), jnp.int32)
+    upd2 = jnp.asarray(rng.randint(100, 200, (B, T)), jnp.int32)
+    want2 = plane2.at[blk, off].set(upd2, mode="drop")
+    got2 = paged_pool_write(plane2, upd2, blk, off)
+    assert np.array_equal(np.asarray(got2), np.asarray(want2))
