@@ -631,6 +631,11 @@ def main() -> None:
             "hbm_ceiling_measured_gbps": (
                 round(hbm_ceiling_gbps, 1) if hbm_ceiling_gbps else None
             ),
+            # NB: the int8 probe's sum-reduce converts one BYTE per
+            # element, so at int8 density the VPU convert — not HBM —
+            # can bound the probe; treat this as a LOWER bound on the
+            # int8 streaming ceiling (the int8 decode legitimately
+            # lands a few % above it).
             "hbm_ceiling_measured_tokens_per_s_int8": (
                 round(hbm_ceiling_tps_int8, 1)
                 if hbm_ceiling_tps_int8 else None
