@@ -472,9 +472,9 @@ def main() -> None:
             def lc_serve_device_ms(
                 ctx: int, max_len: int, use_kernel: bool
             ) -> float:
-                # block_size=None: the batcher's tiered default (256 at
-                # 8k, 512 at 16k — the on-chip-swept DMA-efficiency
-                # sweet spots); identical geometry on both paths.
+                # block_size=None: the batcher's default (512 at both
+                # capacities — the on-chip-swept DMA-efficiency sweet
+                # spot); identical geometry on both paths.
                 cb = ContinuousBatcher(
                     params, lc_cfg, n_slots=2, max_len=max_len,
                     prefill_chunk=2048, use_pallas_kernel=use_kernel,
@@ -496,10 +496,10 @@ def main() -> None:
                 return sum(agg.values()) / 8 / 1e9
 
             lc_serving = {}
-            # Contexts are block-multiples of the tiered default sizes
-            # so padded prompt + 33 new tokens fits the capacity.
+            # Contexts are block-multiples of the default size so the
+            # padded prompt + 33 new tokens fits the capacity.
             for ctx, max_len, label in (
-                (7936, 8192, "8k"), (15872, 16384, "16k")
+                (7680, 8192, "8k"), (15872, 16384, "16k")
             ):
                 for use_kernel, path in ((True, "kernel"),
                                          (False, "gathered")):

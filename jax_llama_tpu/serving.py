@@ -870,15 +870,18 @@ class ContinuousBatcher:
         self.max_len = max_len or config.max_seq_len
         if block_size is None:
             # Larger blocks raise the kernel's DMA efficiency (it
-            # fetches one [KVH, BLK, d] tile per table entry; at a 16k
-            # context the decode step measured 8.9 -> 5.8 ms/step going
-            # 128 -> 512) at the cost of allocation granularity, which
-            # only matters when slots are short.  Tiered default:
-            # capacity-friendly 128 short, bandwidth-friendly up long.
-            if self.max_len >= 16384:
+            # fetches one [KVH, BLK, d] tile per table entry; on-chip
+            # sweeps measured the decode step at a 16k context going
+            # 8.9 -> 5.8 ms/step from 128 -> 512 blocks, and 5.5 -> 4.3
+            # at 8k) at the cost of allocation granularity.  Default:
+            # capacity-friendly 128-and-down short, bandwidth-friendly
+            # 512 at >= 8k.  Granularity trade at the default: prompts
+            # pad to a block multiple, so the longest admissible prompt
+            # is max_len rounded DOWN to the block size minus max_new —
+            # a request within 512 tokens of capacity needs an explicit
+            # smaller block_size.
+            if self.max_len >= 8192:
                 block_size = 512
-            elif self.max_len >= 8192:
-                block_size = 256
             else:
                 block_size = min(128, max(16, self.max_len // 16))
         self.block_size = block_size

@@ -351,7 +351,7 @@ def test_block_size_tiered_default():
         vocab_size=128, max_seq_len=16384,
     )
     params = init_params(jax.random.PRNGKey(0), cfg)
-    for max_len, expect in ((512, 32), (2048, 128), (8192, 256),
+    for max_len, expect in ((512, 32), (2048, 128), (8192, 512),
                             (16384, 512)):
         cb = ContinuousBatcher(params, cfg, n_slots=1, max_len=max_len)
         assert cb.block_size == expect, (max_len, cb.block_size)
