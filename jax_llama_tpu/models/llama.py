@@ -706,7 +706,7 @@ def _block(
                     q, kk, vv, positions, slot_pos,
                     dropout_rate=config.attn_pdrop,
                     dropout_seed=jax.random.bits(
-                        jax.random.fold_in(dropout_rng, 0), (1,), "uint32"
+                        jax.random.fold_in(dropout_rng, 0), (2,), "uint32"
                     ),
                 )
             else:

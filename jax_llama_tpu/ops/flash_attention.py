@@ -67,7 +67,7 @@ def _mix32(x):
     return x
 
 
-def _dropout_keep(seed, b, h, row0, col0, bq, bk, rate):
+def _dropout_keep(seed_lo, seed_hi, b, h, row0, col0, bq, bk, rate):
     """Deterministic keep-mask tile [bq, bk] for probability dropout.
     ``row0``/``col0`` are the tile's GLOBAL element offsets (callers pass
     tile_index * tile_size — plus any sub-tile offset), so the hash is a
@@ -76,36 +76,41 @@ def _dropout_keep(seed, b, h, row0, col0, bq, bk, rate):
 
     Keyed on (seed, batch, head, global row, global column) so any kernel
     that knows its tile coordinates rebuilds the exact same Bernoulli draw;
-    element (r, c) keeps with probability 1 - rate.  Three mixes: one per
-    (batch, head), one per row [bq, 1], one elementwise [bq, bk] — the
-    per-element VPU cost is a handful of integer ops.
+    element (r, c) keeps with probability 1 - rate.  The per-call seed is
+    TWO uint32 words (64 bits): a single word birthday-collides across
+    ~65k training steps per layer, silently reusing whole mask planes.
+    Crucially the two words are NOT folded into one 32-bit base (that
+    would re-create the same 32-bit birthday horizon, just decorrelated
+    across planes): ``seed_lo`` keys the per-ROW words and ``seed_hi``
+    the per-COLUMN words, so a repeated mask plane needs both 32-bit
+    bases to collide simultaneously — a 64-bit event.  Cost: one extra
+    per-column mix [1, bk]; the elementwise [bq, bk] hash is unchanged.
 
-    Row and column enter the element hash JOINTLY (xor of the mixed row
-    word with the odd-multiplied column, not ``mix(row_word + col)``):
-    an additive column would make every row a shifted window into one
-    1-D keep sequence, so row pairs whose mixed words land within S of
-    each other would share diagonal runs of mask bits.  Remaining
-    statistical caveat (documented, accepted): the per-call seed is a
-    single uint32, so across ~65k training steps per layer seeds
-    birthday-collide and those steps reuse a mask plane; this biases
-    long-horizon mask statistics only — fwd/bwd bit-consistency and
-    per-step correctness are unaffected.
+    Row and column enter the element hash JOINTLY (xor of two
+    independently mixed words, not ``mix(row_word + col)``): an additive
+    column would make every row a shifted window into one 1-D keep
+    sequence, so row pairs whose mixed words land within S of each other
+    would share diagonal runs of mask bits.
     """
-    base = _mix32(
-        seed
-        ^ _mix32(
-            b.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
-            + h.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
-            + jnp.uint32(1)
-        )
+    plane = _mix32(
+        b.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+        + h.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+        + jnp.uint32(1)
     )
+    base_lo = _mix32(seed_lo ^ plane)
+    # The lane constant keeps base_hi independent of base_lo when
+    # seed_hi == seed_lo (e.g. a widened legacy seed of 0).
+    base_hi = _mix32(seed_hi ^ plane ^ jnp.uint32(0x85EBCA6B))
     rows = jax.lax.broadcasted_iota(jnp.uint32, (bq, 1), 0) + jnp.asarray(
         row0
     ).astype(jnp.uint32)
     cols = jax.lax.broadcasted_iota(jnp.uint32, (1, bk), 1) + jnp.asarray(
         col0
     ).astype(jnp.uint32)
-    bits = _mix32(_mix32(base ^ rows) ^ (cols * jnp.uint32(0x9E3779B9)))
+    bits = _mix32(
+        _mix32(base_lo ^ rows)
+        ^ _mix32(base_hi ^ (cols * jnp.uint32(0x9E3779B9)))
+    )
     threshold = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
     return bits >= threshold
 
@@ -121,7 +126,7 @@ def _flash_kernel(
     dropout_rate: float = 0.0,
 ):
     if dropout_rate > 0.0:
-        seed_ref, *args = args  # [1] uint32 scalar-prefetch
+        seed_ref, *args = args  # [2] uint32 scalar-prefetch (64-bit seed)
     else:
         seed_ref = None
     q_pos_ref, kv_pos_ref, q_ref, k_ref, v_ref, *rest = args
@@ -262,8 +267,8 @@ def _flash_kernel(
                 # sub-tiling draws the identical bits the (untiled)
                 # backward kernels rebuild.
                 keep = _dropout_keep(
-                    seed_ref[0], bi, hi, qi * bq, ki * bk + i * ksub,
-                    bq, ksub, dropout_rate,
+                    seed_ref[0], seed_ref[1], bi, hi,
+                    qi * bq, ki * bk + i * ksub, bq, ksub, dropout_rate,
                 )
                 p_acc = jnp.where(keep, p, 0.0) * (
                     1.0 / (1.0 - dropout_rate)
@@ -309,6 +314,19 @@ def _flash_kernel(
                     jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
                 )
             ) * float(np.log(2.0))
+
+
+def _normalize_seed(dropout_seed) -> jnp.ndarray:
+    """Widen a scalar / [1] / [2] uint32 seed to the kernels' [2]-word
+    (64-bit) layout; legacy single-word callers get a zero high word."""
+    seed = jnp.asarray(dropout_seed, jnp.uint32).reshape(-1)
+    if seed.size == 1:
+        return jnp.concatenate([seed, jnp.zeros((1,), jnp.uint32)])
+    if seed.size != 2:
+        raise ValueError(
+            f"dropout_seed must hold 1 or 2 uint32 words, got {seed.size}"
+        )
+    return seed
 
 
 def _pad_to(x: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
@@ -363,8 +381,10 @@ def flash_attention(
         generated *inside* the kernels from a counter-based hash — never
         materialized at [T, S] — and the backward kernels rebuild the
         identical mask, so gradients see exactly the forward's draw.
-      dropout_seed: [1] (or scalar) uint32 seed; required when
-        dropout_rate > 0.  Derive per call site, e.g. via jax.random.bits.
+      dropout_seed: [2] uint32 seed words (64 bits; scalar / [1] inputs
+        are widened with a zero high word); required when
+        dropout_rate > 0.  Derive per call site, e.g. via
+        ``jax.random.bits(key, (2,), "uint32")``.
     Returns:
       [B, T, H, d] in q.dtype.
     """
@@ -378,9 +398,9 @@ def flash_attention(
     if dropout_rate > 0.0:
         if dropout_seed is None:
             raise ValueError("dropout_rate > 0 requires dropout_seed")
-        seed = dropout_seed.reshape((1,)).astype(jnp.uint32)
+        seed = _normalize_seed(dropout_seed)
     else:
-        seed = jnp.zeros((1,), jnp.uint32)
+        seed = jnp.zeros((2,), jnp.uint32)
     if group > 1:
         # GQA query packing: fold the `group` query heads of each KV head
         # into the query-row axis, so the kernel grid runs over KV heads
@@ -649,7 +669,7 @@ def _flash_forward(
         operands += [_scale_plane(k_scale), _scale_plane(v_scale)]
     prefetch = [kv_bound_flat]
     if with_dropout:
-        prefetch.append(dropout_seed.reshape((1,)).astype(jnp.uint32))
+        prefetch.append(_normalize_seed(dropout_seed))
     out = pl.pallas_call(
         functools.partial(
             _flash_kernel, scale=scale, with_lse=need_lse,
@@ -711,7 +731,7 @@ def _flash_forward(
 def _flash_dq_kernel(
     *args, scale: float, dropout_rate: float = 0.0,
 ):
-    # With dropout a [1] uint32 seed_ref leads; lse_ref/delta_ref are
+    # With dropout a [2] uint32 seed_ref leads; lse_ref/delta_ref are
     # narrow-lane [1, 1, bq, 1] rows.
     if dropout_rate > 0.0:
         seed_ref, *args = args
@@ -750,8 +770,8 @@ def _flash_dq_kernel(
             # from the tile's GLOBAL element offsets (same hash as the
             # forward — tiling-independent by construction).
             keep = _dropout_keep(
-                seed_ref[0], bi, hi, qi * p.shape[0], ki * p.shape[1],
-                *p.shape, dropout_rate,
+                seed_ref[0], seed_ref[1], bi, hi,
+                qi * p.shape[0], ki * p.shape[1], *p.shape, dropout_rate,
             )
             dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
         ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
@@ -798,8 +818,8 @@ def _flash_dkv_kernel(
             # NOTE the grid here is (B, H, nk, nq), so qi/ki swap
             # program ids.
             keep = _dropout_keep(
-                seed_ref[0], bi, hi, qi * p.shape[0], ki * p.shape[1],
-                *p.shape, dropout_rate,
+                seed_ref[0], seed_ref[1], bi, hi,
+                qi * p.shape[0], ki * p.shape[1], *p.shape, dropout_rate,
             )
             inv = 1.0 / (1.0 - dropout_rate)
             p_v = jnp.where(keep, p, 0.0) * inv  # dV sees dropped weights
@@ -843,8 +863,7 @@ def _flash_backward(
     block_q, block_k = _clamp_blocks(T, S, block_q, block_k, interpret)
     with_dropout = dropout_rate > 0.0
     seed_ops = (
-        (dropout_seed.reshape((1,)).astype(jnp.uint32),)
-        if with_dropout else ()
+        (_normalize_seed(dropout_seed),) if with_dropout else ()
     )
 
     # Δ = rowsum(dO ∘ O): tiny elementwise pass outside the kernels.

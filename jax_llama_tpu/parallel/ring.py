@@ -50,7 +50,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import attention_bias, repeat_kv, sdpa
-from ..ops.flash_attention import MASK_VALUE, _mix32
+from ..ops.flash_attention import MASK_VALUE, _mix32, _normalize_seed
 from .mesh import current_mesh
 
 BATCH_AXES = ("data", "fsdp")
@@ -62,10 +62,16 @@ RING_CHUNK = 512
 
 
 def dropout_base(seed, B, H, b_off, h_off):
-    """Per-(global batch, global head) hash base [B, H] uint32 — the same
-    keying scheme as the flash kernels' ``_dropout_keep`` (one mix per
-    plane), with global indices supplied by the caller so every device of
-    a data/fsdp/tensor-sharded mesh draws an independent plane."""
+    """Per-(global batch, global head) hash bases [2, B, H] uint32 — the
+    same keying scheme as the flash kernels' ``_dropout_keep``: the
+    64-bit seed's low word keys the row-side base plane and its high
+    word the column-side plane, so a repeated mask plane needs BOTH
+    32-bit bases to collide — a 64-bit birthday event, not the old
+    single-word ~65k-step horizon.  Global indices are supplied by the
+    caller so every device of a data/fsdp/tensor-sharded mesh draws an
+    independent plane.  ``seed``: [2] uint32 (scalar / [1] legacy inputs
+    widen with a zero high word, validated by ``_normalize_seed``)."""
+    s = _normalize_seed(seed)
     gb = (
         jnp.asarray(b_off, jnp.uint32)
         + jnp.arange(B, dtype=jnp.uint32)[:, None]
@@ -74,14 +80,17 @@ def dropout_base(seed, B, H, b_off, h_off):
         jnp.asarray(h_off, jnp.uint32)
         + jnp.arange(H, dtype=jnp.uint32)[None, :]
     )
-    return _mix32(
-        jnp.asarray(seed, jnp.uint32)
-        ^ _mix32(
-            gb * jnp.uint32(0x9E3779B9)
-            + gh * jnp.uint32(0x85EBCA6B)
-            + jnp.uint32(1)
-        )
+    plane = _mix32(
+        gb * jnp.uint32(0x9E3779B9)
+        + gh * jnp.uint32(0x85EBCA6B)
+        + jnp.uint32(1)
     )
+    return jnp.stack([
+        _mix32(s[0] ^ plane),
+        # Same lane constant as _dropout_keep: keeps the two bases
+        # independent when the seed words coincide.
+        _mix32(s[1] ^ plane ^ jnp.uint32(0x85EBCA6B)),
+    ])
 
 
 def dropout_keep(base, q_pos, kv_pos, rate):
@@ -93,14 +102,17 @@ def dropout_keep(base, q_pos, kv_pos, rate):
     (row, column) pair and survives chunking, ring rotation, and any
     seq-mesh layout by construction (the property the flash kernels get
     from global tile indices).  Row and column enter the element hash
-    jointly (xor + odd multiply), same rationale as ``_dropout_keep``.
-    base: [B, H] (``dropout_base``); q_pos: [B, T]; kv_pos: [B, C].
+    jointly (xor of two independently mixed words), same rationale — and
+    the same two-base seed split — as ``_dropout_keep``.
+    base: [2, B, H] (``dropout_base``); q_pos: [B, T]; kv_pos: [B, C].
     """
     rows = q_pos.astype(jnp.uint32)[:, None, :, None]
     cols = kv_pos.astype(jnp.uint32)[:, None, None, :]
     bits = _mix32(
-        _mix32(base[:, :, None, None] ^ rows)
-        ^ (cols * jnp.uint32(0x9E3779B9))
+        _mix32(base[0][:, :, None, None] ^ rows)
+        ^ _mix32(
+            base[1][:, :, None, None] ^ (cols * jnp.uint32(0x9E3779B9))
+        )
     )
     threshold = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
     return bits >= threshold
@@ -323,12 +335,12 @@ def ring_sdpa(
         return ring_attention(
             q, k, v, q_pos, kv_pos, axis_name=axis_name, axis_size=n,
             dropout_rate=dropout_rate if with_drop else 0.0,
-            dropout_seed=seed[0], b_off=b_off, h_off=h_off,
+            dropout_seed=seed, b_off=b_off, h_off=h_off,
         )
 
     seed = (
-        jax.random.bits(dropout_rng, (1,), "uint32")
-        if with_drop else jnp.zeros((1,), jnp.uint32)
+        jax.random.bits(dropout_rng, (2,), "uint32")
+        if with_drop else jnp.zeros((2,), jnp.uint32)
     )
     spec4 = P(BATCH_AXES, axis_name, "tensor", None)
     spec2 = P(BATCH_AXES, axis_name)

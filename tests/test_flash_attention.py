@@ -371,6 +371,12 @@ def test_flash_dropout_mask_is_inverted_bernoulli():
         q, k, pos, pos, rate, jnp.asarray([78], jnp.uint32), 16, 16
     )
     assert np.abs(u - u3).max() > 0.1
+    # The seed is 64-bit: the HIGH word must drive an independent draw
+    # (a [1] seed widens to a zero high word, so [77, 1] != [77]).
+    u_hi = _extract_dropout_weights(
+        q, k, pos, pos, rate, jnp.asarray([77, 1], jnp.uint32), 16, 16
+    )
+    assert np.abs(u - u_hi).max() > 0.1
     D_full = np.where(w > 1e-3, u / np.maximum(w, 1e-30), 0.0)
     assert np.abs(D_full[0, :, 0] - D_full[0, :, 1]).max() > 0.1
 

@@ -343,7 +343,7 @@ def test_ring_dropout_matches_dense_with_extracted_mask():
     pos = np.tile(np.arange(T, dtype=np.int32), (B, 1))
 
     def dense_with_mask(seed):
-        base = np.asarray(dropout_base(np.uint32(seed), B, H, 0, 0))
+        base = np.asarray(dropout_base(seed, B, H, 0, 0))
         keep = np.asarray(dropout_keep(
             jnp.asarray(base), jnp.asarray(pos), jnp.asarray(pos), rate
         ))  # [B, H, T, T]
@@ -357,10 +357,10 @@ def test_ring_dropout_matches_dense_with_extracted_mask():
         w_drop = np.where(keep, w / (1.0 - rate), 0.0)
         return np.einsum("bhts,bshd->bthd", w_drop, vr)
 
-    # Mesh path: ring_sdpa derives its uint32 seed from the rng key;
-    # mirror the derivation so the dense oracle shares it.
+    # Mesh path: ring_sdpa derives its 64-bit (two-word) seed from the
+    # rng key; mirror the derivation so the dense oracle shares it.
     key = jax.random.PRNGKey(77)
-    derived = int(np.asarray(jax.random.bits(key, (1,), "uint32"))[0])
+    derived = np.asarray(jax.random.bits(key, (2,), "uint32"))
     mesh = make_mesh(data=2, seq=2, devices=jax.devices()[:4])
     with use_mesh(mesh):
         got = np.asarray(ring_sdpa(
