@@ -227,6 +227,12 @@ def _remat(fn, config: LLaMAConfig):
     return jax.checkpoint(fn)
 
 
+# Above this many (row, token) pairs paged_pool_write switches from the
+# unrolled dynamic_update_slice chain to the batched scatter — see its
+# docstring for the measured crossover.
+_POOL_WRITE_UNROLL_MAX = 256
+
+
 def paged_pool_write(
     plane: jnp.ndarray,
     upd: jnp.ndarray,
@@ -254,6 +260,15 @@ def paged_pool_write(
     pairs — ``dynamic_slice`` clamps identically, so the dead write is an
     exact in-place no-op.
 
+    Slot-count bound: the chain is B*T sequential ops — op count, trace
+    and compile time all grow linearly, so past ``_POOL_WRITE_UNROLL_MAX``
+    total (row, token) pairs this falls back to the batched scatter and
+    eats its layout copies.  Measured on chip (bench pool, [16, 8, 64,
+    128, 128] bf16, xplane device time): chain 0.86/1.11/1.97 ms at
+    B*T = 8/64/256 vs scatter flat ~2.5 ms — crossover ~B*T = 360; the
+    threshold sits below it because per-plane trace size (5 planes when
+    int8) is the binding cost before device time is.
+
     plane: [L, KVH, NB, BLK, d] payload, [L, KVH, NB, BLK] scale, or
       [NB, BLK] position plane — the (NB, BLK) axes sit at (-3, -2),
       (-2, -1) and (0, 1) respectively, derived from ndim.
@@ -261,6 +276,14 @@ def paged_pool_write(
     blk, off: [B, T] int32 physical coordinates (sentinel NB = drop).
     """
     B, T = blk.shape
+    if B * T > _POOL_WRITE_UNROLL_MAX:
+        # Batched scatter: mode="drop" discards the sentinel NB pairs,
+        # matching the chain's contract exactly.
+        if plane.ndim == 5 or plane.ndim == 4:
+            return plane.at[:, :, blk, off].set(
+                upd.astype(plane.dtype), mode="drop"
+            )
+        return plane.at[blk, off].set(upd.astype(plane.dtype), mode="drop")
     if plane.ndim == 5:
         L, KVH, NB, BLK, d = plane.shape
         nb_ax, slab = 2, (L, KVH, 1, 1, d)

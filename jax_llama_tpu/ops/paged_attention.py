@@ -240,10 +240,15 @@ def paged_pool_attention(
             k_scale, v_scale = k_scale[None], v_scale[None]
         layer = None
     # A multi-layer pool without a layer index would silently attend
-    # layer 0 everywhere — fail at trace time instead.
-    assert k_pool.shape[0] == 1 or layer is not None, (
-        "multi-layer pool requires the `layer` index"
-    )
+    # layer 0 everywhere — fail at trace time instead.  ValueError, not
+    # assert: unlike the adjacent shape asserts (whose mistakes surface
+    # immediately as shape errors), this guard protects against silently
+    # WRONG results and must survive `python -O`.
+    if k_pool.shape[0] != 1 and layer is None:
+        raise ValueError(
+            "multi-layer pool requires the `layer` index (a 5-D pool with "
+            "layer=None would attend layer 0 for every layer)"
+        )
     layer_arr = (
         jnp.zeros((1,), jnp.int32) if layer is None
         else jnp.asarray(layer, jnp.int32).reshape(1)

@@ -952,10 +952,21 @@ class ContinuousBatcher:
         # there.
         padded = _round_up(len(prompt_tokens), self.block_size)
         if padded + max_new_tokens > self.max_len:
+            # Name the padding lever: near-capacity requests that fit
+            # unpadded are admissible with a smaller explicit block_size
+            # (the >= 8k default is 512 for DMA efficiency — see
+            # __init__), and users must be able to self-diagnose that.
             raise ValueError(
-                f"prompt ({len(prompt_tokens)}, padded to {padded}) + "
+                f"prompt ({len(prompt_tokens)} tokens, padded to {padded} "
+                f"= a multiple of block_size={self.block_size}) + "
                 f"max_new ({max_new_tokens}) exceeds per-request capacity "
                 f"{self.max_len}"
+                + (
+                    "; the unpadded request fits - construct the batcher "
+                    "with a smaller block_size to admit it"
+                    if len(prompt_tokens) + max_new_tokens <= self.max_len
+                    else ""
+                )
             )
         rid = self._next_id
         self._next_id += 1

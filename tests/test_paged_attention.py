@@ -607,3 +607,34 @@ def test_paged_pool_write_matches_scatter_drop_semantics():
     want2 = plane2.at[blk, off].set(upd2, mode="drop")
     got2 = paged_pool_write(plane2, upd2, blk, off)
     assert np.array_equal(np.asarray(got2), np.asarray(want2))
+
+
+def test_paged_pool_write_scatter_fallback_above_unroll_bound():
+    """Past _POOL_WRITE_UNROLL_MAX (row, token) pairs the write switches
+    to the batched scatter (op count of the DUS chain grows linearly);
+    both paths must agree bit-for-bit, dead sentinels included."""
+    from jax_llama_tpu.models.llama import (
+        _POOL_WRITE_UNROLL_MAX, paged_pool_write,
+    )
+
+    rng = np.random.RandomState(1)
+    NB, BLK = 64, 16
+    B, T = _POOL_WRITE_UNROLL_MAX + 8, 1  # just past the bound
+    assert B * T <= NB * BLK
+    flat = rng.choice(NB * BLK, size=B * T, replace=False)
+    blk = jnp.asarray(flat // BLK, jnp.int32).reshape(B, T)
+    off = jnp.asarray(flat % BLK, jnp.int32).reshape(B, T)
+    blk = blk.at[3].set(NB)  # dead row
+
+    plane2 = jnp.asarray(rng.randint(-5, 99, (NB, BLK)), jnp.int32)
+    upd2 = jnp.asarray(rng.randint(100, 200, (B, T)), jnp.int32)
+    want2 = plane2.at[blk, off].set(upd2, mode="drop")
+    got2 = paged_pool_write(plane2, upd2, blk, off)
+    assert np.array_equal(np.asarray(got2), np.asarray(want2))
+
+    L, KVH, d = 2, 2, 8
+    plane5 = jnp.asarray(rng.randn(L, KVH, NB, BLK, d), jnp.float32)
+    upd5 = jnp.asarray(rng.randn(L, KVH, B, T, d), jnp.float32)
+    want5 = plane5.at[:, :, blk, off].set(upd5, mode="drop")
+    got5 = paged_pool_write(plane5, upd5, blk, off)
+    assert np.array_equal(np.asarray(got5), np.asarray(want5))
