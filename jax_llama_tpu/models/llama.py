@@ -311,12 +311,15 @@ def paged_pool_write(
 
 
 def lm_head_logits(
-    params: Params, x: jnp.ndarray, config: LLaMAConfig
+    params: Params, x: jnp.ndarray, config: LLaMAConfig, normed: bool = False
 ) -> jnp.ndarray:
     """Final RMSNorm + (tied or untied) LM head — the one logits path
     every forward variant shares.  x: [B, T, D] -> [B, T, V] in
-    config.logits_dtype (fp32 island, reference model.py:732-736)."""
-    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    config.logits_dtype (fp32 island, reference model.py:732-736).
+    ``normed=True`` means x is already the post-final-norm hidden state
+    (callers that also emit it as an aux output norm exactly once)."""
+    if not normed:
+        x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     if config.tie_word_embeddings:
         kernel = params["embed"]["embedding"].T
     else:
@@ -819,6 +822,7 @@ def forward(
     dropout_rng: Optional[jax.Array] = None,
     output_hidden_states: bool = False,
     output_attentions: bool = False,
+    output_last_hidden: bool = False,
 ):
     """Run the transformer.
 
@@ -854,9 +858,17 @@ def forward(
         weights; the xla path is the one that computes them anyway).
         Not supported on paged caches (a serving path) or stage > 1
         (pipeline) meshes.
+      output_last_hidden: ALSO return an ``AuxOutput`` holding ONLY
+        ``last_hidden_state`` (post-final-norm [B, T, D]).  Unlike the
+        collect flags above this is a hot-path surface: the scan stack
+        (and the pipeline stack) runs unchanged — nothing per-layer is
+        stacked — so the fused training loss uses it with
+        ``compute_logits=False`` to take the head matmul chunkwise
+        (``ops.loss``) instead of materializing [B, T, V] logits.
+        Subsumed by the collect flags when both are set.
     Returns:
       (logits [B, T, V] in config.logits_dtype, updated cache or None);
-      logits is None when compute_logits=False.  When either output
+      logits is None when compute_logits=False.  When any output
       flag is set, a third ``AuxOutput`` element is appended:
       (logits, cache, aux).
     """
@@ -864,11 +876,11 @@ def forward(
     if isinstance(cache, PagedKVCache):
         if dropout_rng is not None:
             raise ValueError("dropout_rng is training-only (paged decode)")
-        if collect:
+        if collect or output_last_hidden:
             raise NotImplementedError(
-                "output_hidden_states/output_attentions are not supported "
-                "on the paged (serving) path; use a plain KVCache or a "
-                "cache-free forward"
+                "output_hidden_states/output_attentions/output_last_hidden "
+                "are not supported on the paged (serving) path; use a "
+                "plain KVCache or a cache-free forward"
             )
         return paged_forward(
             params, tokens, positions, config, cache,
@@ -1233,10 +1245,9 @@ def forward(
             new_k_scale = constrain(new_k_scale, None, "data", "seq", "tensor")
             new_v_scale = constrain(new_v_scale, None, "data", "seq", "tensor")
 
-    logits = lm_head_logits(params, x, config) if compute_logits else None
-
     aux = None
-    if collect:
+    with_aux = collect or output_last_hidden
+    if with_aux:
         final_h = rms_norm(x, params["final_norm"], config.rms_norm_eps)
         aux = AuxOutput(
             hidden_states=(
@@ -1245,14 +1256,20 @@ def forward(
             last_hidden_state=final_h,
             attentions=jnp.stack(attns) if output_attentions else None,
         )
+    logits = (
+        lm_head_logits(
+            params, final_h if with_aux else x, config, normed=with_aux
+        )
+        if compute_logits else None
+    )
 
     if cache is not None:
         new_cache = KVCache(
             k=new_k, v=new_v, pos=slot_pos, index=cache.index + T,
             k_scale=new_k_scale, v_scale=new_v_scale,
         )
-        return (logits, new_cache, aux) if collect else (logits, new_cache)
-    return (logits, None, aux) if collect else (logits, None)
+        return (logits, new_cache, aux) if with_aux else (logits, new_cache)
+    return (logits, None, aux) if with_aux else (logits, None)
 
 
 def paged_forward(

@@ -1,0 +1,103 @@
+"""Fused (chunked) softmax cross-entropy over the LM head.
+
+The dense loss path materializes the full ``[B, T-1, V]`` fp32
+log-softmax on top of the forward's logits — ~1 GB at the bench training
+geometry (B=4, S=2048, V=32000) plus the VJP's recompute, all of it HBM
+round-trips that bound training MFU.  The reference has no training loop
+at all (SURVEY.md §5); this framework claims training as first-class, so
+the loss has to be TPU-shaped too: take the head matmul CHUNKWISE, fold
+the row logsumexp + target-logit gather into each chunk, and never hold
+more than one ``[chunk, V]`` logits tile.
+
+Memory: O(chunk · V) instead of O(B · T · V) — with the default chunk,
+~65 MB of transient fp32 per step instead of ~1.5 GB of materialized
+logits + log-softmax.  Backward: each chunk is ``jax.checkpoint``ed, so
+the VJP recomputes the chunk's logits and XLA derives the standard
+``(softmax − onehot) · g`` cotangent per chunk — the extra recompute is
+one head matmul (~2% of the step's matmul FLOPs at bench geometry),
+bought against the gigabyte of saved residuals.
+
+The chunk axis is the FLATTENED (batch · position) row axis: loss rows
+are independent, so chunking needs no alignment with batch or sequence
+structure, and padding to a chunk multiple is a weight-0 row that
+contributes exactly nothing to the value or any gradient.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .quant import matmul as qeinsum
+
+# Rows per chunk.  [chunk, V] fp32 transient = 512·32000·4 ≈ 65 MB at the
+# bench vocab.  Swept on chip (xplane device time, fwd+grad at bench
+# geometry N=8188, V=32000): 256 → 36.0 ms (per-chunk overhead × 32
+# steps), 512 → 27.1 ms, 1024/2048/4096 → ~30 ms; 512 wins and also
+# keeps the transient smallest of the plateau.
+CE_CHUNK = 512
+
+
+def chunked_softmax_xent(
+    h: jnp.ndarray,
+    head,
+    targets: jnp.ndarray,
+    weights: jnp.ndarray,
+    *,
+    head_transposed: bool = False,
+    chunk: int = CE_CHUNK,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Weighted next-token NLL without materializing [N, V] logits.
+
+    Args:
+      h: [N, D] post-final-norm hidden rows (activation dtype).
+      head: LM head weights — [D, V], or [V, D] with
+        ``head_transposed=True`` (the tied-embedding layout; the
+        transpose is folded into the einsum, never materialized).
+        QuantizedTensor is handled via ``ops.quant.matmul``.
+      targets: [N] int32 target token ids.
+      weights: [N] fp32 per-row loss weights (0 = ignore row).
+      chunk: rows per scan step.
+
+    Returns:
+      (total_nll, total_weight) — both fp32 scalars;
+      ``total_nll / max(total_weight, 1)`` is the masked mean the dense
+      path computes.
+    """
+    N, D = h.shape
+    nc = -(-N // chunk)
+    pad = nc * chunk - N
+    if pad:
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+        targets = jnp.pad(targets, (0, pad))
+        weights = jnp.pad(weights, (0, pad))
+    hs = h.reshape(nc, chunk, D)
+    ts = targets.reshape(nc, chunk)
+    ws = weights.reshape(nc, chunk).astype(jnp.float32)
+    eq = "td,vd->tv" if head_transposed else "td,dv->tv"
+
+    def body(carry, xs):
+        hc, tc, wc = xs
+        # fp32 accumulation in the MXU output — the same islanding as
+        # lm_head_logits, so the fused loss matches the dense path to
+        # reduction-order noise.
+        logits = qeinsum(
+            hc, head, eq, preferred_element_type=jnp.float32
+        )
+        m = jnp.max(logits, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+        tgt = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        tot, wsum = carry
+        return (
+            tot + jnp.sum((lse - tgt) * wc),
+            wsum + jnp.sum(wc),
+        ), None
+
+    (tot, wsum), _ = lax.scan(
+        jax.checkpoint(body), (jnp.float32(0.0), jnp.float32(0.0)),
+        (hs, ts, ws),
+    )
+    return tot, wsum

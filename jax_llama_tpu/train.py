@@ -76,6 +76,7 @@ def lm_loss(
     config: LLaMAConfig,
     loss_mask: Optional[jnp.ndarray] = None,
     dropout_rng: Optional[jnp.ndarray] = None,
+    fused: bool = True,
 ) -> jnp.ndarray:
     """Masked next-token cross-entropy.
 
@@ -85,13 +86,44 @@ def lm_loss(
       convention `data.pack_documents` emits; the final position has no
       in-row target, so mask[:, -1] is never consumed).  Defaults to all
       positions.
+    fused: take the LM head + softmax cross-entropy CHUNKWISE
+      (``ops.loss.chunked_softmax_xent``) over the forward's last hidden
+      state — never materializing the [B, T, V] logits or the fp32
+      log-softmax (~1.5 GB at B=4 × S=2048 × V=32000) the dense path
+      holds.  False runs the dense reference path (same value to
+      reduction-order noise; kept as the parity oracle).
     """
     B, T = tokens.shape
     targets = tokens[:, 1:]
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
     # Forward over the full T (not T-1): sequence-parallel meshes need the
     # model-visible length to stay divisible by the seq axis; the final
-    # position's logits are simply dropped from the loss.
+    # position's loss rows are simply dropped.
+    if fused:
+        from .ops.loss import chunked_softmax_xent
+
+        _, _, aux = forward(
+            params, tokens, positions, config, dropout_rng=dropout_rng,
+            compute_logits=False, output_last_hidden=True,
+        )
+        h = aux.last_hidden_state[:, :-1]  # [B, T-1, D] post-final-norm
+        if config.tie_word_embeddings:
+            head, head_t = params["embed"]["embedding"], True
+        else:
+            head, head_t = params["lm_head"], False
+        w = (
+            loss_mask[:, :-1].astype(jnp.float32)
+            if loss_mask is not None
+            else jnp.ones((B, T - 1), jnp.float32)
+        )
+        tot, wsum = chunked_softmax_xent(
+            h.reshape(B * (T - 1), -1),
+            head,
+            targets.reshape(-1),
+            w.reshape(-1),
+            head_transposed=head_t,
+        )
+        return tot / jnp.maximum(wsum, 1.0)
     logits, _ = forward(
         params, tokens, positions, config, dropout_rng=dropout_rng
     )
