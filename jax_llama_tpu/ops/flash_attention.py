@@ -115,6 +115,96 @@ def _dropout_keep(seed_lo, seed_hi, b, h, row0, col0, bq, bk, rate):
     return bits >= threshold
 
 
+def _flash_tri_tile_update(
+    q_ref, k_ref, v_ref, seed_ref,
+    m_ref, l_ref, acc_ref, qp, kp, bi, hi, qi, ki,
+    *, scale, dropout_rate,
+):
+    """Diagonal-crossing tile update with RAGGED sub-tile dots: k sub-tile
+    ``i`` computes only query rows ``[i·rq:]`` — ``_KSUB`` shrinking dots
+    (bq, bq−rq, … rows) whose union is exactly the live trapezoid plus
+    the sub-diagonal halves, skipping the 37.5% of the tile's MXU work
+    that the uniform body burned on fully-masked rows.  Correct only
+    when the skipped (row-block j < sub-tile i) regions are provably
+    dead — the caller guards with a dynamic triangle-safety predicate
+    (ascending positions make it true for every causal crossing tile)
+    and falls back to the full masked body otherwise.  State lands
+    per row-block through static scratch slices (no ragged concat of
+    the accumulator).  bf16-only (the quantized path keeps the
+    single-tile body).
+    """
+    q = q_ref[0, 0]  # [bq, d]
+    bq = q.shape[0]
+    bk = k_ref.shape[2]
+    nsub = _KSUB
+    ksub = bk // nsub
+    rq = bq // nsub
+    allowed = kp <= qp  # [bq, bk]
+    m_prev = m_ref[:, :1]  # [bq, 1]
+
+    s_parts = []  # s_i: [bq - i*rq, ksub]
+    m_parts = []  # row maxes, ragged
+    for i in range(nsub):
+        cols = slice(i * ksub, (i + 1) * ksub)
+        kb = k_ref[0, 0, cols, :]
+        s_i = jax.lax.dot_general(
+            q[i * rq:], kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [(bq - i*rq), ksub], base-2 domain
+        s_i = jnp.where(allowed[i * rq:, cols], s_i, MASK_VALUE)
+        s_parts.append(s_i)
+        m_parts.append(s_i.max(axis=-1, keepdims=True))
+
+    # Per-row-block joint max: row block j is touched by sub-tiles
+    # i <= j; m_parts[i]'s rows start at global row i*rq.
+    m_blocks = []
+    for j in range(nsub):
+        mj = m_prev[j * rq:(j + 1) * rq]
+        for i in range(j + 1):
+            mj = jnp.maximum(
+                mj, m_parts[i][(j - i) * rq:(j - i + 1) * rq]
+            )
+        m_blocks.append(mj)
+
+    inv = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else None
+    # exp2 + rowsum + PV per sub-tile (rows [i*rq:] only), then land
+    # each row block's state once.
+    r_parts = []  # [bq - i*rq, 1] rowsums
+    d_parts = []  # [bq - i*rq, d] fp32 PV partials
+    for i in range(nsub):
+        cols = slice(i * ksub, (i + 1) * ksub)
+        m_rows = jnp.concatenate(m_blocks[i:], axis=0)
+        p = jnp.exp2(s_parts[i] - m_rows)
+        r_parts.append(jnp.sum(p, axis=-1, keepdims=True))
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(
+                seed_ref[0], seed_ref[1], bi, hi,
+                qi * bq + i * rq, ki * bk + i * ksub,
+                bq - i * rq, ksub, dropout_rate,
+            )
+            p_acc = jnp.where(keep, p, 0.0) * inv
+        else:
+            p_acc = p
+        d_parts.append(jax.lax.dot_general(
+            p_acc.astype(v_ref.dtype), v_ref[0, 0, cols, :],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ))
+
+    for j in range(nsub):
+        rows = slice(j * rq, (j + 1) * rq)
+        alpha_j = jnp.exp2(m_prev[rows] - m_blocks[j])
+        l_j = alpha_j * l_ref[rows, :1]
+        acc_j = alpha_j * acc_ref[rows]
+        for i in range(j + 1):
+            sub = slice((j - i) * rq, (j - i + 1) * rq)
+            l_j = l_j + r_parts[i][sub]
+            acc_j = acc_j + d_parts[i][sub]
+        acc_ref[rows] = acc_j
+        m_ref[rows] = jnp.broadcast_to(m_blocks[j], (rq, m_ref.shape[1]))
+        l_ref[rows] = jnp.broadcast_to(l_j, (rq, l_ref.shape[1]))
+
+
 def _flash_kernel(
     kv_bound_ref,  # [B * nq] int32 scalar-prefetch: kv-block grid bound
     *args,  # [seed_ref] when dropout; q_pos/kv_pos/q/k/v refs;
@@ -177,7 +267,55 @@ def _flash_kernel(
     # finalize guards l == 0 for rows that never attend).
     block_live = in_bound & (jnp.min(kp) <= jnp.max(qp))
 
-    @pl.when(block_live)
+    # r5: diagonal-crossing tiles take a RAGGED body that skips the dead
+    # upper-triangle MXU work (see _flash_tri_tile_update) — the one
+    # lever that moved after r4's sub-tile pipeline.  Gated statically
+    # on shapes (sub-tilable, row blocks sublane-aligned, bf16) and
+    # dynamically on triangle safety: the ragged body skips row block
+    # j < sub-tile i entirely, sound iff max(qp[:i·rq]) < min(kp of
+    # sub-tile i) for every i — true on every crossing tile of an
+    # ascending position layout (causal prefill, cache layouts), false
+    # for interior tiles and exotic layouts, which take the uniform
+    # masked body below.  (+INT_MAX padding slots never lower the min.)
+    # Negative results, xplane kernel-only at 16k vs the 8.35 ms / 66.8%
+    # r4 baseline: a maskless interior-tile body variant measured
+    # SLOWER (8.52 ms — the per-element mask select was already
+    # overlapped; three bodies cost more than the select), as did
+    # per-sub-tile exp bases with a correction tail (13.98 ms — holding
+    # nsub [bq, d] fp32 PV partials wrecks Mosaic's schedule) and
+    # hoisting the row-max reduces into the dot loop (exactly neutral —
+    # the r4 "joint-max barrier" hypothesis is closed: it never cost
+    # anything).
+    bq_s, bk_s = q_ref.shape[2], k_ref.shape[2]
+    tri_ok = (
+        not quantized
+        and bk_s % _KSUB == 0 and bk_s > _KSUB
+        and bq_s % _KSUB == 0 and bq_s > _KSUB
+        and (bq_s // _KSUB) % _SUBLANES == 0
+    )
+    if tri_ok:
+        rq = bq_s // _KSUB
+        ksub_s = bk_s // _KSUB
+        safe = None
+        for i in range(1, _KSUB):
+            cond = jnp.max(qp[: i * rq]) < jnp.min(
+                kp[:, i * ksub_s:(i + 1) * ksub_s]
+            )
+            safe = cond if safe is None else (safe & cond)
+        tri_live = block_live & safe
+        full_live = block_live & jnp.logical_not(safe)
+
+        @pl.when(tri_live)
+        def _compute_tri():
+            _flash_tri_tile_update(
+                q_ref, k_ref, v_ref, seed_ref,
+                m_ref, l_ref, acc_ref, qp, kp, bi, hi, qi, ki,
+                scale=scale, dropout_rate=dropout_rate,
+            )
+    else:
+        full_live = block_live
+
+    @pl.when(full_live)
     def _compute():
         q = q_ref[0, 0]  # [bq, d]
         bq = q.shape[0]
