@@ -115,6 +115,43 @@ def _dropout_keep(seed_lo, seed_hi, b, h, row0, col0, bq, bk, rate):
     return bits >= threshold
 
 
+def _tri_gate(qp, kp, bq_s, bk_s, quantized=False):
+    """Shared gate for the three kernels' ragged diagonal bodies:
+    ``(tri_ok, safe)`` where ``tri_ok`` is the STATIC shape check (sub-
+    tilable both axes, and both sub-tile granularities sublane-aligned —
+    the ragged bodies slice k/q tiles and store scratch row/column
+    blocks at those granularities) and ``safe`` the DYNAMIC triangle-
+    safety fold, ``None`` when ``tri_ok`` is False.
+
+    One predicate serves all three kernels: the forward/dQ bodies skip
+    (row block j) × (k sub-tile i) for j < i and the dK/dV body skips
+    (q sub-tile i) × (column suffix past i) — both skip sets reduce to
+    the same pairwise condition max(qp[block j]) < min(kp[block c]) for
+    every j < c, which the prefix-max fold below checks exactly.
+    (+INT_MAX padding slots never lower a block min, so padding can
+    never unsoundly enable a skip.)
+    """
+    tri_ok = (
+        not quantized
+        and _KSUB >= 2  # the safety fold is vacuous at 1 sub-tile
+        and bk_s % _KSUB == 0 and bk_s > _KSUB
+        and bq_s % _KSUB == 0 and bq_s > _KSUB
+        and (bq_s // _KSUB) % _SUBLANES == 0
+        and (bk_s // _KSUB) % _SUBLANES == 0
+    )
+    if not tri_ok:
+        return False, None
+    rq = bq_s // _KSUB
+    ksub = bk_s // _KSUB
+    safe = None
+    for i in range(1, _KSUB):
+        cond = jnp.max(qp[: i * rq]) < jnp.min(
+            kp[:, i * ksub:(i + 1) * ksub]
+        )
+        safe = cond if safe is None else (safe & cond)
+    return True, safe
+
+
 def _flash_tri_tile_update(
     q_ref, k_ref, v_ref, seed_ref,
     m_ref, l_ref, acc_ref, qp, kp, bi, hi, qi, ki,
@@ -286,22 +323,10 @@ def _flash_kernel(
     # hoisting the row-max reduces into the dot loop (exactly neutral —
     # the r4 "joint-max barrier" hypothesis is closed: it never cost
     # anything).
-    bq_s, bk_s = q_ref.shape[2], k_ref.shape[2]
-    tri_ok = (
-        not quantized
-        and bk_s % _KSUB == 0 and bk_s > _KSUB
-        and bq_s % _KSUB == 0 and bq_s > _KSUB
-        and (bq_s // _KSUB) % _SUBLANES == 0
+    tri_ok, safe = _tri_gate(
+        qp, kp, q_ref.shape[2], k_ref.shape[2], quantized=quantized
     )
     if tri_ok:
-        rq = bq_s // _KSUB
-        ksub_s = bk_s // _KSUB
-        safe = None
-        for i in range(1, _KSUB):
-            cond = jnp.max(qp[: i * rq]) < jnp.min(
-                kp[:, i * ksub_s:(i + 1) * ksub_s]
-            )
-            safe = cond if safe is None else (safe & cond)
         tri_live = block_live & safe
         full_live = block_live & jnp.logical_not(safe)
 
@@ -887,36 +912,97 @@ def _flash_dq_kernel(
     kp = kv_pos_ref[0, :1, :]  # [1, bk] (+INT_MAX on padding slots)
     block_live = jnp.min(kp) <= jnp.max(qp)
 
-    @pl.when(block_live)
-    def _compute():
-        qb, kb, vb, gb = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], g_ref[0, 0]
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        p = jnp.where(kp <= qp, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
-        dp = jax.lax.dot_general(
-            gb, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if dropout_rate > 0.0:
-            # Forward: out = (D ∘ w) V with w = softmax(s), D the inverted-
-            # dropout mask.  Chain rule gives dw = D ∘ dp, and the softmax
-            # Jacobian's weighted sum Σ_k w_k (D_k dp_k) is exactly
-            # rowsum(dO ∘ O) — the SAME delta as the no-dropout case — so
-            # only dp needs masking.  The mask is rebuilt bit-identically
-            # from the tile's GLOBAL element offsets (same hash as the
-            # forward — tiling-independent by construction).
-            keep = _dropout_keep(
-                seed_ref[0], seed_ref[1], bi, hi,
-                qi * p.shape[0], ki * p.shape[1], *p.shape, dropout_rate,
+    def _dq_body(ragged):
+        """Sub-tiled dQ tile update (r5).  Unlike the forward there is no
+        online-softmax state between sub-tiles — lse is FIXED — so the
+        nsub chains (dot -> exp -> ds -> dot) are fully independent and
+        Mosaic overlaps sub-tile i's VPU work with i±1's dots.  With
+        ``ragged`` (diagonal-crossing tiles, triangle-safety-guarded by
+        the caller like the forward's tri body), k sub-tile i computes
+        only query rows [i·rq:] — on a causal crossing tile the uniform
+        body burned ~50% of its dots on fully-masked rows, which capped
+        useful MXU at ~45% at training scale (S=2048) even though the
+        MXU was ~90% busy; tile-size sweeps could not fix it (smaller
+        tiles hit a ~4.5 µs/step grid-overhead floor).
+        """
+        qb, gb = q_ref[0, 0], g_ref[0, 0]
+        bq = qb.shape[0]
+        bk = k_ref.shape[2]
+        nsub = _KSUB if (bk % _KSUB == 0 and bk > _KSUB) else 1
+        ksub = bk // nsub
+        rq = bq // nsub if ragged else 0
+        # Full-width mask compare once — narrow [1, ksub] sub-slices of
+        # the 1-row position plane hit unsupported Mosaic layouts (the
+        # same trap the forward documents); 2-D slices of the [bq, bk]
+        # compare are fine.
+        allowed = kp <= qp
+        lse_row = lse_ref[0, 0][:, :1]
+        delta_row = delta_ref[0, 0][:, :1]
+        inv = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else None
+        c_parts = []
+        for i in range(nsub):
+            cols = slice(i * ksub, (i + 1) * ksub)
+            r0 = i * rq  # 0 when not ragged
+            kb_i = k_ref[0, 0, cols, :]
+            s_i = jax.lax.dot_general(
+                qb[r0:], kb_i, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            p_i = jnp.where(
+                allowed[r0:, cols], jnp.exp(s_i - lse_row[r0:]), 0.0
             )
-            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
-        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
-        dq_acc[:] += jax.lax.dot_general(
-            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            dp_i = jax.lax.dot_general(
+                gb[r0:], v_ref[0, 0, cols, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if dropout_rate > 0.0:
+                # Forward: out = (D ∘ w) V with w = softmax(s), D the
+                # inverted-dropout mask.  Chain rule gives dw = D ∘ dp,
+                # and the softmax Jacobian's weighted sum
+                # Σ_k w_k (D_k dp_k) is exactly rowsum(dO ∘ O) — the
+                # SAME delta as the no-dropout case — so only dp needs
+                # masking.  The mask is rebuilt bit-identically from
+                # GLOBAL element offsets (tiling-independent hash).
+                keep = _dropout_keep(
+                    seed_ref[0], seed_ref[1], bi, hi,
+                    qi * bq + r0, ki * bk + i * ksub,
+                    bq - r0, ksub, dropout_rate,
+                )
+                dp_i = jnp.where(keep, dp_i, 0.0) * inv
+            ds_i = p_i * (dp_i - delta_row[r0:]) * scale
+            c_parts.append(jax.lax.dot_general(
+                ds_i.astype(kb_i.dtype), kb_i, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))
+        if not ragged:
+            acc = c_parts[0]
+            for c_i in c_parts[1:]:
+                acc = acc + c_i
+            dq_acc[:] += acc
+        else:
+            # Row block j collects contributions from sub-tiles i <= j
+            # (c_parts[i] starts at global row i*rq).
+            for j in range(nsub):
+                rows = slice(j * rq, (j + 1) * rq)
+                add = None
+                for i in range(j + 1):
+                    piece = c_parts[i][(j - i) * rq:(j - i + 1) * rq]
+                    add = piece if add is None else add + piece
+                dq_acc[rows] += add
+
+    tri_ok, safe = _tri_gate(qp, kp, q_ref.shape[2], k_ref.shape[2])
+    if tri_ok:
+        @pl.when(block_live & safe)
+        def _compute_tri():
+            _dq_body(ragged=True)
+
+        @pl.when(block_live & jnp.logical_not(safe))
+        def _compute():
+            _dq_body(ragged=False)
+    else:
+        @pl.when(block_live)
+        def _compute():
+            _dq_body(ragged=False)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -943,42 +1029,113 @@ def _flash_dkv_kernel(
     kp = kv_pos_ref[0, :1, :]  # [1, bk] (+INT_MAX on padding slots)
     block_live = jnp.min(kp) <= jnp.max(qp)
 
-    @pl.when(block_live)
-    def _compute():
-        qb, kb, vb, gb = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], g_ref[0, 0]
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [bq, bk]
-        p = jnp.where(kp <= qp, jnp.exp(s - lse_ref[0, 0][:, :1]), 0.0)
-        if dropout_rate > 0.0:
-            # Same global element offsets as the forward/dQ kernels —
-            # NOTE the grid here is (B, H, nk, nq), so qi/ki swap
-            # program ids.
-            keep = _dropout_keep(
-                seed_ref[0], seed_ref[1], bi, hi,
-                qi * p.shape[0], ki * p.shape[1], *p.shape, dropout_rate,
+    def _dkv_body(ragged):
+        """Sub-tiled dK/dV tile update (r5), over the Q-ROW axis (the
+        kernel's within-tile reduction axis): lse is fixed, so the nsub
+        chains are fully independent and their dots/VPU work pipeline —
+        see the dQ kernel note.  With ``ragged`` (diagonal-crossing
+        tiles), q-row sub-tile i computes only kv columns
+        [0:(i+1)·csub] — GROWING widths, the column-side mirror of the
+        dQ kernel's shrinking rows — and contributions land per column
+        block through static scratch slices."""
+        kb, vb = k_ref[0, 0], v_ref[0, 0]
+        bq = q_ref.shape[2]
+        bk = kb.shape[0]
+        nsub = (
+            _KSUB
+            if (bq % _KSUB == 0 and bq > _KSUB
+                and (bq // _KSUB) % _SUBLANES == 0)
+            else 1
+        )
+        qsub = bq // nsub
+        csub = bk // nsub if ragged else 0
+        # Full-width compare + full narrow-lane loads once; 2-D row
+        # slices of them are Mosaic-safe (see the dQ kernel note).
+        allowed = kp <= qp
+        lse_rows = lse_ref[0, 0][:, :1]
+        delta_rows = delta_ref[0, 0][:, :1]
+        inv = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else None
+        dv_parts = []  # [(i+1)*csub, d] when ragged, else [bk, d]
+        dk_parts = []
+        for i in range(nsub):
+            rows = slice(i * qsub, (i + 1) * qsub)
+            cols = slice(0, (i + 1) * csub) if ragged else slice(0, bk)
+            wk = (i + 1) * csub if ragged else bk
+            qb_i = q_ref[0, 0, rows, :]
+            gb_i = g_ref[0, 0, rows, :]
+            s_i = jax.lax.dot_general(
+                qb_i, kb[cols], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [qsub, wk]
+            p_i = jnp.where(
+                allowed[rows, cols], jnp.exp(s_i - lse_rows[rows]), 0.0
             )
-            inv = 1.0 / (1.0 - dropout_rate)
-            p_v = jnp.where(keep, p, 0.0) * inv  # dV sees dropped weights
-            dp_mask = lambda dp: jnp.where(keep, dp, 0.0) * inv
+            if dropout_rate > 0.0:
+                # Same global element offsets as the forward/dQ kernels —
+                # NOTE the grid here is (B, H, nk, nq), so qi/ki swap
+                # program ids.
+                keep = _dropout_keep(
+                    seed_ref[0], seed_ref[1], bi, hi,
+                    qi * bq + i * qsub, ki * bk, qsub, wk, dropout_rate,
+                )
+                p_v = jnp.where(keep, p_i, 0.0) * inv
+                dp_mask = lambda dp, _k=keep: jnp.where(_k, dp, 0.0) * inv
+            else:
+                p_v = p_i
+                dp_mask = lambda dp: dp
+            # dV_j += (D ∘ P)_ijᵀ dO_i: contract the q-row axis.
+            dv_parts.append(jax.lax.dot_general(
+                p_v.astype(gb_i.dtype), gb_i, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))
+            dp_i = dp_mask(jax.lax.dot_general(
+                gb_i, vb[cols], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))
+            ds_i = p_i * (dp_i - delta_rows[rows]) * scale
+            dk_parts.append(jax.lax.dot_general(
+                ds_i.astype(qb_i.dtype), qb_i, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ))
+        if not ragged:
+            dv_add = dv_parts[0]
+            dk_add = dk_parts[0]
+            for dv_i, dk_i in zip(dv_parts[1:], dk_parts[1:]):
+                dv_add = dv_add + dv_i
+                dk_add = dk_add + dk_i
+            dv_acc[:] += dv_add
+            dk_acc[:] += dk_add
         else:
-            p_v = p
-            dp_mask = lambda dp: dp
-        # dV_j += (D ∘ P)_ijᵀ dO_i: contract the q-row axis.
-        dv_acc[:] += jax.lax.dot_general(
-            p_v.astype(gb.dtype), gb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = dp_mask(jax.lax.dot_general(
-            gb, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ))
-        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
-        dk_acc[:] += jax.lax.dot_general(
-            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            # Column block c collects contributions from q sub-tiles
+            # i >= c (sub-tile i's parts cover columns [0:(i+1)*csub]).
+            for c in range(nsub):
+                cols_c = slice(c * csub, (c + 1) * csub)
+                dv_add = None
+                dk_add = None
+                for i in range(c, nsub):
+                    dv_p = dv_parts[i][cols_c]
+                    dk_p = dk_parts[i][cols_c]
+                    dv_add = dv_p if dv_add is None else dv_add + dv_p
+                    dk_add = dk_p if dk_add is None else dk_add + dk_p
+                dv_acc[cols_c] += dv_add
+                dk_acc[cols_c] += dk_add
+
+    # One shared gate: the dK/dV skip set (q sub-tile i × column suffix
+    # past i) reduces to the same pairwise max(qp block) < min(kp block)
+    # condition as the forward/dQ row-skips — see _tri_gate.
+    tri_ok, safe = _tri_gate(qp, kp, q_ref.shape[2], k_ref.shape[2])
+    if tri_ok:
+        @pl.when(block_live & safe)
+        def _compute_tri():
+            _dkv_body(ragged=True)
+
+        @pl.when(block_live & jnp.logical_not(safe))
+        def _compute():
+            _dkv_body(ragged=False)
+    else:
+        @pl.when(block_live)
+        def _compute():
+            _dkv_body(ragged=False)
 
     @pl.when(qi == nq - 1)
     def _finalize():
