@@ -50,6 +50,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import hashlib
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -64,6 +66,7 @@ from .models.llama import (
     PagedKVCache,
     forward,
     init_cache,
+    lm_head_logits,
     paged_pool_write,
     paged_write_indices,
 )
@@ -431,14 +434,15 @@ def _paged_insert(
     """Prefill a batch of k admitted requests and land their KV in their
     reserved blocks.
 
-    prompt_tokens/prompt_mask: [k, P] left-padded to the GROUP's max
+    prompt_tokens/prompt_mask: [k, P] RIGHT-padded to the GROUP's max
     block-multiple length (a burst of admissions shares ONE prefill
     dispatch — previously each request paid its own B=1 prefill, and a
-    burst of k paid k serialized dispatches).  Rows whose own padded
-    length P_b < P simply carry more left-padding; their logits/sample
-    are unaffected (padding is masked), so each row emits bit-identically
-    to a standalone B=1 insert of its request.
-    block_ids: [k, P // block_size] physical blocks per row, LEADING
+    burst of k paid k serialized dispatches).  Right padding (r5; was
+    left) places every row's token j at view column j, so a prompt's
+    block CONTENT is a pure function of its tokens — the invariant the
+    prefix cache keys on; padding is masked either way, so each row
+    emits bit-identically to a standalone B=1 insert of its request.
+    block_ids: [k, P // block_size] physical blocks per row, TRAILING
     entries set to the sentinel (n_blocks) for rows with P_b < P — the
     pool scatter drops them, so only the row's own P_b-span lands (P and
     every P_b are block multiples, so the alignment is exact).
@@ -452,21 +456,42 @@ def _paged_insert(
         BLK = pool.block_size
         sub = init_cache(config, k_rows, max_len=P)
         positions = prompt_positions(prompt_mask)
+        plen = jnp.sum(prompt_mask.astype(jnp.int32), axis=-1)
         chunk = prefill_chunk if prefill_chunk and prefill_chunk < P else P
+        # Right padding means a row's LAST real token can sit in any
+        # chunk, so instead of taking the final chunk's [k, chunk, V]
+        # logits, gather each row's last-token HIDDEN state as chunks
+        # stream by (output_last_hidden is head-free and O(k·D)) and run
+        # ONE [k, D] head matmul at the end — cheaper than the old full
+        # final-chunk head at every geometry.
+        h_last = None
         for start in range(0, P, chunk):
             end = min(start + chunk, P)
-            logits, sub = forward(
+            _, sub, aux = forward(
                 params, prompt_tokens[:, start:end],
                 positions[:, start:end], config, cache=sub,
                 attn_mask=prompt_mask[:, start:end],
-                compute_logits=end >= P,
+                compute_logits=False, output_last_hidden=True,
             )
+            idx = plen - 1 - start  # [k] last-token offset in this chunk
+            in_chunk = (idx >= 0) & (idx < end - start)
+            g = jnp.take_along_axis(
+                aux.last_hidden_state,
+                jnp.clip(idx, 0, end - start - 1)[:, None, None],
+                axis=1,
+            )[:, 0]
+            h_last = (
+                g if h_last is None
+                else jnp.where(in_chunk[:, None], g, h_last)
+            )
+        logits_last = lm_head_logits(
+            params, h_last[:, None], config, normed=True
+        )[:, 0]
         keys, subkeys = _split_rows(keys)
-        tau = sample_rows(subkeys, logits[:, -1], temperature, top_p, top_k)
+        tau = sample_rows(subkeys, logits_last, temperature, top_p, top_k)
         tau_lp = (
-            _token_logprob(logits[:, -1], tau) if with_logprobs else None
+            _token_logprob(logits_last, tau) if with_logprobs else None
         )
-        plen = jnp.sum(prompt_mask.astype(jnp.int32), axis=-1)
 
         L, KVH, _, _, hd = pool.k.shape
         nb = P // BLK
@@ -500,6 +525,73 @@ def _paged_insert(
                 ),
             )
         return tau, tau_lp, plen, keys, pool
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "mesh", "prefill_chunk", "with_logprobs"),
+    donate_argnames=("pool",),
+)
+def _paged_suffix_insert(
+    params, pool, table_row, n_alloc_row, fill0, suffix_tokens,
+    suffix_mask, keys, temperature, top_p, top_k, *,
+    config, prefill_chunk=None, mesh=None, with_logprobs=False,
+):
+    """Prefill ONE request's prompt SUFFIX over the paged pool — the
+    prefix-cache admission path: the leading ``fill0`` positions of the
+    row's table already hold a reused cached prefix, so only the suffix
+    runs through the model, attending the prefix KV through the row's
+    gathered view (``paged_forward``'s multi-token kernel contract
+    requires uniform activity along T, which a right-padded suffix
+    violates — the gather/scatter cost is one row's reservation, paid
+    once per admission).
+
+    table_row: [1, MB]; n_alloc_row, fill0: [1] int32 (fill0 = shared
+    prefix length in tokens, a block multiple); suffix_tokens/mask:
+    [1, T] right-padded to a block multiple.
+    Returns (tau [1], tau logprob, carried keys, updated pool).
+    """
+    with use_mesh(mesh):
+        B1, T = suffix_tokens.shape
+        view = _gather_cache(pool, table_row, n_alloc_row, fill0)
+        slen = jnp.sum(suffix_mask.astype(jnp.int32), axis=1)  # [1]
+        positions = jnp.where(
+            suffix_mask,
+            fill0[:, None]
+            + jnp.cumsum(suffix_mask.astype(jnp.int32), axis=1) - 1,
+            -1,
+        )
+        chunk = prefill_chunk if prefill_chunk and prefill_chunk < T else T
+        h_last = None
+        for start in range(0, T, chunk):
+            end = min(start + chunk, T)
+            _, view, aux = forward(
+                params, suffix_tokens[:, start:end],
+                positions[:, start:end], config, cache=view,
+                attn_mask=suffix_mask[:, start:end],
+                compute_logits=False, output_last_hidden=True,
+            )
+            idx = slen - 1 - start
+            in_chunk = (idx >= 0) & (idx < end - start)
+            g = jnp.take_along_axis(
+                aux.last_hidden_state,
+                jnp.clip(idx, 0, end - start - 1)[:, None, None],
+                axis=1,
+            )[:, 0]
+            h_last = (
+                g if h_last is None
+                else jnp.where(in_chunk[:, None], g, h_last)
+            )
+        logits_last = lm_head_logits(
+            params, h_last[:, None], config, normed=True
+        )[:, 0]
+        pool = _scatter_back(
+            pool, view, table_row, fill0, jnp.ones((B1,), bool), T
+        )
+        keys, sub = _split_rows(keys)
+        tau = sample_rows(sub, logits_last, temperature, top_p, top_k)
+        lp = _token_logprob(logits_last, tau) if with_logprobs else None
+        return tau, lp, keys, pool
 
 
 @functools.partial(jax.jit, donate_argnames=("pos",))
@@ -841,6 +933,7 @@ class ContinuousBatcher:
         mesh=None,
         use_pallas_kernel: bool = True,
         logprobs: bool = False,
+        prefix_cache: bool = True,
     ):
         if config.attn_impl not in ("xla", "auto"):
             raise ValueError(
@@ -900,11 +993,29 @@ class ContinuousBatcher:
             if self.spec else None
         )
         self.free_blocks: List[int] = list(range(self.n_blocks))
+        # Prefix cache (vLLM-style, r5): full prompt blocks are keyed by
+        # a position-invariant chain hash of their tokens; admission
+        # reuses a cached chain's blocks (refcounted) instead of
+        # re-prefilling them, and completed requests RETAIN their keyed
+        # blocks in an LRU (``_reusable``) until allocation pressure
+        # evicts them — so the /chat pattern of identical system prompts
+        # across sequential requests skips the shared prefill entirely.
+        # Enabled by default; ``prefix_cache=False`` disables matching
+        # and retention (refcounts still maintained — the mechanism is
+        # the same, it just never hits).
+        self.prefix_cache_enabled = bool(prefix_cache)
+        self._block_refs: Dict[int, int] = {}    # block -> active users
+        self._block_chain: Dict[int, bytes] = {}  # block -> chain key
+        self._prefix_index: Dict[bytes, int] = {}  # chain key -> block
+        # refcount-0 keyed blocks, insertion order = eviction order
+        self._reusable: "OrderedDict[int, None]" = OrderedDict()
         # Observability counters (exposed via the HTTP /metrics endpoint).
         self.emitted_total = 0
         self.steps_total = 0
         self.drafts_proposed = 0
         self.drafts_accepted = 0
+        self.prefix_requests_hit = 0
+        self.prefix_blocks_reused = 0
         # Host-side numpy mirrors; uploaded per step (tiny) — the KV pool
         # is the only state that stays resident/donated on device.
         B, MB = n_slots, self.blocks_per_slot
@@ -1042,6 +1153,9 @@ class ContinuousBatcher:
             "drafts_proposed_total": self.drafts_proposed,
             "drafts_accepted_total": self.drafts_accepted,
             "draft_acceptance_rate": self.acceptance_rate(),
+            "prefix_cached_blocks": len(self._reusable),
+            "prefix_requests_hit_total": self.prefix_requests_hit,
+            "prefix_blocks_reused_total": self.prefix_blocks_reused,
         }
 
     def step(self) -> List[Tuple]:
@@ -1192,55 +1306,295 @@ class ContinuousBatcher:
 
     # -- internals ----------------------------------------------------------
 
+    def _capacity(self) -> int:
+        """Allocatable blocks: truly free + evictable cached prefixes."""
+        return len(self.free_blocks) + len(self._reusable)
+
+    def _alloc_blocks(self, n: int) -> List[int]:
+        """Pop n blocks, evicting LRU cached-prefix blocks when the free
+        list runs dry.  Evicted blocks' POSITIONS are invalidated here:
+        retained blocks keep valid pos (future reusers need them), but a
+        block re-purposed as part of a DECODE reservation is only
+        overwritten up to the prompt span — a stale pos >= 0 in the
+        beyond-the-prompt region would be attended as a live slot."""
+        out: List[int] = []
+        evicted: List[int] = []
+        for _ in range(n):
+            if self.free_blocks:
+                out.append(self.free_blocks.pop(0))
+            else:
+                blk, _ = self._reusable.popitem(last=False)
+                self._drop_chain_entry(blk)
+                evicted.append(blk)
+                out.append(blk)
+        if evicted:
+            # More evictions than one slot's span is impossible in one
+            # call (n <= blocks_per_slot), but stay defensive.
+            for start in range(0, len(evicted), self.blocks_per_slot):
+                ids = np.full(
+                    (self.blocks_per_slot,), self.n_blocks, np.int32
+                )
+                chunk = evicted[start:start + self.blocks_per_slot]
+                ids[: len(chunk)] = chunk
+                self.pool = dataclasses.replace(
+                    self.pool,
+                    pos=_release_blocks(self.pool.pos, jnp.asarray(ids)),
+                )
+                if self.spec:
+                    self.draft_pool = dataclasses.replace(
+                        self.draft_pool,
+                        pos=_release_blocks(
+                            self.draft_pool.pos, jnp.asarray(ids)
+                        ),
+                    )
+        return out
+
+    def _drop_chain_entry(self, blk: int) -> None:
+        key = self._block_chain.pop(blk, None)
+        if key is not None and self._prefix_index.get(key) == blk:
+            del self._prefix_index[key]
+
+    @staticmethod
+    def _chain_keys(tokens: Sequence[int], block_size: int) -> List[bytes]:
+        """Chain hash per FULL prompt block: key_j = H(key_{j-1}, block-j
+        tokens), so a hit at block j certifies the whole prefix up to it.
+        Only blocks strictly before the last token are keyed (at least
+        one token must run through the model to produce the first sample).
+        """
+        m = (len(tokens) - 1) // block_size
+        keys: List[bytes] = []
+        h = hashlib.blake2b(digest_size=16)
+        for j in range(m):
+            h.update(
+                np.asarray(
+                    tokens[j * block_size:(j + 1) * block_size], np.int32
+                ).tobytes()
+            )
+            keys.append(h.digest())  # digest() is non-destructive
+        return keys
+
+    def _match_prefix(self, keys: List[bytes]) -> List[int]:
+        """Longest cached chain prefix -> its physical blocks."""
+        if not self.prefix_cache_enabled:
+            return []
+        hits: List[int] = []
+        for key in keys:
+            blk = self._prefix_index.get(key)
+            if blk is None:
+                break
+            hits.append(blk)
+        return hits
+
+    def _claim_blocks(self, blocks: List[int]) -> None:
+        for blk in blocks:
+            self._block_refs[blk] = self._block_refs.get(blk, 0) + 1
+            self._reusable.pop(blk, None)
+
+    def _register_chain(self, blocks: List[int], keys: List[bytes]) -> None:
+        """Publish a request's freshly prefilled full prompt blocks."""
+        if not self.prefix_cache_enabled:
+            return
+        for blk, key in zip(blocks, keys):
+            self._block_chain[blk] = key
+            self._prefix_index[key] = blk
+
     def _free_slot(self, b: int) -> None:
         slot = self.slots[b]
         assert slot is not None
-        ids = np.full((self.blocks_per_slot,), self.n_blocks, np.int32)
-        ids[: len(slot.blocks)] = slot.blocks
-        new_pos = _release_blocks(self.pool.pos, jnp.asarray(ids))
-        self.pool = dataclasses.replace(self.pool, pos=new_pos)
-        if self.spec:
-            self.draft_pool = dataclasses.replace(
-                self.draft_pool,
-                pos=_release_blocks(self.draft_pool.pos, jnp.asarray(ids)),
-            )
-        self.free_blocks.extend(slot.blocks)
+        # Keyed blocks with no remaining users are RETAINED (prefix
+        # cache) — their positions must stay valid for future reusers —
+        # later chain blocks enter the LRU first so chains evict
+        # back-to-front (an evicted middle block strands its suffix).
+        plain: List[int] = []
+        retained: List[int] = []
+        for blk in slot.blocks:
+            refs = self._block_refs.get(blk, 1) - 1
+            if refs > 0:
+                self._block_refs[blk] = refs
+                continue
+            self._block_refs.pop(blk, None)
+            if self.prefix_cache_enabled and blk in self._block_chain:
+                retained.append(blk)
+            else:
+                plain.append(blk)
+        for blk in reversed(retained):
+            self._reusable[blk] = None
+        if plain:
+            ids = np.full((self.blocks_per_slot,), self.n_blocks, np.int32)
+            ids[: len(plain)] = plain
+            new_pos = _release_blocks(self.pool.pos, jnp.asarray(ids))
+            self.pool = dataclasses.replace(self.pool, pos=new_pos)
+            if self.spec:
+                self.draft_pool = dataclasses.replace(
+                    self.draft_pool,
+                    pos=_release_blocks(
+                        self.draft_pool.pos, jnp.asarray(ids)
+                    ),
+                )
+            self.free_blocks.extend(plain)
         self.slots[b] = None
         self.table[b] = self.n_blocks
         self.n_alloc[b] = 0
         self.fill[b] = 0
         self.active[b] = False
 
+    def _request_key(self, req: "_Request") -> np.ndarray:
+        """Host-built threefry key words for a request.  The obvious
+        np.asarray(jax.random.PRNGKey(seed)) is a device round-trip PER
+        REQUEST — ~100 ms of tunnel latency each here, which silently
+        handed back the entire batched-prefill admission win (measured:
+        8 admissions cost ~800 ms in key fetches alone).  Under the
+        default (x64-disabled) canonicalization PRNGKey(seed) is exactly
+        [0, seed & 0xFFFFFFFF] (parity-tested); with x64 enabled
+        threefry_seed keeps the high word too, so mirror it — otherwise
+        an embedding application that flips jax_enable_x64 would
+        silently fork the batcher's sampled streams from standalone
+        seeded generates.  (Seed mix: a stable multiply, NOT Python's
+        hash() — its tuple algorithm is an interpreter detail that would
+        change sampled outputs across Python versions.)"""
+        seed = (
+            req.seed if req.seed is not None
+            else (self.seed * 1000003 + req.rid) & 0x7FFFFFFF
+        )
+        kw = np.zeros((2,), np.uint32)
+        if jax.config.jax_enable_x64:
+            kw[0] = np.uint32((seed >> 32) & 0xFFFFFFFF)
+        kw[1] = np.uint32(seed & 0xFFFFFFFF)
+        return kw
+
+    def _admit_shared(
+        self, req: "_Request", chain: List[bytes], hits: List[int],
+        b: int,
+    ) -> None:
+        """Admit one request whose leading full blocks hit the prefix
+        cache: reuse the cached blocks (already claimed by _admit) and
+        prefill only the suffix through the row's gathered view.  The
+        request's own freshly prefilled full prompt blocks extend the
+        published chain, so a follow-up with a longer shared prefix hits
+        deeper."""
+        bs = self.block_size
+        n_share = len(hits)
+        L0 = n_share * bs
+        total = req.blocks_needed(bs)
+        fresh = self._alloc_blocks(total - n_share)
+        blocks = hits + fresh
+        suffix = req.tokens[L0:]
+        T = _round_up(len(suffix), bs)
+        st = np.zeros((1, T), np.int32)
+        sm = np.zeros((1, T), bool)
+        st[0, : len(suffix)] = suffix
+        sm[0, : len(suffix)] = True
+        table_row = np.full((1, self.blocks_per_slot), self.n_blocks,
+                            np.int32)
+        table_row[0, : len(blocks)] = blocks
+        tau, tau_lp, key_out, self.pool = _paged_suffix_insert(
+            self.params, self.pool, jnp.asarray(table_row),
+            jnp.asarray([len(blocks)], np.int32),
+            jnp.asarray([L0], np.int32), jnp.asarray(st),
+            jnp.asarray(sm),
+            jnp.asarray(self._request_key(req))[None],
+            jnp.asarray([req.temperature], np.float32),
+            jnp.asarray([req.top_p], np.float32),
+            jnp.asarray([req.top_k], np.int32),
+            config=self.config, prefill_chunk=self.prefill_chunk,
+            mesh=self.mesh, with_logprobs=self.logprobs,
+        )
+        if self.spec:
+            # Draft pool: the shared blocks hold the DRAFT model's KV
+            # for the same tokens (written when the chain was first
+            # admitted under this batcher), so only the suffix runs
+            # here too; sampled tokens are discarded.
+            _, _, _, self.draft_pool = _paged_suffix_insert(
+                self.draft_params, self.draft_pool,
+                jnp.asarray(table_row),
+                jnp.asarray([len(blocks)], np.int32),
+                jnp.asarray([L0], np.int32), jnp.asarray(st),
+                jnp.asarray(sm),
+                jnp.asarray(self._request_key(req))[None],
+                jnp.zeros((1,), jnp.float32), jnp.ones((1,), jnp.float32),
+                jnp.zeros((1,), jnp.int32),
+                config=self.draft_config,
+                prefill_chunk=self.prefill_chunk, mesh=self.mesh,
+            )
+        self.tau = self.tau.at[b].set(tau[0])
+        if self.logprobs:
+            self.tau_lp[b] = float(np.asarray(tau_lp)[0])
+        self.keys = self.keys.at[b].set(key_out[0])
+        self.pos[b] = len(req.tokens)
+        self.fill[b] = _round_up(len(req.tokens), bs)
+        self.active[b] = True
+        self.table[b] = self.n_blocks
+        self.table[b, : len(blocks)] = blocks
+        self.n_alloc[b] = len(blocks)
+        self.temp_arr[b] = req.temperature
+        self.top_p_arr[b] = req.top_p
+        self.top_k_arr[b] = req.top_k
+        self.slots[b] = _Slot(
+            request_id=req.rid, emitted=[], max_new=req.max_new,
+            stop_tokens=req.stops, blocks=blocks,
+        )
+        self._claim_blocks(fresh)
+        # Extend the published chain with this request's own full
+        # prompt blocks (indices n_share..len(chain)-1 are fresh).
+        self._register_chain(blocks[n_share: len(chain)],
+                             chain[n_share:])
+        self.prefix_requests_hit += 1
+        self.prefix_blocks_reused += n_share
+
     def _admit(self) -> None:
         """Admit queued requests into free slots.
 
-        A burst of k admissible requests shares ONE [k', P] prefill
-        dispatch (k' = k rounded up to a power of two with inactive pad
-        rows, P = the group's max block-padded prompt length) instead of
-        k serialized B=1 dispatches — in this environment each dispatch
-        costs ~100ms of tunnel latency on top of the prefill itself.
-        Per-row left-padding and per-row key chains keep every request's
-        output bit-identical to one-at-a-time admission; head-of-line
-        FIFO blocking on block reservations is preserved.
+        A burst of k admissible requests without prefix-cache hits
+        shares ONE [k', P] prefill dispatch (k' = k rounded up to a
+        power of two with inactive pad rows, P = the group's max
+        block-padded prompt length) instead of k serialized B=1
+        dispatches — in this environment each dispatch costs ~100ms of
+        tunnel latency on top of the prefill itself.  Requests whose
+        leading full blocks hit the prefix cache are admitted
+        individually through ``_paged_suffix_insert`` (per-row position
+        offsets don't fit the group program; the hit's whole point is
+        that the remaining suffix is small).  Per-row right-padding and
+        per-row key chains keep every request's output bit-identical to
+        one-at-a-time admission; head-of-line FIFO blocking on block
+        reservations is preserved (budget stays the FULL reservation
+        even for hits — shared blocks change compute, not the
+        conservative capacity accounting).
         """
         while True:
             free_slots = [b for b, s in self.slots.items() if s is None]
             if not free_slots or not self.queue:
                 return
-            batch: List[_Request] = []
-            budget = len(self.free_blocks)
+            picked: List[Tuple[_Request, List[bytes], List[int]]] = []
+            budget = self._capacity()
             for req in self.queue:
-                if len(batch) >= len(free_slots):
+                if len(picked) >= len(free_slots):
                     break
                 need = req.blocks_needed(self.block_size)
                 if need > budget:
                     # Head-of-line blocking (FIFO fairness): wait.
                     break
                 budget -= need
-                batch.append(req)
-            if not batch:
+                # Don't hash prompts for users who opted out.
+                chain = (
+                    self._chain_keys(req.tokens, self.block_size)
+                    if self.prefix_cache_enabled else []
+                )
+                hits = self._match_prefix(chain)
+                # Claim hits at SELECTION time: a later allocation in
+                # this same admission round must not evict them.
+                self._claim_blocks(hits)
+                picked.append((req, chain, hits))
+            if not picked:
                 return
-            del self.queue[:len(batch)]
+            del self.queue[:len(picked)]
+            slot_iter = iter(free_slots)
+            shared = [(r, c, h) for r, c, h in picked if h]
+            batch = [r for r, c, h in picked if not h]
+            chains = {r.rid: c for r, c, h in picked}
+            for req, chain, hits in shared:
+                self._admit_shared(req, chain, hits, next(slot_iter))
+            if not batch:
+                continue
             k = len(batch)
             kb = 1 << max(k - 1, 0).bit_length()  # pow2 row bucket
             P = max(
@@ -1258,39 +1612,18 @@ class ContinuousBatcher:
             for i, req in enumerate(batch):
                 Pb = _round_up(len(req.tokens), self.block_size)
                 need = req.blocks_needed(self.block_size)
-                blocks = [self.free_blocks.pop(0) for _ in range(need)]
+                blocks = self._alloc_blocks(need)
                 row_blocks.append(blocks)
-                pt[i, P - len(req.tokens):] = req.tokens
-                pm[i, P - len(req.tokens):] = True
-                # Leading sentinels cover the group padding below this
-                # row's own block-padded length; block boundaries align
-                # because P and Pb are both block multiples.
-                lead = (P - Pb) // self.block_size
-                bid[i, lead:lead + Pb // self.block_size] = blocks[
+                # RIGHT padding (r5): token j at view column j, so block
+                # content is a pure function of the tokens (the prefix
+                # cache's keying invariant).  Trailing sentinels cover
+                # the group padding past this row's block-padded length.
+                pt[i, :len(req.tokens)] = req.tokens
+                pm[i, :len(req.tokens)] = True
+                bid[i, : Pb // self.block_size] = blocks[
                     : Pb // self.block_size
                 ]
-                # Stable mix (NOT Python's hash(): its tuple algorithm is
-                # an interpreter implementation detail, which would
-                # silently change sampled outputs across Python versions).
-                seed = (
-                    req.seed if req.seed is not None
-                    else (self.seed * 1000003 + req.rid) & 0x7FFFFFFF
-                )
-                # Host-built threefry key words: the obvious
-                # np.asarray(jax.random.PRNGKey(seed)) is a device
-                # round-trip PER REQUEST — ~100 ms of tunnel latency
-                # each here, which silently handed back the entire
-                # batched-prefill admission win (measured: 8 admissions
-                # cost ~800 ms in key fetches alone).  Under the default
-                # (x64-disabled) canonicalization PRNGKey(seed) is
-                # exactly [0, seed & 0xFFFFFFFF] (parity-tested); with
-                # x64 enabled threefry_seed keeps the high word too, so
-                # mirror it — otherwise an embedding application that
-                # flips jax_enable_x64 would silently fork the batcher's
-                # sampled streams from standalone seeded generates.
-                if jax.config.jax_enable_x64:
-                    keys[i, 0] = np.uint32((seed >> 32) & 0xFFFFFFFF)
-                keys[i, 1] = np.uint32(seed & 0xFFFFFFFF)
+                keys[i] = self._request_key(req)
                 temps[i] = req.temperature
                 top_ps[i] = req.top_p
                 top_ks[i] = req.top_k
@@ -1316,7 +1649,7 @@ class ContinuousBatcher:
                     config=self.draft_config,
                     prefill_chunk=self.prefill_chunk, mesh=self.mesh,
                 )
-            slot_ids = free_slots[:k]
+            slot_ids = [next(slot_iter) for _ in range(k)]
             idx = jnp.asarray(np.asarray(slot_ids, np.int32))
             self.tau = self.tau.at[idx].set(taus[:k])
             if self.logprobs:
@@ -1339,3 +1672,8 @@ class ContinuousBatcher:
                     request_id=req.rid, emitted=[], max_new=req.max_new,
                     stop_tokens=req.stops, blocks=blocks,
                 )
+                # Every block now has an active user; the freshly
+                # prefilled full prompt blocks join the prefix index.
+                self._claim_blocks(blocks)
+                chain = chains[req.rid]
+                self._register_chain(blocks[: len(chain)], chain)

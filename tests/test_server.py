@@ -656,6 +656,10 @@ def test_http_mixed_concurrent_load(model):
         assert not any(t.is_alive() for t in threads)
         assert all(v is True for v in results.values()), results
 
-    # Everything released: full block pool, no occupied slots.
-    assert len(cb.free_blocks) == total_blocks
+    # Everything released: the full pool is allocatable again — truly
+    # free blocks plus prefix-cache-retained ones (r5: completed
+    # requests RETAIN their keyed prompt blocks for reuse; retention is
+    # capacity, not leakage) — and no occupied slots.
+    assert len(cb.free_blocks) + len(cb._reusable) == total_blocks
     assert all(s is None for s in cb.slots.values())
+    assert not cb._block_refs  # no dangling refcounts
