@@ -62,6 +62,10 @@ def main() -> None:
                          "POST /chat for llama-3 tokenizers, "
                          "GET /metrics, /healthz) instead of the stdin "
                          "loop; 0 picks a free port")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable prompt prefix caching in the serving "
+                         "pool (on by default; hits never change outputs "
+                         "— this is a memory/debug knob)")
     ap.add_argument("--logprobs", action="store_true",
                     help="compute per-token model logprobs so HTTP "
                          "requests may ask for them (\"logprobs\": true)")
@@ -167,6 +171,7 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         temperature=args.temperature, top_p=args.top_p,
         seed=args.seed, mesh=mesh,
         logprobs=getattr(args, "logprobs", False),
+        prefix_cache=not getattr(args, "no_prefix_cache", False),
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).
@@ -208,6 +213,7 @@ def _serve(params, config, tokenizer, mesh, args) -> None:
         max_len=config.max_seq_len, stop_tokens=stops,
         temperature=args.temperature, top_p=args.top_p,
         seed=args.seed, mesh=mesh,
+        prefix_cache=not getattr(args, "no_prefix_cache", False),
     )
     rid_prompt: dict = {}
     emitted: dict = {}

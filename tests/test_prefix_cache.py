@@ -129,6 +129,73 @@ def test_eviction_under_pressure_stays_correct(model):
     assert cb.stats()["prefix_cached_blocks"] <= n_blocks
 
 
+def test_cancel_sharer_keeps_other_alive(model):
+    """Cancelling one of two requests sharing cached prefix blocks must
+    not free or corrupt the blocks under the survivor (refcount, not
+    ownership)."""
+    params, config = model
+    rng = np.random.RandomState(5)
+    prefix = rng.randint(1, 128, size=32).tolist()
+    a = prefix + [11]
+    b = prefix + [22]
+
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=128,
+                           block_size=16, prefix_cache=True)
+    r0 = cb.submit(list(prefix) + [1], max_new_tokens=2)
+    cb.run_to_completion()  # seed the cache
+    ra = cb.submit(list(a), max_new_tokens=8)
+    rb = cb.submit(list(b), max_new_tokens=8)
+    got = {ra: [], rb: []}
+    for rid, tok, *_ in cb.step():  # both admitted (as hits), decoding
+        got[rid].append(tok)
+    assert cb.cancel(ra)
+    while cb.pending():
+        for rid, tok, *_ in cb.step():
+            got[rid].append(tok)
+
+    cold = ContinuousBatcher(params, config, n_slots=2, max_len=128,
+                             block_size=16, prefix_cache=False)
+    cw = cold.submit(list(b), max_new_tokens=8)
+    want = cold.run_to_completion()[cw]
+    assert got[rb] == want
+    # And a later resubmit (hitting the still-cached chain) matches too.
+    rb2 = cb.submit(list(b), max_new_tokens=8)
+    assert cb.run_to_completion()[rb2] == want
+
+
+def test_chunked_suffix_and_logprobs(model):
+    """A hit whose remaining suffix spans multiple prefill chunks (the
+    chunked gathered-view path), with logprobs on: outputs AND per-token
+    logprobs identical to the cold batcher."""
+    params, config = model
+    rng = np.random.RandomState(4)
+    prefix = rng.randint(1, 128, size=32).tolist()  # 2 full blocks
+    long_suffix = rng.randint(1, 128, size=70).tolist()  # > 2 chunks of 32
+    prompt = prefix + long_suffix
+
+    def run(pc):
+        cb = ContinuousBatcher(
+            params, config, n_slots=1, max_len=256, block_size=16,
+            prefill_chunk=32, logprobs=True, prefix_cache=pc,
+        )
+        # Seed the cache with a short request sharing only the prefix.
+        r0 = cb.submit(list(prefix) + [7], max_new_tokens=2)
+        cb.run_to_completion()
+        rid = cb.submit(list(prompt), max_new_tokens=6)
+        out = []
+        while cb.pending():
+            for tup in cb.step():
+                if tup[0] == rid:
+                    out.append((tup[1], round(float(tup[3]), 5)))
+        return out, cb.stats()
+
+    warm, wst = run(True)
+    cold, _ = run(False)
+    assert warm == cold
+    assert wst["prefix_requests_hit_total"] == 1
+    assert wst["prefix_blocks_reused_total"] == 2
+
+
 def test_repeat_same_prompt_exact_with_spec(model):
     """Prefix hits compose with speculative decoding (draft pool shares
     the same blocks/chain): identical outputs, and the second submit of
