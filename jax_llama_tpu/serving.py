@@ -537,19 +537,23 @@ def _paged_suffix_insert(
     suffix_mask, keys, temperature, top_p, top_k, *,
     config, prefill_chunk=None, mesh=None, with_logprobs=False,
 ):
-    """Prefill ONE request's prompt SUFFIX over the paged pool — the
-    prefix-cache admission path: the leading ``fill0`` positions of the
-    row's table already hold a reused cached prefix, so only the suffix
-    runs through the model, attending the prefix KV through the row's
-    gathered view (``paged_forward``'s multi-token kernel contract
-    requires uniform activity along T, which a right-padded suffix
-    violates — the gather/scatter cost is one row's reservation, paid
-    once per admission).
+    """Prefill k requests' prompt SUFFIXES over the paged pool — the
+    prefix-cache admission path: the leading ``fill0[i]`` positions of
+    each row's table already hold a reused cached prefix, so only the
+    suffixes run through the model, attending the prefix KV through the
+    rows' gathered views (``paged_forward``'s multi-token kernel
+    contract requires uniform activity along T, which right-padded
+    suffixes violate — the gather/scatter cost is the rows'
+    reservations, paid once per admission).  Hit requests sharing a
+    padded suffix length are admitted as ONE call (per-row fill0
+    offsets differ freely); this environment charges ~100 ms of tunnel
+    latency per dispatch, so bursts of identical /chat prompts would
+    otherwise serialize.
 
-    table_row: [1, MB]; n_alloc_row, fill0: [1] int32 (fill0 = shared
+    table_row: [k, MB]; n_alloc_row, fill0: [k] int32 (fill0 = shared
     prefix length in tokens, a block multiple); suffix_tokens/mask:
-    [1, T] right-padded to a block multiple.
-    Returns (tau [1], tau logprob, carried keys, updated pool).
+    [k, T] right-padded to a block multiple.
+    Returns (tau [k], tau logprobs, carried keys, updated pool).
     """
     with use_mesh(mesh):
         B1, T = suffix_tokens.shape
@@ -1438,6 +1442,23 @@ class ContinuousBatcher:
         self.fill[b] = 0
         self.active[b] = False
 
+    def _row_bucket(self, reqs: List["_Request"]):
+        """Shared admission-row-bucket setup: the pow2 row count (jit
+        cache key discipline — both admission paths must bucket the same
+        way) plus the per-row key/sampling-parameter arrays."""
+        k = len(reqs)
+        kb = 1 << max(k - 1, 0).bit_length()
+        keys = np.zeros((kb, 2), np.uint32)
+        temps = np.zeros((kb,), np.float32)
+        top_ps = np.ones((kb,), np.float32)
+        top_ks = np.zeros((kb,), np.int32)
+        for i, req in enumerate(reqs):
+            keys[i] = self._request_key(req)
+            temps[i] = req.temperature
+            top_ps[i] = req.top_p
+            top_ks[i] = req.top_k
+        return kb, keys, temps, top_ps, top_ks
+
     def _request_key(self, req: "_Request") -> np.ndarray:
         """Host-built threefry key words for a request.  The obvious
         np.asarray(jax.random.PRNGKey(seed)) is a device round-trip PER
@@ -1462,84 +1483,98 @@ class ContinuousBatcher:
         kw[1] = np.uint32(seed & 0xFFFFFFFF)
         return kw
 
-    def _admit_shared(
-        self, req: "_Request", chain: List[bytes], hits: List[int],
-        b: int,
+    def _admit_shared_group(
+        self,
+        grp: List[Tuple["_Request", List[bytes], List[int]]],
+        slots: List[int],
     ) -> None:
-        """Admit one request whose leading full blocks hit the prefix
-        cache: reuse the cached blocks (already claimed by _admit) and
-        prefill only the suffix through the row's gathered view.  The
-        request's own freshly prefilled full prompt blocks extend the
-        published chain, so a follow-up with a longer shared prefix hits
-        deeper."""
+        """Admit a group of prefix-cache-hit requests sharing one padded
+        suffix length: reuse the cached blocks (already claimed by
+        _admit) and prefill only the suffixes through the rows' gathered
+        views in ONE dispatch (per-row fill offsets differ freely).
+        Each request's own freshly prefilled full prompt blocks extend
+        the published chain, so a follow-up with a longer shared prefix
+        hits deeper."""
         bs = self.block_size
-        n_share = len(hits)
-        L0 = n_share * bs
-        total = req.blocks_needed(bs)
-        fresh = self._alloc_blocks(total - n_share)
-        blocks = hits + fresh
-        suffix = req.tokens[L0:]
-        T = _round_up(len(suffix), bs)
-        st = np.zeros((1, T), np.int32)
-        sm = np.zeros((1, T), bool)
-        st[0, : len(suffix)] = suffix
-        sm[0, : len(suffix)] = True
-        table_row = np.full((1, self.blocks_per_slot), self.n_blocks,
-                            np.int32)
-        table_row[0, : len(blocks)] = blocks
-        tau, tau_lp, key_out, self.pool = _paged_suffix_insert(
-            self.params, self.pool, jnp.asarray(table_row),
-            jnp.asarray([len(blocks)], np.int32),
-            jnp.asarray([L0], np.int32), jnp.asarray(st),
-            jnp.asarray(sm),
-            jnp.asarray(self._request_key(req))[None],
-            jnp.asarray([req.temperature], np.float32),
-            jnp.asarray([req.top_p], np.float32),
-            jnp.asarray([req.top_k], np.int32),
+        k = len(grp)
+        kb, keysA, temps, top_ps, top_ks = self._row_bucket(
+            [r for r, _, _ in grp]
+        )
+        T = _round_up(len(grp[0][0].tokens) - len(grp[0][2]) * bs, bs)
+        st = np.zeros((kb, T), np.int32)
+        sm = np.zeros((kb, T), bool)
+        table_rows = np.full((kb, self.blocks_per_slot), self.n_blocks,
+                             np.int32)
+        n_alloc_arr = np.zeros((kb,), np.int32)
+        fill0s = np.zeros((kb,), np.int32)
+        row_blocks: List[List[int]] = []
+        row_fresh: List[List[int]] = []
+        for i, (req, chain, hits) in enumerate(grp):
+            n_share = len(hits)
+            L0 = n_share * bs
+            fresh = self._alloc_blocks(req.blocks_needed(bs) - n_share)
+            blocks = hits + fresh
+            row_blocks.append(blocks)
+            row_fresh.append(fresh)
+            suffix = req.tokens[L0:]
+            st[i, : len(suffix)] = suffix
+            sm[i, : len(suffix)] = True
+            table_rows[i, : len(blocks)] = blocks
+            n_alloc_arr[i] = len(blocks)
+            fill0s[i] = L0
+        tau, tau_lp, keys_out, self.pool = _paged_suffix_insert(
+            self.params, self.pool, jnp.asarray(table_rows),
+            jnp.asarray(n_alloc_arr), jnp.asarray(fill0s),
+            jnp.asarray(st), jnp.asarray(sm), jnp.asarray(keysA),
+            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
             config=self.config, prefill_chunk=self.prefill_chunk,
             mesh=self.mesh, with_logprobs=self.logprobs,
         )
         if self.spec:
             # Draft pool: the shared blocks hold the DRAFT model's KV
             # for the same tokens (written when the chain was first
-            # admitted under this batcher), so only the suffix runs
+            # admitted under this batcher), so only the suffixes run
             # here too; sampled tokens are discarded.
             _, _, _, self.draft_pool = _paged_suffix_insert(
                 self.draft_params, self.draft_pool,
-                jnp.asarray(table_row),
-                jnp.asarray([len(blocks)], np.int32),
-                jnp.asarray([L0], np.int32), jnp.asarray(st),
-                jnp.asarray(sm),
-                jnp.asarray(self._request_key(req))[None],
-                jnp.zeros((1,), jnp.float32), jnp.ones((1,), jnp.float32),
-                jnp.zeros((1,), jnp.int32),
+                jnp.asarray(table_rows), jnp.asarray(n_alloc_arr),
+                jnp.asarray(fill0s), jnp.asarray(st), jnp.asarray(sm),
+                jnp.asarray(keysA),
+                jnp.zeros((kb,), jnp.float32),
+                jnp.ones((kb,), jnp.float32),
+                jnp.zeros((kb,), jnp.int32),
                 config=self.draft_config,
                 prefill_chunk=self.prefill_chunk, mesh=self.mesh,
             )
-        self.tau = self.tau.at[b].set(tau[0])
+        idx = jnp.asarray(np.asarray(slots, np.int32))
+        self.tau = self.tau.at[idx].set(tau[:k])
         if self.logprobs:
-            self.tau_lp[b] = float(np.asarray(tau_lp)[0])
-        self.keys = self.keys.at[b].set(key_out[0])
-        self.pos[b] = len(req.tokens)
-        self.fill[b] = _round_up(len(req.tokens), bs)
-        self.active[b] = True
-        self.table[b] = self.n_blocks
-        self.table[b, : len(blocks)] = blocks
-        self.n_alloc[b] = len(blocks)
-        self.temp_arr[b] = req.temperature
-        self.top_p_arr[b] = req.top_p
-        self.top_k_arr[b] = req.top_k
-        self.slots[b] = _Slot(
-            request_id=req.rid, emitted=[], max_new=req.max_new,
-            stop_tokens=req.stops, blocks=blocks,
-        )
-        self._claim_blocks(fresh)
-        # Extend the published chain with this request's own full
-        # prompt blocks (indices n_share..len(chain)-1 are fresh).
-        self._register_chain(blocks[n_share: len(chain)],
-                             chain[n_share:])
-        self.prefix_requests_hit += 1
-        self.prefix_blocks_reused += n_share
+            self.tau_lp[np.asarray(slots)] = np.asarray(tau_lp)[:k]
+        self.keys = self.keys.at[idx].set(keys_out[:k])
+        for i, (req, chain, hits) in enumerate(grp):
+            b = slots[i]
+            blocks = row_blocks[i]
+            n_share = len(hits)
+            self.pos[b] = len(req.tokens)
+            self.fill[b] = _round_up(len(req.tokens), bs)
+            self.active[b] = True
+            self.table[b] = self.n_blocks
+            self.table[b, : len(blocks)] = blocks
+            self.n_alloc[b] = len(blocks)
+            self.temp_arr[b] = req.temperature
+            self.top_p_arr[b] = req.top_p
+            self.top_k_arr[b] = req.top_k
+            self.slots[b] = _Slot(
+                request_id=req.rid, emitted=[], max_new=req.max_new,
+                stop_tokens=req.stops, blocks=blocks,
+            )
+            self._claim_blocks(row_fresh[i])
+            # Extend the published chain with this request's own full
+            # prompt blocks (indices n_share..len(chain)-1 are fresh).
+            self._register_chain(blocks[n_share: len(chain)],
+                                 chain[n_share:])
+            self.prefix_requests_hit += 1
+            self.prefix_blocks_reused += n_share
 
     def _admit(self) -> None:
         """Admit queued requests into free slots.
@@ -1550,10 +1585,11 @@ class ContinuousBatcher:
         block-padded prompt length) instead of k serialized B=1
         dispatches — in this environment each dispatch costs ~100ms of
         tunnel latency on top of the prefill itself.  Requests whose
-        leading full blocks hit the prefix cache are admitted
-        individually through ``_paged_suffix_insert`` (per-row position
-        offsets don't fit the group program; the hit's whole point is
-        that the remaining suffix is small).  Per-row right-padding and
+        leading full blocks hit the prefix cache are admitted through
+        ``_paged_suffix_insert``, grouped by padded suffix length so a
+        burst of similar /chat prompts is ONE dispatch too (per-row
+        fill0 offsets differ freely within a group — the gathered view
+        and scatter-back are per-row already).  Per-row right-padding and
         per-row key chains keep every request's output bit-identical to
         one-at-a-time admission; head-of-line FIFO blocking on block
         reservations is preserved (budget stays the FULL reservation
@@ -1591,12 +1627,24 @@ class ContinuousBatcher:
             shared = [(r, c, h) for r, c, h in picked if h]
             batch = [r for r, c, h in picked if not h]
             chains = {r.rid: c for r, c, h in picked}
+            # Hit requests group by padded suffix length: each group is
+            # ONE suffix-insert dispatch (identical /chat prompts in a
+            # burst land in the same group).
+            groups: Dict[int, List[Tuple[_Request, List[bytes], List[int]]]] = {}
             for req, chain, hits in shared:
-                self._admit_shared(req, chain, hits, next(slot_iter))
+                T = _round_up(
+                    len(req.tokens) - len(hits) * self.block_size,
+                    self.block_size,
+                )
+                groups.setdefault(T, []).append((req, chain, hits))
+            for grp in groups.values():
+                self._admit_shared_group(
+                    grp, [next(slot_iter) for _ in grp]
+                )
             if not batch:
                 continue
             k = len(batch)
-            kb = 1 << max(k - 1, 0).bit_length()  # pow2 row bucket
+            kb, keys, temps, top_ps, top_ks = self._row_bucket(batch)
             P = max(
                 _round_up(len(r.tokens), self.block_size) for r in batch
             )
@@ -1604,10 +1652,6 @@ class ContinuousBatcher:
             pt = np.zeros((kb, P), np.int32)
             pm = np.zeros((kb, P), bool)
             bid = np.full((kb, nb), self.n_blocks, np.int32)
-            keys = np.zeros((kb, 2), np.uint32)
-            temps = np.zeros((kb,), np.float32)
-            top_ps = np.ones((kb,), np.float32)
-            top_ks = np.zeros((kb,), np.int32)
             row_blocks: List[List[int]] = []
             for i, req in enumerate(batch):
                 Pb = _round_up(len(req.tokens), self.block_size)
@@ -1623,10 +1667,6 @@ class ContinuousBatcher:
                 bid[i, : Pb // self.block_size] = blocks[
                     : Pb // self.block_size
                 ]
-                keys[i] = self._request_key(req)
-                temps[i] = req.temperature
-                top_ps[i] = req.top_p
-                top_ks[i] = req.top_k
             taus, tau_lps, plens, keys_out, self.pool = _paged_insert(
                 self.params, self.pool, jnp.asarray(bid),
                 jnp.asarray(pt), jnp.asarray(pm), jnp.asarray(keys),

@@ -196,6 +196,39 @@ def test_chunked_suffix_and_logprobs(model):
     assert wst["prefix_blocks_reused_total"] == 2
 
 
+def test_grouped_hits_with_differing_prefix_depths(model):
+    """One grouped suffix-insert dispatch whose rows have DIFFERENT
+    cached-prefix depths (fill0 32 vs 48) but the same padded suffix
+    length: per-row offsets must be honored independently — outputs
+    identical to the cold batcher for both rows."""
+    params, config = model
+    rng = np.random.RandomState(6)
+    pref_a = rng.randint(1, 128, size=32).tolist()  # 2 full blocks
+    pref_b = rng.randint(1, 128, size=48).tolist()  # 3 full blocks
+    a = pref_a + rng.randint(1, 128, size=10).tolist()  # suffix pads to 16
+    b = pref_b + rng.randint(1, 128, size=12).tolist()  # suffix pads to 16
+
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=128,
+                           block_size=16, prefix_cache=True)
+    cb.submit(list(pref_a) + [1], max_new_tokens=2)
+    cb.submit(list(pref_b) + [2], max_new_tokens=2)
+    cb.run_to_completion()  # seed both chains
+    ra = cb.submit(list(a), max_new_tokens=6)
+    rb = cb.submit(list(b), max_new_tokens=6)
+    res = cb.run_to_completion()
+    st = cb.stats()
+    assert st["prefix_requests_hit_total"] == 2
+    assert st["prefix_blocks_reused_total"] == 5  # 2 + 3
+
+    cold = ContinuousBatcher(params, config, n_slots=2, max_len=128,
+                             block_size=16, prefix_cache=False)
+    ca = cold.submit(list(a), max_new_tokens=6)
+    cbr = cold.submit(list(b), max_new_tokens=6)
+    cres = cold.run_to_completion()
+    assert res[ra] == cres[ca]
+    assert res[rb] == cres[cbr]
+
+
 def test_repeat_same_prompt_exact_with_spec(model):
     """Prefix hits compose with speculative decoding (draft pool shares
     the same blocks/chain): identical outputs, and the second submit of
