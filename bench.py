@@ -83,15 +83,22 @@ def main() -> None:
         difference cancels both prefill time and the constant per-call
         dispatch overhead of this environment's tunnel out of the metric.
 
-        PAIRED-MEDIAN differencing (r5; was min-of-5 on each side):
-        min-of-min composes two independent minima, and the full-run
-        side occasionally produces an anomalously FAST outlier (r5
-        instrumented run: full samples [0.456, 0.491, 0.492, 0.493,
-        0.493] s — one 35 ms-fast fluke against a 2 ms-tight cluster)
-        which min() then selects, overstating the rate by ~9%.  The
-        median of per-index (full − short) pairs is outlier-robust and
-        agreed with the jitter-immune xplane device rate to 0.2% in the
-        same session (2728 vs 2734 tok/s, vs min-of-min's 2985)."""
+        RANK-PAIRED MEDIAN differencing (r5; was min-of-5 on each
+        side): the 5 full and 5 short timings are each sorted, paired
+        BY RANK (k-th order statistic of one against the k-th of the
+        other — the runs are independent, so there is no meaningful
+        run-to-run pairing to preserve), and the median of those
+        rank-matched differences is taken.  min-of-min composed two
+        independent minima, and the full-run side occasionally
+        produces an anomalously FAST outlier (r5 instrumented run:
+        full samples [0.456, 0.491, 0.492, 0.493, 0.493] s — one
+        35 ms-fast fluke against a 2 ms-tight cluster) which min()
+        then selects, overstating the rate by ~9%.  The rank-paired
+        median is outlier-robust and agreed with the jitter-immune
+        xplane device rate to 0.2% in the same session (2728 vs 2734
+        tok/s, vs min-of-min's 2985).  The returned fulls[0] /
+        shorts[0] companions are each side's min-of-5 (reported for
+        context, not inputs to the rate)."""
         fulls = sorted(run(p, N) for _ in range(5))
         shorts = sorted(run(p, 1) for _ in range(5))
         diffs = sorted(f - s for f, s in zip(fulls, shorts))

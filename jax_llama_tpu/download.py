@@ -20,9 +20,13 @@ downloading, run the converter:
 from __future__ import annotations
 
 import argparse
+import errno
 import hashlib
+import random
+import shutil
+import time
 from pathlib import Path
-from typing import Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 # consolidated.*.pth shard count per model size (reference download.sh:9-13
 # covers LLaMA-1; LLaMA-2/3 use the same layout with these counts).
@@ -69,14 +73,69 @@ def verify_checklist(directory: Path, checklist_name: str = "checklist.chk") -> 
     return ok
 
 
-def _fetch(url: str, dest: Path) -> None:
+# Transient-failure policy for _fetch: a hard socket timeout (a stalled
+# CDN connection must not hang a 130 GB download forever) plus bounded
+# exponential backoff with jitter on transient errors — URLError
+# (connection reset / DNS / timeout) and HTTP 5xx.  4xx (e.g. an expired
+# presigned URL) fails immediately: retrying cannot fix it.
+FETCH_TIMEOUT_S = 60.0
+FETCH_RETRIES = 4          # total attempts = 1 + FETCH_RETRIES
+FETCH_BACKOFF_BASE_S = 1.0
+# Local-filesystem errnos retrying a download can never fix (the OSError
+# branch below otherwise also wraps the .part write/rename): surface
+# them immediately instead of re-pulling a multi-GB shard with backoff.
+_NONRETRYABLE_ERRNO = frozenset({
+    errno.ENOSPC, errno.EACCES, errno.EROFS, errno.EDQUOT, errno.EISDIR,
+})
+
+
+def _fetch(
+    url: str,
+    dest: Path,
+    *,
+    timeout: float = FETCH_TIMEOUT_S,
+    retries: int = FETCH_RETRIES,
+    opener: Optional[Callable] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    jitter: Optional[Callable[[], float]] = None,
+) -> None:
+    """Download ``url`` to ``dest`` atomically (.part then rename), with
+    a socket timeout and bounded retry.  ``opener``/``sleep``/``jitter``
+    are injectable for unit tests (default: ``urllib.request.urlopen``,
+    ``time.sleep``, ``random.random``)."""
+    import urllib.error
     import urllib.request
 
+    opener = opener or urllib.request.urlopen
+    jitter = jitter or random.random
     dest.parent.mkdir(parents=True, exist_ok=True)
     tmp = dest.with_suffix(dest.suffix + ".part")
     print(f"  {dest.name} <- {url.split('?')[0]}")
-    urllib.request.urlretrieve(url, tmp)
-    tmp.rename(dest)
+    for attempt in range(retries + 1):
+        try:
+            with opener(url, timeout=timeout) as r, open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            tmp.rename(dest)
+            return
+        except urllib.error.HTTPError as e:
+            # HTTPError subclasses URLError — catch it first.  Only
+            # server-side (5xx) failures are transient.
+            if e.code < 500 or attempt == retries:
+                raise
+            err = e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            # URLError/TimeoutError carry no filesystem errno, so this
+            # only fires for genuine disk-side failures.
+            if getattr(e, "errno", None) in _NONRETRYABLE_ERRNO:
+                raise
+            if attempt == retries:
+                raise
+            err = e
+        # Full-jitter exponential backoff: base * 2^attempt * U(0.5, 1.5),
+        # capped implicitly by the bounded retry count.
+        delay = FETCH_BACKOFF_BASE_S * (2 ** attempt) * (0.5 + jitter())
+        print(f"  retrying in {delay:.1f}s ({err})")
+        sleep(delay)
 
 
 def download(presigned_url: str, model_sizes: List[str], target: Path) -> None:

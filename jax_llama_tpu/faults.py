@@ -1,0 +1,190 @@
+"""Deterministic fault injection for the serving stack.
+
+The serving loop hangs everything off one device-owning thread: an
+exception out of a jitted dispatch (``ContinuousBatcher.step`` /
+``_paged_insert`` / ``_paged_suffix_insert``) or a block allocation kills
+the loop.  This module makes those failure paths *testable and
+rehearsable*: a seeded :class:`FaultInjector` with named injection sites
+wraps the batcher's dispatch points and can raise device-style errors,
+fail allocations, or add latency — at a chosen call index or with a
+seeded per-call probability — so both the test suite and manual chaos
+runs (``run.py --inject-faults`` / ``JLT_FAULTS``) exercise crash
+recovery, the retry budget, and the step watchdog deterministically.
+
+Sites (fired by ``ContinuousBatcher`` just before the real operation):
+
+  ``step``           a decode/speculative step dispatch
+  ``insert``         a batched full-prompt prefill (``_paged_insert``)
+  ``suffix_insert``  a prefix-cache-hit suffix prefill
+  ``alloc``          a block-pool allocation (``_alloc_blocks``)
+
+Spec grammar (comma-separated, used by the CLI flag and ``JLT_FAULTS``)::
+
+    site@N:kind[=value]     fire when the site's call counter == N
+    site~P:kind[=value]     fire each call with probability P (seeded)
+
+kinds: ``error`` (raise :class:`InjectedFault`, a device-style runtime
+error), ``oom`` (raise :class:`InjectedOOM`, an allocation failure), and
+``delay=SECONDS`` (sleep, then proceed — the watchdog's test lever).
+
+Examples::
+
+    step@5:error                 kill the 6th decode dispatch
+    insert@0:error,alloc@3:oom   first prefill + 4th allocation
+    step~0.01:error              1% of steps, deterministic per seed
+    step@2:delay=1.5             stall one step by 1.5 s
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+SITES = ("step", "insert", "suffix_insert", "alloc")
+KINDS = ("error", "oom", "delay")
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected device-style failure (INTERNAL)."""
+
+
+class InjectedOOM(InjectedFault):
+    """A deliberately injected allocation failure (RESOURCE_EXHAUSTED)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One injection rule: fire ``kind`` at ``site`` when the site's call
+    counter equals ``at``, or (``at`` is None) with probability ``p`` per
+    call drawn from the injector's seeded RNG."""
+
+    site: str
+    kind: str
+    at: Optional[int] = None
+    p: float = 0.0
+    delay_s: float = 0.0
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r}; have {SITES}"
+            )
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; have {KINDS}"
+            )
+        if self.at is None and not (0.0 < self.p <= 1.0):
+            raise ValueError(
+                "a FaultSpec needs an index (site@N) or a probability "
+                "in (0, 1] (site~P)"
+            )
+
+    @classmethod
+    def parse(cls, text: str) -> List["FaultSpec"]:
+        """Parse the comma-separated CLI/env grammar (module docstring)."""
+        specs: List[FaultSpec] = []
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            head, sep, kind = part.partition(":")
+            if not sep:
+                raise ValueError(
+                    f"bad fault spec {part!r}: expected site[@N|~P]:kind"
+                )
+            kind, _, value = kind.partition("=")
+            kind = kind.strip()
+            at: Optional[int] = None
+            p = 0.0
+            if "@" in head:
+                site, _, idx = head.partition("@")
+                at = int(idx)
+            elif "~" in head:
+                site, _, prob = head.partition("~")
+                p = float(prob)
+            else:
+                site, at = head, 0
+            delay_s = 0.0
+            if kind == "delay":
+                if not value:
+                    raise ValueError(
+                        f"bad fault spec {part!r}: delay needs =SECONDS"
+                    )
+                delay_s = float(value)
+            elif value:
+                raise ValueError(
+                    f"bad fault spec {part!r}: {kind} takes no =value"
+                )
+            specs.append(cls(
+                site=site.strip(), kind=kind, at=at, p=p, delay_s=delay_s
+            ))
+        return specs
+
+
+class FaultInjector:
+    """Seeded, counting fault injector shared by a batcher's sites.
+
+    ``fire(site)`` increments the site's call counter, checks every spec
+    for that site, and either returns (no match), sleeps (``delay``), or
+    raises (``error``/``oom``).  Counters survive a batcher rebuild (the
+    recovery path hands the same injector to the fresh batcher), so
+    ``step@N`` indexes the N-th dispatch of the *process*, not of one
+    batcher incarnation — which is what makes "kill step 5, recover,
+    don't kill step 6" expressible.
+    """
+
+    def __init__(
+        self,
+        specs: Union[str, Sequence[FaultSpec], None] = None,
+        seed: int = 0,
+    ):
+        if isinstance(specs, str):
+            specs = FaultSpec.parse(specs)
+        self.specs: List[FaultSpec] = list(specs or [])
+        self._rng = random.Random(seed)
+        self.calls: Dict[str, int] = {s: 0 for s in SITES}
+        self.injected: Dict[str, int] = {s: 0 for s in SITES}
+        self.injected_total = 0
+        self.delays_total = 0
+
+    def fire(self, site: str) -> None:
+        """Hook point: called by the batcher just before the real op."""
+        n = self.calls.get(site, 0)
+        self.calls[site] = n + 1
+        for spec in self.specs:
+            if spec.site != site:
+                continue
+            if spec.at is not None:
+                hit = spec.at == n
+            else:
+                hit = self._rng.random() < spec.p
+            if not hit:
+                continue
+            if spec.kind == "delay":
+                self.delays_total += 1
+                time.sleep(spec.delay_s)
+                continue
+            self.injected[site] = self.injected.get(site, 0) + 1
+            self.injected_total += 1
+            if spec.kind == "oom":
+                raise InjectedOOM(
+                    f"RESOURCE_EXHAUSTED: injected allocation failure "
+                    f"({site} call #{n})"
+                )
+            raise InjectedFault(
+                f"INTERNAL: injected device error ({site} call #{n})"
+            )
+
+    def stats(self) -> Dict[str, float]:
+        """Counters for the HTTP /metrics endpoint."""
+        out: Dict[str, float] = {
+            "faults_injected_total": self.injected_total,
+            "fault_delays_total": self.delays_total,
+        }
+        for site in SITES:
+            out[f"faults_injected_{site}_total"] = self.injected.get(
+                site, 0
+            )
+        return out

@@ -34,6 +34,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams; accept
+# either so the kernels run across the version skew (same fields).
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 # Finite stand-in for -inf: fully-masked tiles then accumulate a bogus-but-
 # finite (l, acc) that the online-softmax rescale zeroes out the moment a
 # real score arrives (exp(MASK - real) == 0), and rows that stay fully
@@ -854,7 +860,7 @@ def _flash_forward(
         # carries state through scratch ("arbitrary").  Without this hint
         # Mosaic treats the whole grid as sequential and cannot pipeline
         # block DMA against compute — measured ~4x slower at 16k context.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
             # The default 16 MiB scoped-vmem budget blocks the larger
             # tiles (s lives at [block_q, block_k] fp32); v5e VMEM is
@@ -1224,7 +1230,7 @@ def _flash_backward(
                 scratch_shapes=scratch_shapes,
             ),
             out_shape=out_shape,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=(
                     "parallel", "parallel", "parallel", "arbitrary"
                 ),

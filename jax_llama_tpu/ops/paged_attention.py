@@ -40,7 +40,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import MASK_VALUE, _LANES, _SUBLANES, _resolve_interpret
+from .flash_attention import (
+    MASK_VALUE,
+    _CompilerParams,
+    _LANES,
+    _SUBLANES,
+    _resolve_interpret,
+)
 
 
 def _paged_kernel(
@@ -364,7 +370,7 @@ def paged_pool_attention(
             jax.ShapeDtypeStruct((B, KVH, TG8, d), jnp.float32),
             jax.ShapeDtypeStruct((B, KVH, TG8, _LANES), jnp.float32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -467,7 +473,9 @@ def paged_decode_attention(
                     q_pos, k_scale, v_scale, layer, interpret,
                 )
 
-            fn = jax.shard_map(
+            from ..parallel.mesh import shard_map_compat
+
+            fn = shard_map_compat(
                 body, mesh=mesh, in_specs=tuple(in_specs),
                 out_specs=head4, check_vma=False,
             )

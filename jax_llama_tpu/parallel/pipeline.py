@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .mesh import shard_map_compat
+
 StageFn = Callable[
     [Any, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray
 ]
@@ -128,7 +130,7 @@ def pipeline_blocks(
                 )
         return outs
 
-    out = jax.shard_map(
+    out = shard_map_compat(
         per_stage,
         mesh=mesh,
         in_specs=(P(axis_name), P(), P(), P()),

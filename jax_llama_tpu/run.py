@@ -64,19 +64,61 @@ def main() -> None:
                          "loop; 0 picks a free port")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable prompt prefix caching in the serving "
-                         "pool (on by default; hits never change outputs "
-                         "— this is a memory/debug knob)")
+                         "pool (on by default; hits are token-identical "
+                         "in tested configurations — this is a "
+                         "memory/debug knob)")
     ap.add_argument("--logprobs", action="store_true",
                     help="compute per-token model logprobs so HTTP "
                          "requests may ask for them (\"logprobs\": true)")
     ap.add_argument("--host", default="127.0.0.1",
                     help="bind address for --http")
+    ap.add_argument("--inject-faults", default=None, metavar="SPEC",
+                    help="deterministic fault injection for chaos runs "
+                         "(--http only): comma-separated "
+                         "site[@N|~P]:kind[=v] rules — sites step, "
+                         "insert, suffix_insert, alloc; kinds error, "
+                         "oom, delay=SECONDS; e.g. 'step@5:error' or "
+                         "'step~0.01:error'.  Also read from the "
+                         "JLT_FAULTS env var")
+    ap.add_argument("--fault-seed", type=int, default=0,
+                    help="seed for probabilistic (site~P) fault rules")
+    ap.add_argument("--max-recoveries", type=int, default=3,
+                    help="crash recoveries (batcher rebuild + request "
+                         "replay) allowed per --recovery-window-s "
+                         "before the server hard-drains with 503s")
+    ap.add_argument("--recovery-window-s", type=float, default=60.0)
+    ap.add_argument("--watchdog-s", type=float, default=60.0,
+                    help="flip /healthz degraded when the serving loop "
+                         "heartbeat stalls past this many seconds "
+                         "(0 disables the watchdog thread)")
     args = ap.parse_args()
     if args.logprobs and args.http is None:
         raise SystemExit(
             "--logprobs only applies to the HTTP server (--http PORT); "
             "the stdin/--serve and one-shot modes have no logprobs output"
         )
+    import os
+
+    # The env var is checked here too: a JLT_FAULTS chaos drill that the
+    # chosen mode cannot honor must refuse loudly, not run fault-free
+    # while the operator believes injection was armed.
+    fault_spec = args.inject_faults or os.environ.get("JLT_FAULTS")
+    if fault_spec:
+        if args.http is None:
+            raise SystemExit(
+                "--inject-faults / JLT_FAULTS only apply to the HTTP "
+                "server (--http PORT) — the stdin/--serve and one-shot "
+                "modes have no crash recovery, so a fault drill there "
+                "would just crash the run"
+            )
+        # Validate the spec BEFORE the (potentially minutes-long) weight
+        # load; faults.py imports no jax, so this is free.
+        from .faults import FaultSpec
+
+        try:
+            FaultSpec.parse(fault_spec)
+        except ValueError as e:
+            raise SystemExit(f"bad fault spec: {e}")
 
     import jax
 
@@ -157,6 +199,7 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
     the function returns instead of blocking (tests drive requests
     against the live server without a second process).
     """
+    import os
     import time
 
     from .server import LLMServer
@@ -165,6 +208,19 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
     stops = tuple(
         int(s) for s in getattr(tokenizer, "stop_tokens", [tokenizer.eos_id])
     )
+    # Fault injection (chaos runs / tests): --inject-faults wins over the
+    # JLT_FAULTS env var; absent both, no injector is constructed.
+    fault_spec = (
+        getattr(args, "inject_faults", None) or os.environ.get("JLT_FAULTS")
+    )
+    injector = None
+    if fault_spec:
+        from .faults import FaultInjector
+
+        injector = FaultInjector(
+            fault_spec, seed=getattr(args, "fault_seed", 0)
+        )
+        print(f"fault injection armed: {fault_spec}", flush=True)
     cb = ContinuousBatcher(
         params, config, n_slots=args.slots,
         max_len=config.max_seq_len, stop_tokens=stops,
@@ -172,6 +228,7 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         seed=args.seed, mesh=mesh,
         logprobs=getattr(args, "logprobs", False),
         prefix_cache=not getattr(args, "no_prefix_cache", False),
+        fault_injector=injector,
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).
@@ -180,9 +237,13 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         from .tokenizers.llama3 import ChatFormat
 
         chat_format = ChatFormat(tokenizer)
+    watchdog_s = getattr(args, "watchdog_s", 60.0)
     with LLMServer(
         cb, tokenizer=tokenizer, host=args.host, port=args.http,
         chat_format=chat_format,
+        max_recoveries=getattr(args, "max_recoveries", 3),
+        recovery_window_s=getattr(args, "recovery_window_s", 60.0),
+        watchdog_deadline_s=watchdog_s if watchdog_s > 0 else None,
     ) as srv:
         endpoints = "POST /generate" + (
             ", /chat" if chat_format is not None else ""

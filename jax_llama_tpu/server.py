@@ -48,6 +48,7 @@ Endpoints:
 
 from __future__ import annotations
 
+import inspect
 import json
 import math
 import queue
@@ -59,9 +60,15 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from .serving import ContinuousBatcher
+from .serving import ContinuousBatcher, _round_up
 
 _DONE = object()  # stream sentinel
+
+# The batcher's own default generation budget — read from the signature
+# so the recovery snapshot can never drift from what submit() reserved.
+_SUBMIT_DEFAULT_MAX_NEW = inspect.signature(
+    ContinuousBatcher.submit
+).parameters["max_new_tokens"].default
 
 
 @dataclass
@@ -93,6 +100,20 @@ class _Pending:
     # (requires the batcher to be constructed with logprobs=True).
     want_lp: bool = False
     lps: List[float] = field(default_factory=list)
+    # Crash-recovery snapshot, recorded at submit time: the CPU-side
+    # state a replay needs.  ``tokens`` above is the DELIVERED record —
+    # authoritative over the batcher's slot.emitted, which may include
+    # tokens an aborted step() never returned; replaying from prompt +
+    # delivered regenerates those, so clients neither miss nor repeat
+    # tokens.
+    prompt_tokens: List[int] = field(default_factory=list)
+    submit_kwargs: Dict[str, Any] = field(default_factory=dict)
+    max_new: int = _SUBMIT_DEFAULT_MAX_NEW
+    replay_seed: Optional[int] = None
+    # Recovery clamped this request's continuation budget (the replayed
+    # prompt's block padding ate capacity): the reply is shorter than a
+    # fault-free run's and says so.
+    truncated: bool = False
 
     def fail(self, message: str, code: int) -> None:
         self.error = message
@@ -117,17 +138,44 @@ class LLMServer:
         port: int = 0,
         max_queue: int = 256,
         chat_format: Any = None,
+        max_recoveries: int = 3,
+        recovery_window_s: float = 60.0,
+        watchdog_deadline_s: Optional[float] = 60.0,
+        watchdog_interval_s: float = 1.0,
     ):
         self.batcher = batcher
         self.tokenizer = tokenizer
         self.chat_format = chat_format
         self.max_queue = max_queue
+        # Crash-recovery circuit breaker: at most ``max_recoveries``
+        # batcher rebuilds per sliding ``recovery_window_s`` window; one
+        # more failure hard-drains (every client 503s) instead of
+        # crash-looping a persistently broken device.
+        self.max_recoveries = max_recoveries
+        self.recovery_window_s = recovery_window_s
+        self.recoveries_total = 0
+        self._recovery_times: List[float] = []
+        # Step watchdog: the loop heartbeats every iteration; a monitor
+        # thread flips /healthz to a degraded payload when the heartbeat
+        # goes stale past the deadline (a wedged dispatch, not a crash —
+        # crashes drain loudly).  None disables the monitor thread.
+        self.watchdog_deadline_s = watchdog_deadline_s
+        self.watchdog_interval_s = watchdog_interval_s
+        self.watchdog_stalls_total = 0
+        self._heartbeat = time.monotonic()
+        self._stalled = False
         self._inbox: "queue.Queue[_Pending]" = queue.Queue()
         self._active: Dict[int, _Pending] = {}
         self._stop = threading.Event()
         self._closed = threading.Event()  # set once the loop has drained
         self._loop_thread = threading.Thread(
             target=self._loop, name="llm-serving-loop", daemon=True
+        )
+        self._watchdog_thread = (
+            threading.Thread(
+                target=self._watchdog, name="llm-watchdog", daemon=True
+            )
+            if watchdog_deadline_s is not None else None
         )
 
         server = self
@@ -150,7 +198,8 @@ class LLMServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._reply_json(200, {"ok": True})
+                    h = server._health()
+                    self._reply_json(200 if h["ok"] else 503, h)
                 elif self.path == "/metrics":
                     self._reply(
                         200, server._metrics_text().encode(),
@@ -261,6 +310,8 @@ class LLMServer:
                     "request_id": pending.request_id,
                     "tokens": pending.tokens,
                 }
+                if pending.truncated:
+                    out["truncated"] = True
                 if pending.want_lp:
                     out["logprobs"] = pending.lps
                 if server.tokenizer is not None:
@@ -319,6 +370,8 @@ class LLMServer:
                     "request_id": pending.request_id,
                     "tokens": pending.tokens,
                 }
+                if pending.truncated:
+                    final["truncated"] = True
                 if pending.want_lp:
                     final["logprobs"] = pending.lps
                 if pending.timed_out:
@@ -340,7 +393,10 @@ class LLMServer:
         return f"http://{host}:{port}"
 
     def start(self) -> "LLMServer":
+        self._heartbeat = time.monotonic()
         self._loop_thread.start()
+        if self._watchdog_thread is not None:
+            self._watchdog_thread.start()
         self._http_thread.start()
         return self
 
@@ -349,6 +405,8 @@ class LLMServer:
         self.httpd.shutdown()
         self.httpd.server_close()
         self._loop_thread.join(timeout=30)
+        if self._watchdog_thread is not None:
+            self._watchdog_thread.join(timeout=10)
 
     def __enter__(self) -> "LLMServer":
         return self.start()
@@ -436,6 +494,17 @@ class LLMServer:
                 kwargs["stop_tokens"] = tuple(int(t) for t in stops)
         rid = self.batcher.submit(tokens, **kwargs)
         p.request_id = rid
+        # Snapshot the replay state (crash recovery resubmits from it):
+        # original prompt, resolved sampling kwargs, and the seed pinned
+        # to its resolved value — a replayed request gets a new id, so
+        # leaving the seed implicit would silently fork its chain.
+        p.prompt_tokens = list(tokens)
+        p.submit_kwargs = dict(kwargs)
+        p.max_new = int(kwargs.get("max_new_tokens", _SUBMIT_DEFAULT_MAX_NEW))
+        p.replay_seed = (
+            int(kwargs["seed"]) if kwargs.get("seed") is not None
+            else self.batcher.default_seed(rid)
+        )
         self._active[rid] = p
 
     def _reap(self) -> None:
@@ -457,6 +526,100 @@ class LLMServer:
                 p.timed_out = True
                 p.fail("generation timed out", 504)
 
+    def _recover(self, exc: BaseException) -> bool:
+        """Crash recovery: rebuild the batcher (fresh pool + host state
+        from the still-held params) and resubmit every live request from
+        the CPU-side snapshot each ``_Pending`` carries — original
+        prompt + DELIVERED tokens as the replay prompt, remaining token
+        budget, same sampling params/stops, seed pinned to its resolved
+        value.  Greedy requests continue token-identically (teacher-
+        forced prefix); streaming clients see only fresh continuation
+        tokens, never a repeat, because the replay prompt already
+        contains everything they received.
+
+        Returns False when the circuit breaker trips (``max_recoveries``
+        rebuilds inside ``recovery_window_s``): the caller re-raises and
+        the finally-drain 503s every client instead of crash-looping."""
+        now = time.monotonic()
+        self._recovery_times = [
+            t for t in self._recovery_times
+            if now - t < self.recovery_window_s
+        ]
+        if len(self._recovery_times) >= self.max_recoveries:
+            return False
+        self._recovery_times.append(now)
+        self.recoveries_total += 1
+        # Rebuild BEFORE detaching _active: if the rebuild itself dies
+        # (e.g. a real OOM re-allocating the pool), the exception must
+        # propagate with _active intact so the finally-drain still
+        # delivers the crash reason to every in-flight client.
+        new_batcher = self.batcher.rebuild()
+        old_active, self._active = self._active, {}
+        self.batcher = new_batcher
+        bs = self.batcher.block_size
+        for p in old_active.values():
+            prompt = list(p.prompt_tokens) + list(p.tokens)
+            remaining = p.max_new - len(p.tokens)
+            # Replay headroom: prompt + delivered pads to a block
+            # multiple, which can exceed the original prompt's padding
+            # by up to a block — a request admitted within a block of
+            # capacity can lose up to block_size-1 tokens of budget.
+            # Clamp rather than reject, but SAY SO: a shortened reply
+            # carries "truncated": true instead of silently posing as
+            # the full fault-free completion.
+            # _round_up is submit()'s own padding helper — the headroom
+            # math must stay in lockstep with its admission check.
+            room = self.batcher.max_len - _round_up(len(prompt), bs)
+            if room < remaining:
+                remaining = room
+                p.truncated = True
+            if remaining <= 0:
+                p.finish()  # deliver what the client already has
+                continue
+            kwargs = dict(p.submit_kwargs)
+            kwargs["max_new_tokens"] = remaining
+            kwargs["seed"] = p.replay_seed
+            try:
+                rid = self.batcher.submit(prompt, **kwargs)
+            except (ValueError, TypeError) as e:
+                p.fail(f"lost in crash recovery: {e}", 503)
+                continue
+            p.request_id = rid
+            self._active[rid] = p
+        return True
+
+    def _watchdog(self) -> None:
+        """Monitor thread: flag a stall when the serving loop's heartbeat
+        goes stale past the deadline (the loop beats every iteration,
+        idle included, so only a wedged dispatch — or a dead loop —
+        stalls).  Passive by design: it flips /healthz degraded for the
+        fleet's load balancer; it never touches the batcher."""
+        while not self._stop.wait(self.watchdog_interval_s):
+            if self._closed.is_set():
+                break
+            age = time.monotonic() - self._heartbeat
+            if age > self.watchdog_deadline_s:
+                if not self._stalled:
+                    self._stalled = True
+                    self.watchdog_stalls_total += 1
+            else:
+                self._stalled = False
+
+    def _health(self) -> Dict[str, Any]:
+        """The /healthz payload: liveness + watchdog/recovery state.
+        ``ok`` is False (HTTP 503) when the loop is dead or stalled."""
+        alive = self._loop_thread.is_alive() and not self._closed.is_set()
+        return {
+            "ok": alive and not self._stalled,
+            "stalled": self._stalled,
+            "loop_alive": alive,
+            "last_step_age_s": round(
+                time.monotonic() - self._heartbeat, 3
+            ),
+            "recoveries_total": self.recoveries_total,
+            "watchdog_stalls_total": self.watchdog_stalls_total,
+        }
+
     def _loop(self) -> None:
         # The finally-drain guarantees no client blocks forever: whether
         # the loop exits via stop() or an unexpected device/runtime error,
@@ -464,6 +627,7 @@ class LLMServer:
         reason, code = "server shutting down", 503
         try:
             while not self._stop.is_set():
+                self._heartbeat = time.monotonic()
                 # Admit whatever is waiting; block briefly when fully idle
                 # so shutdown and new work are both responsive.
                 try:
@@ -491,7 +655,16 @@ class LLMServer:
                 self._reap()
                 if not self.batcher.pending():
                     continue
-                for ev in self.batcher.step():
+                try:
+                    events = self.batcher.step()
+                except Exception as e:
+                    # A step/insert dispatch died (device error, injected
+                    # fault, allocation failure).  Rebuild + replay; past
+                    # the retry budget, re-raise into the hard drain.
+                    if self._recover(e):
+                        continue
+                    raise
+                for ev in events:
                     rid, tok, done = ev[0], ev[1], ev[2]
                     lp = ev[3] if len(ev) > 3 else None
                     p = self._active.get(rid)
@@ -520,8 +693,19 @@ class LLMServer:
     # -- metrics ------------------------------------------------------------
 
     def _metrics_text(self) -> str:
+        stats = dict(self.batcher.stats())
+        stats.update({
+            # Server-level fault tolerance (batcher counters above carry
+            # the injection-site totals when an injector is attached).
+            "server_recoveries_total": self.recoveries_total,
+            "watchdog_stalls_total": self.watchdog_stalls_total,
+            "watchdog_stalled": int(self._stalled),
+            "watchdog_last_step_age_seconds": round(
+                time.monotonic() - self._heartbeat, 3
+            ),
+        })
         lines = []
-        for k, v in self.batcher.stats().items():
+        for k, v in stats.items():
             name = f"llm_{k}"
             kind = "gauge" if "total" not in k else "counter"
             lines.append(f"# TYPE {name} {kind}")

@@ -61,6 +61,7 @@ from jax import lax
 
 from .config import LLaMAConfig
 from .engine import prompt_positions
+from .faults import FaultInjector
 from .models.llama import (
     KVCache,
     PagedKVCache,
@@ -558,7 +559,7 @@ def _paged_suffix_insert(
     with use_mesh(mesh):
         B1, T = suffix_tokens.shape
         view = _gather_cache(pool, table_row, n_alloc_row, fill0)
-        slen = jnp.sum(suffix_mask.astype(jnp.int32), axis=1)  # [1]
+        slen = jnp.sum(suffix_mask.astype(jnp.int32), axis=1)  # [k]
         positions = jnp.where(
             suffix_mask,
             fill0[:, None]
@@ -938,7 +939,23 @@ class ContinuousBatcher:
         use_pallas_kernel: bool = True,
         logprobs: bool = False,
         prefix_cache: bool = True,
+        fault_injector: Optional[FaultInjector] = None,
     ):
+        # Raw construction arguments, captured before any derivation so
+        # ``rebuild()`` (crash recovery) reproduces this batcher exactly
+        # — fresh pool + host state, same geometry and policies.  The
+        # injector is shared across rebuilds so its call counters index
+        # the process's dispatches, not one incarnation's.
+        self._ctor_kwargs = dict(
+            n_slots=n_slots, max_len=max_len, stop_tokens=stop_tokens,
+            temperature=temperature, top_p=top_p, top_k=top_k,
+            prefill_chunk=prefill_chunk, seed=seed, block_size=block_size,
+            n_blocks=n_blocks, draft_params=draft_params,
+            draft_config=draft_config, n_draft=n_draft, mesh=mesh,
+            use_pallas_kernel=use_pallas_kernel, logprobs=logprobs,
+            prefix_cache=prefix_cache, fault_injector=fault_injector,
+        )
+        self.fault_injector = fault_injector
         if config.attn_impl not in ("xla", "auto"):
             raise ValueError(
                 "continuous batching requires attn_impl 'xla' or 'auto' "
@@ -1004,7 +1021,12 @@ class ContinuousBatcher:
         # blocks in an LRU (``_reusable``) until allocation pressure
         # evicts them — so the /chat pattern of identical system prompts
         # across sequential requests skips the shared prefill entirely.
-        # Enabled by default; ``prefix_cache=False`` disables matching
+        # Hits are token-identical to a cold batcher in the tested
+        # (CPU fp32) configurations — the suffix path computes its
+        # activations in a differently-shaped dispatch than a cold full
+        # prefill, so on-chip bf16 identity is a parity test away, not a
+        # theorem.  Enabled by default; ``prefix_cache=False`` disables
+        # matching
         # and retention (refcounts still maintained — the mechanism is
         # the same, it just never hits).
         self.prefix_cache_enabled = bool(prefix_cache)
@@ -1043,6 +1065,30 @@ class ContinuousBatcher:
         self._next_id = 0
 
     # -- public API ---------------------------------------------------------
+
+    def rebuild(self) -> "ContinuousBatcher":
+        """Fresh batcher with this one's construction: new KV pool and
+        host-side slot/queue/cache state from the still-held params (the
+        jitted step programs are cached per-function, so no recompile).
+        The crash-recovery path: after a dispatch exception the old
+        instance's device state is suspect; callers resubmit every
+        in-flight request (prompt + delivered tokens as the new prompt)
+        against the rebuilt instance and drop this one."""
+        return ContinuousBatcher(
+            self.params, self.config, **self._ctor_kwargs
+        )
+
+    def default_seed(self, rid: int) -> int:
+        """The PRNG seed a request without an explicit one derives from
+        the pool seed and its id (the exact mix ``_request_key`` uses).
+        Exposed so a recovery layer can pin a replayed request to its
+        original chain start instead of a new id's derivation."""
+        return (self.seed * 1000003 + rid) & 0x7FFFFFFF
+
+    def _fault(self, site: str) -> None:
+        """Named fault-injection hook (no-op without an injector)."""
+        if self.fault_injector is not None:
+            self.fault_injector.fire(site)
 
     def submit(
         self,
@@ -1145,7 +1191,10 @@ class ContinuousBatcher:
 
     def stats(self) -> Dict[str, float]:
         """Counters for observability (the HTTP /metrics endpoint)."""
-        return {
+        out: Dict[str, float] = {} if self.fault_injector is None else (
+            dict(self.fault_injector.stats())
+        )
+        out.update({
             "emitted_tokens_total": self.emitted_total,
             "decode_steps_total": self.steps_total,
             "active_slots": sum(
@@ -1160,7 +1209,8 @@ class ContinuousBatcher:
             "prefix_cached_blocks": len(self._reusable),
             "prefix_requests_hit_total": self.prefix_requests_hit,
             "prefix_blocks_reused_total": self.prefix_blocks_reused,
-        }
+        })
+        return out
 
     def step(self) -> List[Tuple]:
         """One decode step for every active slot.
@@ -1202,6 +1252,13 @@ class ContinuousBatcher:
                 self._free_slot(b)
 
         if any(s is not None for s in self.slots.values()):
+            # Injection site "step": fires AFTER the emit/free scan above
+            # — exactly where a real dispatch failure lands, with this
+            # step's events already appended to slot.emitted but never
+            # returned to the caller.  Recovery must therefore replay
+            # from the tokens it DELIVERED, not from slot.emitted (the
+            # server keeps its own per-request token record).
+            self._fault("step")
             self.steps_total += 1
             if self.spec:
                 self._spec_tail(out)
@@ -1321,6 +1378,7 @@ class ContinuousBatcher:
         block re-purposed as part of a DECODE reservation is only
         overwritten up to the prompt span — a stale pos >= 0 in the
         beyond-the-prompt region would be attended as a live slot."""
+        self._fault("alloc")
         out: List[int] = []
         evicted: List[int] = []
         for _ in range(n):
@@ -1357,6 +1415,29 @@ class ContinuousBatcher:
         key = self._block_chain.pop(blk, None)
         if key is not None and self._prefix_index.get(key) == blk:
             del self._prefix_index[key]
+
+    def _invalidate_and_free(self, blocks: List[int]) -> None:
+        """Return blocks to the free list with their pool positions
+        invalidated (a stale pos >= 0 in a re-purposed block's
+        beyond-the-prompt region would be attended as live KV)."""
+        if not blocks:
+            return
+        for start in range(0, len(blocks), self.blocks_per_slot):
+            chunk = blocks[start:start + self.blocks_per_slot]
+            ids = np.full((self.blocks_per_slot,), self.n_blocks, np.int32)
+            ids[: len(chunk)] = chunk
+            self.pool = dataclasses.replace(
+                self.pool,
+                pos=_release_blocks(self.pool.pos, jnp.asarray(ids)),
+            )
+            if self.spec:
+                self.draft_pool = dataclasses.replace(
+                    self.draft_pool,
+                    pos=_release_blocks(
+                        self.draft_pool.pos, jnp.asarray(ids)
+                    ),
+                )
+        self.free_blocks.extend(blocks)
 
     @staticmethod
     def _chain_keys(tokens: Sequence[int], block_size: int) -> List[bytes]:
@@ -1395,12 +1476,31 @@ class ContinuousBatcher:
             self._reusable.pop(blk, None)
 
     def _register_chain(self, blocks: List[int], keys: List[bytes]) -> None:
-        """Publish a request's freshly prefilled full prompt blocks."""
+        """Publish a request's freshly prefilled full prompt blocks.
+
+        A duplicate chain re-prefill (two identical prompts admitted in
+        one cold burst, or a stranded suffix re-keyed after a mid-chain
+        eviction) overwrites ``_prefix_index[key]`` — the superseded
+        block can never be hit again, so drop its chain entry; if nothing
+        is using it (it sat idle in ``_reusable``) free it outright.
+        Without this, ``_reusable`` accumulates unreachable blocks that
+        occupy pool capacity until LRU pressure happens to evict them."""
         if not self.prefix_cache_enabled:
             return
+        superseded: List[int] = []
         for blk, key in zip(blocks, keys):
+            old = self._prefix_index.get(key)
+            if old is not None and old != blk:
+                self._block_chain.pop(old, None)
+                if old in self._reusable:
+                    del self._reusable[old]
+                    superseded.append(old)
             self._block_chain[blk] = key
             self._prefix_index[key] = blk
+        # One batched free for the whole chain: per-block frees would be
+        # one jitted _release_blocks dispatch each (~100 ms of tunnel
+        # latency apiece in this environment).
+        self._invalidate_and_free(superseded)
 
     def _free_slot(self, b: int) -> None:
         slot = self.slots[b]
@@ -1423,24 +1523,34 @@ class ContinuousBatcher:
                 plain.append(blk)
         for blk in reversed(retained):
             self._reusable[blk] = None
-        if plain:
-            ids = np.full((self.blocks_per_slot,), self.n_blocks, np.int32)
-            ids[: len(plain)] = plain
-            new_pos = _release_blocks(self.pool.pos, jnp.asarray(ids))
-            self.pool = dataclasses.replace(self.pool, pos=new_pos)
-            if self.spec:
-                self.draft_pool = dataclasses.replace(
-                    self.draft_pool,
-                    pos=_release_blocks(
-                        self.draft_pool.pos, jnp.asarray(ids)
-                    ),
-                )
-            self.free_blocks.extend(plain)
+        self._invalidate_and_free(plain)
         self.slots[b] = None
         self.table[b] = self.n_blocks
         self.n_alloc[b] = 0
         self.fill[b] = 0
         self.active[b] = False
+
+    def _suffix_pad(self, n_suffix_tokens: int, n_share: int) -> int:
+        """Padded suffix length for the grouped suffix-insert: round to a
+        block multiple, then bucket the BLOCK COUNT to a power of two —
+        the same jit-cache-key discipline admission row counts already
+        follow — so diverse /chat prompt lengths compile a bounded
+        O(log2(max_len / block_size)) set of ``_paged_suffix_insert``
+        executables instead of one per distinct suffix length.  The
+        extra padding is masked compute (positions -1, mask False), and
+        POOL write columns past a row's reservation resolve to sentinel
+        table entries and drop (the ``paged_write_indices`` contract).
+        The hard bound is the gathered VIEW: its width is
+        blocks_per_slot x block_size and the in-forward cache write
+        starts at fill0 = n_share blocks — a bucket past the remaining
+        view columns would make that dynamic-update clamp its start and
+        scribble over the reused prefix KV, so clamp the bucket to the
+        columns the row actually has (admissibility guarantees the
+        un-bucketed count fits, so the clamp never shrinks below it)."""
+        nb = max(1, -(-n_suffix_tokens // self.block_size))
+        nb_b = 1 << (nb - 1).bit_length()
+        cap = self.blocks_per_slot - n_share
+        return (min(nb_b, cap) if cap >= nb else nb) * self.block_size
 
     def _row_bucket(self, reqs: List["_Request"]):
         """Shared admission-row-bucket setup: the pow2 row count (jit
@@ -1475,7 +1585,7 @@ class ContinuousBatcher:
         change sampled outputs across Python versions.)"""
         seed = (
             req.seed if req.seed is not None
-            else (self.seed * 1000003 + req.rid) & 0x7FFFFFFF
+            else self.default_seed(req.rid)
         )
         kw = np.zeros((2,), np.uint32)
         if jax.config.jax_enable_x64:
@@ -1500,7 +1610,9 @@ class ContinuousBatcher:
         kb, keysA, temps, top_ps, top_ks = self._row_bucket(
             [r for r, _, _ in grp]
         )
-        T = _round_up(len(grp[0][0].tokens) - len(grp[0][2]) * bs, bs)
+        T = self._suffix_pad(
+            len(grp[0][0].tokens) - len(grp[0][2]) * bs, len(grp[0][2])
+        )
         st = np.zeros((kb, T), np.int32)
         sm = np.zeros((kb, T), bool)
         table_rows = np.full((kb, self.blocks_per_slot), self.n_blocks,
@@ -1522,6 +1634,7 @@ class ContinuousBatcher:
             table_rows[i, : len(blocks)] = blocks
             n_alloc_arr[i] = len(blocks)
             fill0s[i] = L0
+        self._fault("suffix_insert")
         tau, tau_lp, keys_out, self.pool = _paged_suffix_insert(
             self.params, self.pool, jnp.asarray(table_rows),
             jnp.asarray(n_alloc_arr), jnp.asarray(fill0s),
@@ -1632,9 +1745,9 @@ class ContinuousBatcher:
             # burst land in the same group).
             groups: Dict[int, List[Tuple[_Request, List[bytes], List[int]]]] = {}
             for req, chain, hits in shared:
-                T = _round_up(
+                T = self._suffix_pad(
                     len(req.tokens) - len(hits) * self.block_size,
-                    self.block_size,
+                    len(hits),
                 )
                 groups.setdefault(T, []).append((req, chain, hits))
             for grp in groups.values():
@@ -1667,6 +1780,7 @@ class ContinuousBatcher:
                 bid[i, : Pb // self.block_size] = blocks[
                     : Pb // self.block_size
                 ]
+            self._fault("insert")
             taus, tau_lps, plens, keys_out, self.pool = _paged_insert(
                 self.params, self.pool, jnp.asarray(bid),
                 jnp.asarray(pt), jnp.asarray(pm), jnp.asarray(keys),

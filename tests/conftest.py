@@ -50,6 +50,17 @@ def pytest_configure(config):
         "tpu: compiled-on-chip kernel regression tests (run: pytest -m tpu "
         "on a TPU host; forced-CPU otherwise and the tests self-skip)",
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection / crash-recovery / watchdog tests "
+        "(CPU-safe and part of the default tier-1 run; select just them "
+        "with pytest -m faults)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 run "
+        "(pytest -m 'not slow')",
+    )
 
 
 @pytest.fixture(autouse=True)

@@ -4,6 +4,8 @@ is)."""
 
 from pathlib import Path
 
+import pytest
+
 from jax_llama_tpu.download import (
     N_SHARDS,
     md5_file,
@@ -78,6 +80,95 @@ def test_download_resumes_verified_shards(tmp_path: Path, monkeypatch):
     except SystemExit:
         pass
     assert fetched == ["consolidated.01.pth"]
+
+
+class _FakeResponse:
+    """Minimal context-managed urlopen response."""
+
+    def __init__(self, payload: bytes):
+        import io
+
+        self._buf = io.BytesIO(payload)
+
+    def __enter__(self):
+        return self._buf
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_fetch_retries_transient_then_succeeds(tmp_path: Path):
+    """A flaky opener (URLError, then HTTP 503) is retried with
+    exponential backoff + jitter and a socket timeout on every attempt;
+    the third attempt lands atomically (no .part left behind)."""
+    import urllib.error
+
+    import jax_llama_tpu.download as dl
+
+    calls, sleeps = [], []
+
+    def opener(url, timeout):
+        calls.append(timeout)
+        if len(calls) == 1:
+            raise urllib.error.URLError("connection reset")
+        if len(calls) == 2:
+            raise urllib.error.HTTPError(url, 503, "unavailable", None, None)
+        return _FakeResponse(b"payload")
+
+    dest = tmp_path / "f.bin"
+    dl._fetch(
+        "https://host/f.bin?sig", dest,
+        opener=opener, sleep=sleeps.append, jitter=lambda: 0.5,
+    )
+    assert dest.read_bytes() == b"payload"
+    assert not (tmp_path / "f.bin.part").exists()
+    assert calls == [dl.FETCH_TIMEOUT_S] * 3   # timeout on every attempt
+    # base * 2^attempt * (0.5 + jitter): bounded exponential backoff
+    assert sleeps == [dl.FETCH_BACKOFF_BASE_S * 1.0,
+                      dl.FETCH_BACKOFF_BASE_S * 2.0]
+
+
+def test_fetch_4xx_fails_immediately(tmp_path: Path):
+    """Client errors (expired presigned URL) are not transient: no
+    retry, no sleep."""
+    import urllib.error
+
+    import jax_llama_tpu.download as dl
+
+    calls, sleeps = [], []
+
+    def opener(url, timeout):
+        calls.append(url)
+        raise urllib.error.HTTPError(url, 403, "forbidden", None, None)
+
+    with pytest.raises(urllib.error.HTTPError):
+        dl._fetch(
+            "https://host/x?sig", tmp_path / "x",
+            opener=opener, sleep=sleeps.append,
+        )
+    assert len(calls) == 1 and sleeps == []
+
+
+def test_fetch_retry_budget_is_bounded(tmp_path: Path):
+    """A persistently failing fetch raises after 1 + retries attempts."""
+    import urllib.error
+
+    import jax_llama_tpu.download as dl
+
+    calls, sleeps = [], []
+
+    def opener(url, timeout):
+        calls.append(url)
+        raise urllib.error.URLError("no route to host")
+
+    with pytest.raises(urllib.error.URLError):
+        dl._fetch(
+            "https://host/x?sig", tmp_path / "x",
+            opener=opener, retries=2, sleep=sleeps.append,
+            jitter=lambda: 0.0,
+        )
+    assert len(calls) == 3
+    assert sleeps == [0.5, 1.0]   # base * 2^attempt * 0.5 (no jitter)
 
 
 def test_initialize_single_host_is_noop(monkeypatch):

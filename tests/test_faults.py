@@ -1,0 +1,566 @@
+"""Fault tolerance: deterministic injection, crash recovery with request
+replay, the recovery circuit breaker, and the step watchdog.
+
+The invariants pinned here:
+  * a fault injected at a mid-decode step (or at admission / allocation /
+    suffix-insert) recovers: EVERY in-flight and queued request still
+    completes, and greedy outputs are token-identical to a fault-free
+    run — streaming clients receive no duplicated tokens;
+  * exceeding the recovery budget drains cleanly: all clients get 503,
+    no handler thread hangs, and /healthz reports the dead loop;
+  * the watchdog flips /healthz to a degraded payload (last-step age,
+    recovery count) while a step stalls, and clears it afterwards;
+  * /metrics exposes recovery / injection / watchdog counters.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.faults import (
+    FaultInjector,
+    FaultSpec,
+    InjectedFault,
+    InjectedOOM,
+)
+from jax_llama_tpu.server import LLMServer
+from jax_llama_tpu.serving import ContinuousBatcher
+
+pytestmark = pytest.mark.faults
+
+CFG = dict(
+    vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    multiple_of=32, max_seq_len=128, dtype="float32", param_dtype="float32",
+)
+
+PROMPTS = [[5, 17, 99, 3], [7, 8, 9], [11, 12, 13], [2, 3, 4]]
+MAX_NEW = 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = get_config("tiny", **CFG)
+    params = init_params(jax.random.PRNGKey(0), config)
+    return params, config
+
+
+@pytest.fixture(scope="module")
+def reference(model):
+    """Fault-free greedy outputs for PROMPTS (the identity oracle)."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    rids = [cb.submit(list(p), max_new_tokens=MAX_NEW) for p in PROMPTS]
+    out = cb.run_to_completion()
+    return [out[r] for r in rids]
+
+
+def _post(url, payload, timeout=300):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url, path, timeout=60):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _stream_lines(url, payload, timeout=300):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.status == 200
+        return [json.loads(line) for line in r.read().splitlines()]
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector unit behavior (no jax involved)
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parse():
+    specs = FaultSpec.parse(
+        "step@5:error, alloc@0:oom,insert~0.25:error,step@3:delay=1.5"
+    )
+    assert specs[0] == FaultSpec(site="step", kind="error", at=5)
+    assert specs[1] == FaultSpec(site="alloc", kind="oom", at=0)
+    assert specs[2] == FaultSpec(site="insert", kind="error", p=0.25)
+    assert specs[3] == FaultSpec(
+        site="step", kind="delay", at=3, delay_s=1.5
+    )
+    # bare site defaults to index 0
+    assert FaultSpec.parse("suffix_insert:error")[0].at == 0
+    for bad in ("nosite@0:error", "step@0:nope", "step@0:delay",
+                "step~0.0:error", "step~1.5:error", "step"):
+        with pytest.raises(ValueError):
+            FaultSpec.parse(bad)
+
+
+def test_injector_counts_and_raises():
+    inj = FaultInjector("step@1:error,alloc@0:oom")
+    inj.fire("step")                      # call 0: no match
+    with pytest.raises(InjectedFault):
+        inj.fire("step")                  # call 1: boom
+    inj.fire("step")                      # call 2: indices fire once
+    with pytest.raises(InjectedOOM):
+        inj.fire("alloc")
+    assert inj.calls["step"] == 3 and inj.calls["alloc"] == 1
+    st = inj.stats()
+    assert st["faults_injected_total"] == 2
+    assert st["faults_injected_step_total"] == 1
+    assert st["faults_injected_alloc_total"] == 1
+
+
+def test_injector_probability_is_seeded():
+    def pattern(seed):
+        inj = FaultInjector("step~0.5:error", seed=seed)
+        out = []
+        for _ in range(64):
+            try:
+                inj.fire("step")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    a, b, c = pattern(7), pattern(7), pattern(8)
+    assert a == b                # deterministic per seed
+    assert a != c                # varies across seeds
+    assert 0 < sum(a) < 64       # actually probabilistic
+
+
+def test_injector_delay(monkeypatch):
+    import jax_llama_tpu.faults as faults_mod
+
+    slept = []
+    monkeypatch.setattr(faults_mod.time, "sleep", slept.append)
+    inj = FaultInjector("step@0:delay=0.75")
+    inj.fire("step")
+    inj.fire("step")
+    assert slept == [0.75]
+    assert inj.delays_total == 1
+    assert inj.injected_total == 0  # delays are not failures
+
+
+# ---------------------------------------------------------------------------
+# Batcher-level rebuild + replay (the recovery primitive, no HTTP)
+# ---------------------------------------------------------------------------
+
+def test_rebuild_replay_continues_greedy_exactly(model):
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    rid = cb.submit(list(PROMPTS[0]), max_new_tokens=10)
+    want = cb.run_to_completion()[rid]
+
+    cb2 = cb.rebuild()
+    assert cb2.block_size == cb.block_size
+    assert cb2.n_blocks == cb.n_blocks
+    cb2.submit(list(PROMPTS[0]), max_new_tokens=10)
+    got = []
+    for _ in range(4):  # partial progress, then "crash"
+        for ev in cb2.step():
+            got.append(ev[1])
+    assert 0 < len(got) < 10
+    cb3 = cb2.rebuild()
+    rid3 = cb3.submit(
+        list(PROMPTS[0]) + got, max_new_tokens=10 - len(got)
+    )
+    got += cb3.run_to_completion()[rid3]
+    assert got == want
+
+
+def test_default_seed_matches_submit_derivation(model):
+    """A replayed request pinned to default_seed(rid) draws the same key
+    words submit's implicit derivation would."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64, seed=9)
+    rid = cb.submit([4, 5, 6], max_new_tokens=2, temperature=0.8)
+    req = cb.queue[0]
+    implicit = cb._request_key(req)
+    import dataclasses as _dc
+    explicit = cb._request_key(
+        _dc.replace(req, seed=cb.default_seed(rid))
+    )
+    assert (implicit == explicit).all()
+
+
+# ---------------------------------------------------------------------------
+# The acceptance path: mid-decode kill, every request completes identically
+# ---------------------------------------------------------------------------
+
+def test_mid_decode_fault_all_requests_complete_identically(
+    model, reference
+):
+    """Kill the engine mid-decode (step dispatch #3 raises a device-style
+    error) with blocking, streaming, and queued requests live: recovery
+    rebuilds the batcher and replays, every request completes, greedy
+    outputs are identical to the fault-free run, and the streaming
+    client sees each token exactly once."""
+    params, config = model
+    inj = FaultInjector("step@3:error")
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, fault_injector=inj
+    )
+    results = {}
+    with LLMServer(cb) as srv:
+        def call(i):
+            try:
+                if i == 0:  # one streaming client
+                    results[i] = _stream_lines(
+                        srv.address,
+                        {"prompt": PROMPTS[i], "max_new_tokens": MAX_NEW,
+                         "stream": True},
+                    )
+                else:
+                    _, body = _post(
+                        srv.address,
+                        {"prompt": PROMPTS[i], "max_new_tokens": MAX_NEW},
+                    )
+                    results[i] = body["tokens"]
+            except Exception as e:  # noqa: BLE001 — fail the test, not the thread
+                results[i] = f"{type(e).__name__}: {e}"
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(PROMPTS))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads)
+
+        lines = results[0]
+        assert isinstance(lines, list), lines
+        streamed = [ln["token"] for ln in lines[:-1]]
+        assert streamed == reference[0]          # no dup, no gap
+        assert lines[-1]["done"] is True
+        assert lines[-1]["tokens"] == reference[0]
+        for i in range(1, len(PROMPTS)):
+            assert results[i] == reference[i], i
+
+        assert inj.injected_total == 1
+        assert srv.recoveries_total == 1
+        _, mtext = _get(srv.address, "/metrics")
+        assert "llm_server_recoveries_total 1" in mtext
+        assert "llm_faults_injected_total 1" in mtext
+        assert "llm_watchdog_stalls_total 0" in mtext
+        _, htext = _get(srv.address, "/healthz")
+        h = json.loads(htext)
+        assert h["ok"] is True and h["recoveries_total"] == 1
+        assert h["stalled"] is False and "last_step_age_s" in h
+
+
+@pytest.mark.parametrize(
+    "spec", ["insert@0:error", "step@2:error", "alloc@1:oom"]
+)
+def test_fault_matrix_recovers(model, reference, spec):
+    """CPU fault matrix: inject at admission (the batched prefill
+    dispatch), mid-decode, and during block allocation — recovery keeps
+    every request's greedy output identical to the fault-free run."""
+    params, config = model
+    inj = FaultInjector(spec)
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, fault_injector=inj
+    )
+    results = {}
+    with LLMServer(cb) as srv:
+        def call(i):
+            try:
+                _, body = _post(
+                    srv.address,
+                    {"prompt": PROMPTS[i], "max_new_tokens": MAX_NEW},
+                )
+                results[i] = body["tokens"]
+            except Exception as e:  # noqa: BLE001
+                results[i] = f"{type(e).__name__}: {e}"
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(PROMPTS))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads)
+        for i in range(len(PROMPTS)):
+            assert results[i] == reference[i], (spec, i)
+        assert inj.injected_total == 1
+        assert srv.recoveries_total == 1
+
+
+def test_suffix_insert_fault_recovers(model):
+    """The prefix-cache-hit admission dispatch dies: recovery replays the
+    request through a cold batcher's full-prefill path — same tokens (a
+    hit changes what is computed, never what is emitted)."""
+    params, config = model
+    rng = np.random.RandomState(3)
+    base = rng.randint(1, 128, size=40).tolist()  # 2 full keyed blocks
+    p1, p2 = base + [3], base + [9, 4]
+
+    cb0 = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                            block_size=16)
+    r1 = cb0.submit(list(p1), max_new_tokens=6)
+    want1 = cb0.run_to_completion()[r1]
+    r2 = cb0.submit(list(p2), max_new_tokens=6)  # suffix-path hit
+    want2 = cb0.run_to_completion()[r2]
+    assert cb0.stats()["prefix_requests_hit_total"] == 1
+
+    inj = FaultInjector("suffix_insert@0:error")
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                           block_size=16, fault_injector=inj)
+    with LLMServer(cb) as srv:
+        _, body1 = _post(
+            srv.address, {"prompt": p1, "max_new_tokens": 6}
+        )
+        assert body1["tokens"] == want1
+        _, body2 = _post(
+            srv.address, {"prompt": p2, "max_new_tokens": 6}
+        )
+        assert body2["tokens"] == want2
+        assert inj.injected["suffix_insert"] == 1
+        assert srv.recoveries_total == 1
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker: hard drain past the budget
+# ---------------------------------------------------------------------------
+
+def test_recovery_budget_exhausted_drains_with_503(model):
+    """Every step faults: after max_recoveries rebuilds the loop gives
+    up — all in-flight clients get 503, no handler thread hangs, new
+    requests are refused, and /healthz reports the dead loop."""
+    params, config = model
+    inj = FaultInjector("step~1.0:error")
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, fault_injector=inj
+    )
+    codes = {}
+    with LLMServer(cb, max_recoveries=2, recovery_window_s=60.0) as srv:
+        def call(i):
+            try:
+                codes[i] = _post(
+                    srv.address,
+                    {"prompt": PROMPTS[i], "max_new_tokens": 4},
+                    timeout=300,
+                )[0]
+            except urllib.error.HTTPError as e:
+                codes[i] = e.code
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads)  # nobody hangs
+        assert codes == {0: 503, 1: 503}
+
+        # the loop is dead: new work is refused up front
+        try:
+            _post(srv.address, {"prompt": [1, 2], "max_new_tokens": 2})
+            assert False, "expected HTTP 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+
+        # degraded health: loop dead, recovery counters exposed
+        try:
+            _get(srv.address, "/healthz")
+            assert False, "expected HTTP 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            h = json.loads(e.read())
+            assert h["ok"] is False and h["loop_alive"] is False
+            assert h["recoveries_total"] == 2
+
+        _, mtext = _get(srv.address, "/metrics")
+        assert "llm_server_recoveries_total 2" in mtext
+        assert inj.injected_total == 3  # 2 recovered + 1 fatal
+    assert srv.recoveries_total == 2
+
+
+# ---------------------------------------------------------------------------
+# Step watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_flags_stall_and_clears(model):
+    """A 2 s injected stall in one step flips /healthz to a degraded
+    payload (stalled, last-step age) while the loop is wedged, and
+    clears it once steps resume; /metrics counts the stall."""
+    params, config = model
+    # step@5: the warm-up request consumes steps 0-1, so the stall lands
+    # mid-generation of the observed request.
+    inj = FaultInjector("step@5:delay=2.0")
+    cb = ContinuousBatcher(
+        params, config, n_slots=1, max_len=64, fault_injector=inj
+    )
+    with LLMServer(
+        cb, watchdog_deadline_s=0.4, watchdog_interval_s=0.05
+    ) as srv:
+        # Warm the compile caches so the injected delay is the only
+        # multi-second step.
+        status, _ = _post(
+            srv.address, {"prompt": [4, 5], "max_new_tokens": 2}
+        )
+        assert status == 200
+
+        result = {}
+
+        def call():
+            result["r"] = _post(
+                srv.address,
+                {"prompt": [7, 8, 9], "max_new_tokens": 6}, timeout=300,
+            )
+
+        t = threading.Thread(target=call)
+        t.start()
+        seen_degraded = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not seen_degraded:
+            try:
+                _get(srv.address, "/healthz", timeout=30)
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                h = json.loads(e.read())
+                if h["stalled"]:
+                    assert h["last_step_age_s"] >= 0.4
+                    assert h["loop_alive"] is True  # wedged, not dead
+                    seen_degraded = True
+            time.sleep(0.02)
+        t.join(timeout=300)
+        assert not t.is_alive()
+        assert seen_degraded, "watchdog never flagged the stalled step"
+        status, body = result["r"]
+        assert status == 200 and len(body["tokens"]) == 6
+
+        # the stall clears once the loop beats again
+        cleared = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not cleared:
+            try:
+                status, htext = _get(srv.address, "/healthz", timeout=30)
+                cleared = json.loads(htext)["ok"] is True
+            except urllib.error.HTTPError:
+                time.sleep(0.05)
+        assert cleared
+        _, mtext = _get(srv.address, "/metrics")
+        # >= 1: the warm-up request's first-step compile may itself have
+        # outlived the (deliberately tight) deadline and counted a stall.
+        stalls = next(
+            float(line.split()[1]) for line in mtext.splitlines()
+            if line.startswith("llm_watchdog_stalls_total")
+        )
+        assert stalls >= 1
+        assert "llm_watchdog_stalled 0" in mtext
+        assert inj.delays_total == 1
+
+
+# ---------------------------------------------------------------------------
+# run.py wiring
+# ---------------------------------------------------------------------------
+
+def test_run_cli_fault_flags(tmp_path, capsys, monkeypatch):
+    """--inject-faults arms an injector on the server's batcher; a
+    mid-decode kill recovers transparently and the counters surface in
+    /metrics and /healthz."""
+    import sys
+
+    from jax_llama_tpu.convert.checkpoint import save_checkpoint
+    import jax_llama_tpu.run as run_cli
+
+    config = get_config(
+        "tiny", vocab_size=512, dim=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, multiple_of=32, max_seq_len=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(str(ckpt), params, config)
+
+    hits = {}
+
+    def hook(srv):
+        _, body = _post(
+            srv.address,
+            {"text": "hi", "max_new_tokens": 6, "temperature": 0.0},
+        )
+        hits["gen"] = body
+        hits["metrics"] = _get(srv.address, "/metrics")[1]
+        hits["health"] = json.loads(_get(srv.address, "/healthz")[1])
+
+    orig = run_cli._serve_http
+    monkeypatch.setattr(
+        run_cli, "_serve_http",
+        lambda *a, **kw: orig(*a, **kw, _test_hook=hook),
+    )
+    monkeypatch.setattr(
+        sys, "argv",
+        ["run", "--ckpt-dir", str(ckpt), "--byte-tokenizer",
+         "--tensor", "2", "--http", "0", "--max-gen-len", "8",
+         "--temperature", "0.0", "--inject-faults", "step@2:error",
+         "--watchdog-s", "30"],
+    )
+    run_cli.main()
+    out = capsys.readouterr().out
+    assert "fault injection armed" in out
+    assert len(hits["gen"]["tokens"]) == 6
+    assert "llm_faults_injected_total 1" in hits["metrics"]
+    assert "llm_server_recoveries_total 1" in hits["metrics"]
+    assert hits["health"]["ok"] is True
+    assert hits["health"]["recoveries_total"] == 1
+
+
+def test_replay_truncation_is_surfaced(model):
+    """A request admitted within a block of capacity can lose budget on
+    replay (prompt + delivered tokens pad to an extra block, eating the
+    headroom): the reply must carry "truncated": true rather than pose
+    as the full fault-free completion."""
+    params, config = model
+    inj = FaultInjector("step@2:error")
+    # 48-token prompt + max_new 16 fills max_len 64 exactly at block 16;
+    # any delivered token pushes the replay prompt into a 5th block.
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64,
+                           block_size=16, fault_injector=inj)
+    prompt = np.random.RandomState(9).randint(1, 128, size=48).tolist()
+    with LLMServer(cb) as srv:
+        _, body = _post(
+            srv.address, {"prompt": prompt, "max_new_tokens": 16}
+        )
+        assert body["truncated"] is True
+        assert 0 < len(body["tokens"]) < 16
+        assert srv.recoveries_total == 1
+    # The common case stays truncation-free (pinned by the identity
+    # assertions in the tests above — no "truncated" key at all).
+
+
+def test_run_cli_inject_faults_requires_http(tmp_path, monkeypatch):
+    """--inject-faults without --http must refuse loudly (the non-HTTP
+    modes have no recovery; a silent no-op would fake a passing drill)."""
+    import sys
+
+    import jax_llama_tpu.run as run_cli
+
+    monkeypatch.setattr(
+        sys, "argv",
+        ["run", "--ckpt-dir", str(tmp_path), "--byte-tokenizer",
+         "--inject-faults", "step@0:error"],
+    )
+    with pytest.raises(SystemExit, match="inject-faults"):
+        run_cli.main()
+
+    # The env-var spelling must refuse too — a JLT_FAULTS drill the mode
+    # cannot honor running fault-free would fake a passing drill.
+    monkeypatch.setattr(
+        sys, "argv",
+        ["run", "--ckpt-dir", str(tmp_path), "--byte-tokenizer"],
+    )
+    monkeypatch.setenv("JLT_FAULTS", "step@0:error")
+    with pytest.raises(SystemExit, match="JLT_FAULTS"):
+        run_cli.main()

@@ -45,6 +45,7 @@ def test_flash_matches_sdpa_causal():
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_flash_triangular_diagonal_body():
     """The ragged diagonal body (r5): active when block_q/_KSUB is
     sublane-aligned — (32, 64) tiles here — on every causal crossing
@@ -185,6 +186,7 @@ def test_flash_quantized_matches_dequantized_reference():
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_model_forward_flash_matches_xla():
     import jax
 
@@ -204,6 +206,7 @@ def test_model_forward_flash_matches_xla():
     )
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_model_decode_with_cache_flash_matches_xla():
     import jax
     from jax_llama_tpu.engine import GenerationConfig, generate
@@ -228,6 +231,7 @@ def test_model_decode_with_cache_flash_matches_xla():
     np.testing.assert_array_equal(np.asarray(out_ref), np.asarray(out_flash))
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_flash_gradients_match_xla():
     import jax
 
@@ -291,6 +295,7 @@ def test_flash_backward_matches_dense_gqa_and_padding():
     np.testing.assert_allclose(np.asarray(fdv), np.asarray(ddv), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_flash_backward_matches_dense_8k():
     """Long-context gradient parity at the production block sizes
     (VERDICT r1 item 4).  Small head count keeps the dense oracle's S^2
@@ -306,6 +311,7 @@ def test_flash_backward_matches_dense_8k():
         assert np.abs(f - dref).max() / denom < 1e-4, name
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_flash_backward_fdiff_16k():
     """At 16k a dense oracle no longer fits; check the analytic gradient
     against a central finite difference along a random direction."""
@@ -404,6 +410,7 @@ def _dense_weights(q, k, q_pos, kv_pos):
     return np.asarray(jax.nn.softmax(s, axis=-1)), np.asarray(allowed)
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_flash_dropout_mask_is_inverted_bernoulli():
     import jax
 
@@ -464,6 +471,7 @@ def test_flash_dropout_rate0_and_seed_requirements():
         )
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_flash_dropout_backward_matches_dense_with_extracted_mask():
     """Gradient parity for q/k/v against a dense attention whose dropout
     matrix is the mask EXTRACTED from the kernel forward: proves all three

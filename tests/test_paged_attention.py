@@ -9,6 +9,7 @@ inactive rows, and the model-level ``paged_forward`` step.
 
 import numpy as np
 import jax
+import pytest
 import jax.numpy as jnp
 
 from jax_llama_tpu import get_config, init_params
@@ -200,6 +201,7 @@ def test_paged_kernel_multi_token_first_token_empty_pool():
         np.testing.assert_allclose(got[b], want, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_paged_forward_multi_token_matches_gathered_view():
     """paged_forward at T=3 (the verify shape) vs the gathered-view
     forward: same logits for active rows, same pool afterwards."""
@@ -390,6 +392,7 @@ def test_paged_kernel_all_dead_block_contributes_nothing():
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_paged_forward_int8_matches_gathered_int8():
     """int8 pool through the kernel (in-kernel scale folding) must match
     the gathered-view int8 path: same logits at quantization-noise level,
@@ -468,6 +471,7 @@ def test_paged_forward_int8_matches_gathered_int8():
     )
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_int8_batcher_kernel_path_runs_end_to_end():
     """End-to-end int8 continuous batching through the paged kernel: full
     deterministic generations on an int8 pool.
@@ -507,6 +511,7 @@ def test_int8_batcher_kernel_path_runs_end_to_end():
     assert run(kv_cache_dtype="int8") == got
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_batcher_on_tensor_data_mesh_matches_unsharded():
     """Continuous batching on a data x tensor mesh runs the paged kernel
     per-shard via shard_map (KV heads over tensor, rows over data) and
@@ -536,6 +541,7 @@ def test_batcher_on_tensor_data_mesh_matches_unsharded():
     assert got == want
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_use_pallas_kernel_toggle_token_identical():
     """The explicit gathered-view toggle (bench's A/B knob) must not
     change tokens: kernel and gathered paths at IDENTICAL block size and

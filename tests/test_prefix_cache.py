@@ -259,3 +259,76 @@ def test_repeat_same_prompt_exact_with_spec(model):
     assert outs[0] == outs[1]
     # Determinism across repeats too (greedy).
     assert outs[0][0] == outs[0][1]
+
+
+def test_duplicate_chain_overwrite_leaves_no_unreachable_blocks(model):
+    """Two identical prompts in ONE cold admission burst both prefill
+    fully and both register the same chain keys; the second registration
+    supersedes the first.  The superseded blocks must not linger keyed
+    (unreachable for hits yet occupying capacity): everything retained
+    in ``_reusable`` must be the current index target for its key, and
+    free + retained must account for the whole pool."""
+    params, config = model
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(1, 128, size=40).tolist()  # 2 full keyed blocks
+
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=128,
+                           block_size=16, prefix_cache=True)
+    for _ in range(2):  # repeat the burst: cold, then hitting
+        r1 = cb.submit(list(prompt), max_new_tokens=4)
+        r2 = cb.submit(list(prompt), max_new_tokens=4)
+        res = cb.run_to_completion()
+        assert set(res) >= {r1, r2}
+        assert res[r1] == res[r2]
+        # No unreachable retained blocks, no dangling refcounts, exact
+        # capacity accounting.
+        assert set(cb._reusable) <= set(cb._prefix_index.values())
+        assert len(cb.free_blocks) + len(cb._reusable) == cb.n_blocks
+        assert not cb._block_refs
+
+    # Directly exercise the idle-superseded branch: re-keying a chain
+    # whose old block sits refcount-0 in ``_reusable`` frees it outright.
+    key = next(iter(cb._prefix_index))
+    old_blk = cb._prefix_index[key]
+    assert old_blk in cb._reusable
+    new_blk = cb.free_blocks[0]
+    cb._register_chain([new_blk], [key])
+    assert old_blk not in cb._reusable
+    assert old_blk in cb.free_blocks
+    assert cb._prefix_index[key] == new_blk
+
+
+def test_suffix_admission_buckets_jit_executables(model):
+    """Grouped suffix admission buckets the padded suffix length to a
+    power of two of blocks (like admission row counts), so diverse /chat
+    suffix lengths compile a BOUNDED set of _paged_suffix_insert
+    executables: four hits whose block-rounded suffixes span {32, 48,
+    48, 64} tokens share the {32, 64} buckets — 2 compiles, not 3 — and
+    outputs stay identical to a cold batcher."""
+    from jax_llama_tpu.serving import _paged_suffix_insert
+
+    params, config = model
+    rng = np.random.RandomState(12)
+    base = rng.randint(1, 128, size=32).tolist()   # the shared 2 blocks
+    prime = base + rng.randint(1, 128, size=16).tolist()
+    extras = [rng.randint(1, 128, size=n).tolist()
+              for n in (17, 33, 45, 60)]
+
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                           block_size=16, prefix_cache=True)
+    rid = cb.submit(list(prime), max_new_tokens=2)
+    cb.run_to_completion()
+    before = _paged_suffix_insert._cache_size()
+    got = []
+    for extra in extras:
+        rid = cb.submit(base + extra, max_new_tokens=4)
+        got.append(cb.run_to_completion()[rid])
+    assert cb.stats()["prefix_requests_hit_total"] == 4
+    compiled = _paged_suffix_insert._cache_size() - before
+    assert compiled == 2, compiled  # buckets {32, 64}, not {32, 48, 64}
+
+    cold = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                             block_size=16, prefix_cache=False)
+    for extra, want in zip(extras, got):
+        rid = cold.submit(base + extra, max_new_tokens=4)
+        assert cold.run_to_completion()[rid] == want

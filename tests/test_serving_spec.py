@@ -34,6 +34,7 @@ def _plain(params, config, prompts, max_new, stop=()):
     return rids, cb.run_to_completion()
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_spec_batcher_matches_plain_greedy(models):
     params, config, draft_params, draft_config = models
     rng = np.random.RandomState(0)
@@ -53,6 +54,7 @@ def test_spec_batcher_matches_plain_greedy(models):
     assert 0.0 <= cb.acceptance_rate() <= 1.0
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_spec_batcher_self_draft_accepts_everything(models):
     """With the target as its own draft, greedy proposals always match —
     acceptance must be 100% and each request finishes in ~max_new/(G+1)
@@ -91,6 +93,7 @@ def test_spec_batcher_stop_tokens(models):
     assert sorted(cb.free_blocks) == list(range(cb.n_blocks))
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_spec_batcher_sampled_matches_standalone(models):
     """Sampled speculative serving: a sampled slot must emit BIT-identical
     tokens to a standalone seeded ``generate_speculative`` of the same
@@ -139,6 +142,7 @@ def test_spec_batcher_sampled_matches_standalone(models):
     assert results[r0] == want
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_spec_batcher_logprobs_match_engine_score(models):
     """logprobs=True composes with speculative decoding: every emitted
     token's logprob equals ``engine.score``'s teacher-forced
@@ -187,6 +191,7 @@ def test_spec_batcher_logprobs_match_engine_score(models):
         np.testing.assert_allclose(lps[rid], want, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_spec_batcher_sampled_only_batch(models):
     """Two sampled slots with different seeds/policies, no greedy rows:
     each must reproduce its standalone seeded run."""
