@@ -1,0 +1,28 @@
+# Test / drill entry points.  All CPU targets force JAX_PLATFORMS=cpu
+# (tests/conftest.py pins it anyway; the env var keeps jax's platform
+# probe from touching an attached accelerator during collection).
+
+PYTEST := env JAX_PLATFORMS=cpu python -m pytest
+
+.PHONY: tier1 faults chaos tpu
+
+# The gating suite: everything not marked slow, under the 870 s budget.
+tier1:
+	$(PYTEST) tests/ -q -m 'not slow' --continue-on-collection-errors
+
+# Just the fault-injection / crash-recovery / degradation tests.
+faults:
+	$(PYTEST) tests/ -q -m faults
+
+# Chaos smoke drill: the full fault matrix — every injection site
+# (step / insert / suffix_insert / alloc and the kernel sites
+# flash_kernel / paged_kernel / spec_decode, driven through
+# `run.py --inject-faults`), kernel quarantine + XLA-fallback identity,
+# non-finite-guard, and drain-on-signal.  Includes the slow drills that
+# tier-1 excludes for time.
+chaos:
+	$(PYTEST) tests/ -q -m 'chaos or faults'
+
+# On-chip kernel regressions (run on a TPU host; self-skip elsewhere).
+tpu:
+	python -m pytest tests/ -q -m tpu

@@ -9,17 +9,31 @@ and when a mesh is given each host reads only the shards it owns
 never materializes the full model on one host.
 
 Layout on disk:
-    <dir>/params/...   Orbax tree of arrays
-    <dir>/config.json  LLaMAConfig fields
+    <dir>/params/...     Orbax tree of arrays
+    <dir>/config.json    LLaMAConfig fields
+    <dir>/manifest.json  per-file sha256 + size, verified on restore
+
+Saves are ATOMIC: the checkpoint is assembled in a temp sibling
+directory and renamed into place, so a crash mid-save never leaves a
+half-written tree at the target path (a pre-existing checkpoint is
+swapped aside and removed only after the new tree has landed).  The
+manifest is written over the finished tree at save time; restore
+verifies every listed file's size and sha256 first, so a truncated or
+bit-flipped shard fails loudly before serving starts instead of
+surfacing as silent garbage logits.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import logging
+import os
+import shutil
+import tempfile
 from pathlib import Path
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,22 +45,156 @@ from ..models.llama import init_params
 from ..ops.quant import is_quantized, quantize_params
 from ..parallel.partition import param_partition_specs
 
+MANIFEST_NAME = "manifest.json"
+
+
+def _sha256_file(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_manifest(root: Path) -> None:
+    """Record every file under ``root`` (sha256 + byte size), manifest
+    excluded, keyed by POSIX-relative path."""
+    files: Dict[str, Dict[str, Any]] = {}
+    for p in sorted(root.rglob("*")):
+        if p.is_file() and p.name != MANIFEST_NAME:
+            files[p.relative_to(root).as_posix()] = {
+                "sha256": _sha256_file(p),
+                "bytes": p.stat().st_size,
+            }
+    with open(root / MANIFEST_NAME, "w") as f:
+        json.dump({"version": 1, "files": files}, f, indent=2)
+
+
+def verify_manifest(path: str) -> bool:
+    """Verify every manifest-listed file's existence, size, and sha256.
+
+    Returns False (nothing to verify) for pre-manifest checkpoints;
+    raises ValueError naming every bad shard otherwise.  Size is checked
+    before hashing so plain truncation is reported as truncation, not as
+    a hash mismatch.
+    """
+    root = Path(path).absolute()
+    mf = root / MANIFEST_NAME
+    if not mf.exists():
+        return False
+    with open(mf) as f:
+        manifest = json.load(f)
+    errors = []
+    for rel, want in manifest.get("files", {}).items():
+        p = root / rel
+        if not p.is_file():
+            errors.append(f"{rel}: missing")
+            continue
+        size = p.stat().st_size
+        if size != want["bytes"]:
+            errors.append(
+                f"{rel}: truncated/resized ({size} bytes, "
+                f"recorded {want['bytes']})"
+            )
+            continue
+        if _sha256_file(p) != want["sha256"]:
+            errors.append(f"{rel}: sha256 mismatch (corrupted shard)")
+    if errors:
+        raise ValueError(
+            f"checkpoint {root} failed integrity verification — "
+            "refusing to restore corrupt weights: " + "; ".join(errors)
+        )
+    return True
+
+
+def _promote(tmp: Path, path: Path) -> None:
+    """Rename the finished tree into place — atomic when ``path`` does
+    not exist; otherwise the old checkpoint is swapped aside first and
+    removed only after the new tree has landed, so no crash point
+    leaves ``path`` holding a partial tree (worst case: ``path``
+    briefly absent with the old tree intact in a ``.trash`` sibling)."""
+    if path.exists():
+        trash = path.parent / f".{path.name}.trash-{os.getpid()}"
+        if trash.exists():
+            shutil.rmtree(trash)
+        os.rename(path, trash)
+        os.rename(tmp, path)
+        shutil.rmtree(trash)
+    else:
+        os.rename(tmp, path)
+
+
+def _atomic_save(path: Path, write: Callable[[Path], None]) -> None:
+    """Assemble a checkpoint via ``write(tmp_dir)`` then promote it
+    into ``path`` (see ``_promote``).
+
+    Multi-process programs (jax.process_count() > 1, shared storage —
+    the only topology Orbax multi-host saves support) must all hand
+    Orbax the SAME directory, so the temp dir name is deterministic
+    there; process 0 clears any stale one, every process syncs before
+    writing and after Orbax finishes, and only process 0 hashes the
+    manifest and performs the rename.  Single-process saves use a
+    random temp dir (no collision with a concurrent saver) and clean it
+    up on failure."""
+    multi = jax.process_count() > 1
+    path.parent.mkdir(parents=True, exist_ok=True)
+    if multi:
+        from jax.experimental import multihost_utils
+
+        tmp = path.parent / f".{path.name}.tmp-save"
+        if jax.process_index() == 0 and tmp.exists():
+            shutil.rmtree(tmp)
+        multihost_utils.sync_global_devices(f"ckpt-clear:{path.name}")
+        tmp.mkdir(exist_ok=True)
+    else:
+        tmp = Path(tempfile.mkdtemp(
+            prefix=f".{path.name}.tmp-", dir=path.parent
+        ))
+        # mkdtemp creates 0700 (private), and _promote's rename would
+        # keep that — restore umask-default perms so a checkpoint saved
+        # by one user stays restorable by another on shared storage
+        # (matching the old path.mkdir behavior).
+        um = os.umask(0)
+        os.umask(um)
+        os.chmod(tmp, 0o777 & ~um)
+    try:
+        write(tmp)
+        if multi:
+            multihost_utils.sync_global_devices(
+                f"ckpt-written:{path.name}"
+            )
+        if jax.process_index() == 0:
+            _write_manifest(tmp)
+            _promote(tmp, path)
+        if multi:
+            multihost_utils.sync_global_devices(
+                f"ckpt-promoted:{path.name}"
+            )
+    except BaseException:
+        if not multi:
+            shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
 
 def save_checkpoint(path: str, params: Any, config: LLaMAConfig) -> None:
-    """Write params + config to `path` (created if needed).
+    """Write params + config to `path` — atomically, with an integrity
+    manifest (module docstring).
 
     Quantized trees (``quantize_params`` output) round-trip: a marker in
     config.json tells ``load_checkpoint`` to build the matching abstract
     tree on restore.
     """
-    path = Path(path).absolute()
-    path.mkdir(parents=True, exist_ok=True)
+    final = Path(path).absolute()
     meta = dict(dataclasses.asdict(config), _quantized=is_quantized(params))
-    with open(path / "config.json", "w") as f:
-        json.dump(meta, f, indent=2)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path / "params", params, force=True)
-    ckptr.wait_until_finished()
+
+    def write(tmp: Path) -> None:
+        with open(tmp / "config.json", "w") as f:
+            json.dump(meta, f, indent=2)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(tmp / "params", params, force=True)
+        ckptr.wait_until_finished()
+
+    _atomic_save(final, write)
 
 
 def load_config(path: str) -> Tuple[LLaMAConfig, bool]:
@@ -67,6 +215,7 @@ def load_checkpoint(
     mesh: Optional[Mesh] = None,
     *,
     fsdp: bool = False,
+    verify: bool = True,
 ) -> Tuple[Any, LLaMAConfig]:
     """Restore (params, config).
 
@@ -74,8 +223,16 @@ def load_checkpoint(
     per-host partial reads, no full-model host copy (this replaces the
     reference's convert-into-RAM-then-device_put startup, jax_example.py:
     21-26).  Without: plain host restore.
+
+    ``verify`` (default True) checks the integrity manifest first — a
+    truncated/corrupted shard raises before serving starts.  It re-reads
+    every checkpoint byte to hash it; pass ``verify=False`` when restore
+    I/O dominates startup and the storage layer already guarantees
+    integrity.  Pre-manifest checkpoints skip the check silently.
     """
     path = Path(path).absolute()
+    if verify:
+        verify_manifest(path)
     config, quantized, is_train = _load_meta(path)
     if is_train:
         raise ValueError(
@@ -117,7 +274,12 @@ def _saved_layout(ckptr, item_path: Path, config: LLaMAConfig) -> str:
     axis leading), or "current".  Unreadable metadata counts as current.
     """
     try:
-        tree = ckptr.metadata(item_path).item_metadata.tree
+        md = ckptr.metadata(item_path)
+        # Orbax version skew: .metadata() has returned an object with
+        # .item_metadata.tree, an object with .tree, and (current image)
+        # the raw tree dict itself.  Accept all three shapes.
+        tree = getattr(md, "item_metadata", md)
+        tree = getattr(tree, "tree", tree)
         layers = tree.get("layers", {})
         if "q" in layers and "qkv" not in layers:
             return "separate"
@@ -227,15 +389,21 @@ def save_train_state(path: str, state: Any, config: LLaMAConfig) -> None:
     The reference cannot resume anything (SURVEY.md §5: checkpointing is
     load-only and its convert CLI is broken); this is the training half of
     the checkpoint story: crash-safe resume with optimizer moments intact.
+    Atomic + manifest-verified like ``save_checkpoint`` — a periodic
+    save that crashes mid-write must never destroy the previous good
+    resume point.
     """
-    path = Path(path).absolute()
-    path.mkdir(parents=True, exist_ok=True)
-    with open(path / "config.json", "w") as f:
-        json.dump(dict(dataclasses.asdict(config), _train_state=True), f,
-                  indent=2)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path / "state", state, force=True)
-    ckptr.wait_until_finished()
+    final = Path(path).absolute()
+    meta = dict(dataclasses.asdict(config), _train_state=True)
+
+    def write(tmp: Path) -> None:
+        with open(tmp / "config.json", "w") as f:
+            json.dump(meta, f, indent=2)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(tmp / "state", state, force=True)
+        ckptr.wait_until_finished()
+
+    _atomic_save(final, write)
 
 
 def _suffix_sharding_tree(abstract: Any, abstract_params: Any, mesh: Mesh) -> Any:
@@ -284,16 +452,20 @@ def load_train_state(
     mesh: Optional[Mesh] = None,
     *,
     fsdp: bool = False,
+    verify: bool = True,
 ) -> Tuple[Any, LLaMAConfig]:
     """Restore (TrainState, config) for training resume.
 
     With ``mesh``: params and the param-shaped optimizer moments restore
     straight into their NamedShardings (per-host partial reads); scalar
-    state (step, Adam count) is replicated.
+    state (step, Adam count) is replicated.  ``verify`` as in
+    ``load_checkpoint``.
     """
     from ..train import init_train_state
 
     path = Path(path).absolute()
+    if verify:
+        verify_manifest(path)
     config, _, is_train = _load_meta(path)
     if not is_train:
         raise ValueError(

@@ -110,6 +110,19 @@ def prompt_positions(prompt_mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(prompt_mask, pos, -1)
 
 
+def finite_rows(logits: jnp.ndarray) -> jnp.ndarray:
+    """Per-row non-finite guard: [..., V] logits -> [...] bool, True only
+    where EVERY logit is finite.  A NaN/Inf here means the forward itself
+    produced garbage (bad weights, a silently-corrupting kernel, an HBM
+    bit flip) — sampling from it streams nonsense tokens, and the
+    logprob of any sample is undefined.  The serving step programs
+    return this flag so the batcher can fail just the poisoned request
+    with a clean error instead of emitting from a corrupt distribution
+    (raw logits from a healthy model are always finite; -inf only ever
+    appears post-warp, which this guard runs before)."""
+    return jnp.all(jnp.isfinite(logits.astype(jnp.float32)), axis=-1)
+
+
 def _is_stop(tokens: jnp.ndarray, stop_tokens: Tuple[int, ...]) -> jnp.ndarray:
     if not stop_tokens:
         return jnp.zeros(tokens.shape, dtype=bool)

@@ -17,6 +17,23 @@ Sites (fired by ``ContinuousBatcher`` just before the real operation):
   ``insert``         a batched full-prompt prefill (``_paged_insert``)
   ``suffix_insert``  a prefix-cache-hit suffix prefill
   ``alloc``          a block-pool allocation (``_alloc_blocks``)
+  ``flash_kernel``   a dispatch whose prefill runs the Pallas flash
+                     kernel (fired by the batcher per dispatch, AND by
+                     ``ops.flash_attention`` at trace time when a hook
+                     is installed — the batcher fire precedes the trace
+                     fire, and cached executables re-fire only the
+                     batcher-side site)
+  ``paged_kernel``   a decode step on the Pallas paged-attention kernel
+                     path (same batcher-then-trace fire order)
+  ``spec_decode``    a speculative draft+verify round (also fired by
+                     ``spec_decode.generate_speculative`` at trace time
+                     when a hook is installed)
+
+The three kernel/spec sites carry their site name on the raised
+exception (``InjectedFault.site``), which is what lets the server's
+degradation layer (``degrade.py``) attribute the failure to a feature
+and quarantine it onto its fallback path instead of burning the crash-
+recovery budget.
 
 Spec grammar (comma-separated, used by the CLI flag and ``JLT_FAULTS``)::
 
@@ -24,8 +41,11 @@ Spec grammar (comma-separated, used by the CLI flag and ``JLT_FAULTS``)::
     site~P:kind[=value]     fire each call with probability P (seeded)
 
 kinds: ``error`` (raise :class:`InjectedFault`, a device-style runtime
-error), ``oom`` (raise :class:`InjectedOOM`, an allocation failure), and
-``delay=SECONDS`` (sleep, then proceed — the watchdog's test lever).
+error), ``oom`` (raise :class:`InjectedOOM`, an allocation failure),
+``delay=SECONDS`` (sleep, then proceed — the watchdog's test lever), and
+``nan`` (arm a non-finite poison: the next guarded dispatch reports its
+first active row's logits as non-finite — the test lever for the
+serving layer's non-finite guard; no exception is raised).
 
 Examples::
 
@@ -33,6 +53,8 @@ Examples::
     insert@0:error,alloc@3:oom   first prefill + 4th allocation
     step~0.01:error              1% of steps, deterministic per seed
     step@2:delay=1.5             stall one step by 1.5 s
+    paged_kernel@0:error         kill the first kernel-path decode step
+    step@3:nan                   poison one row's logits on step 3
 """
 
 from __future__ import annotations
@@ -42,12 +64,23 @@ import random
 import time
 from typing import Dict, List, Optional, Sequence, Union
 
-SITES = ("step", "insert", "suffix_insert", "alloc")
-KINDS = ("error", "oom", "delay")
+SITES = (
+    "step", "insert", "suffix_insert", "alloc",
+    "flash_kernel", "paged_kernel", "spec_decode",
+)
+KINDS = ("error", "oom", "delay", "nan")
 
 
 class InjectedFault(RuntimeError):
-    """A deliberately injected device-style failure (INTERNAL)."""
+    """A deliberately injected device-style failure (INTERNAL).
+
+    ``site`` names the injection site that raised — the degradation
+    layer's attribution key (real device errors carry no site and are
+    attributed from the batcher's last-dispatch record instead)."""
+
+    def __init__(self, message: str, site: Optional[str] = None):
+        super().__init__(message)
+        self.site = site
 
 
 class InjectedOOM(InjectedFault):
@@ -123,6 +156,36 @@ class FaultSpec:
         return specs
 
 
+# ---------------------------------------------------------------------------
+# Trace-time hook registry
+#
+# The kernel/spec modules (ops.flash_attention, ops.paged_attention,
+# spec_decode) call ``fire_trace(<site>)`` at their entry points' TRACE
+# time — the moment a Mosaic compile failure would surface on real
+# hardware.  One registry arms or clears every site at once
+# (run.py --inject-faults installs ``injector.fire`` here and clears it
+# on exit); cached executables do not re-trace, so per-dispatch
+# injection is the batcher-side site of the same name.  faults.py
+# imports nothing from the package, so the kernel modules can import
+# this without cycles.
+# ---------------------------------------------------------------------------
+
+_trace_hook = None
+
+
+def install_trace_hook(hook) -> None:
+    """Install (or clear, with None) the trace-time fault hook — called
+    as ``hook(site)`` from the kernel/spec module entry points."""
+    global _trace_hook
+    _trace_hook = hook
+
+
+def fire_trace(site: str) -> None:
+    """Hook point for the kernel/spec modules (no-op when unarmed)."""
+    if _trace_hook is not None:
+        _trace_hook(site)
+
+
 class FaultInjector:
     """Seeded, counting fault injector shared by a batcher's sites.
 
@@ -148,6 +211,8 @@ class FaultInjector:
         self.injected: Dict[str, int] = {s: 0 for s in SITES}
         self.injected_total = 0
         self.delays_total = 0
+        self.nans_armed_total = 0
+        self._nan_armed = False
 
     def fire(self, site: str) -> None:
         """Hook point: called by the batcher just before the real op."""
@@ -166,22 +231,38 @@ class FaultInjector:
                 self.delays_total += 1
                 time.sleep(spec.delay_s)
                 continue
+            if spec.kind == "nan":
+                # Arm a non-finite poison instead of raising: the next
+                # guarded dispatch (ContinuousBatcher consumes via
+                # ``take_nan``) reports its first active row's logits as
+                # non-finite — exercising the serving non-finite guard
+                # end-to-end without needing the model to emit NaN.
+                self.nans_armed_total += 1
+                self._nan_armed = True
+                continue
             self.injected[site] = self.injected.get(site, 0) + 1
             self.injected_total += 1
             if spec.kind == "oom":
                 raise InjectedOOM(
                     f"RESOURCE_EXHAUSTED: injected allocation failure "
-                    f"({site} call #{n})"
+                    f"({site} call #{n})", site=site,
                 )
             raise InjectedFault(
-                f"INTERNAL: injected device error ({site} call #{n})"
+                f"INTERNAL: injected device error ({site} call #{n})",
+                site=site,
             )
+
+    def take_nan(self) -> bool:
+        """Consume an armed ``nan`` poison (one dispatch at most)."""
+        armed, self._nan_armed = self._nan_armed, False
+        return armed
 
     def stats(self) -> Dict[str, float]:
         """Counters for the HTTP /metrics endpoint."""
         out: Dict[str, float] = {
             "faults_injected_total": self.injected_total,
             "fault_delays_total": self.delays_total,
+            "fault_nans_armed_total": self.nans_armed_total,
         }
         for site in SITES:
             out[f"faults_injected_{site}_total"] = self.injected.get(

@@ -53,6 +53,14 @@ _SUBLANES = 8  # TPU sublane width (fp32/int32)
 # tiles not divisible by this fall back to a single sub-tile.
 _KSUB = 4
 
+def _maybe_fault() -> None:
+    """Chaos-drill hook: fires faults.py's trace-time registry (site
+    "flash_kernel") at the kernel entry points' trace time — where a
+    Mosaic compile failure would surface on real hardware."""
+    from ..faults import fire_trace
+
+    fire_trace("flash_kernel")
+
 
 def _mix32(x):
     """splitmix32 finalizer: a bijective avalanche mix on uint32.
@@ -557,6 +565,7 @@ def flash_attention(
     Returns:
       [B, T, H, d] in q.dtype.
     """
+    _maybe_fault()
     H, KVH = q.shape[2], k.shape[2]
     assert H % KVH == 0, (H, KVH)
     group = H // KVH
@@ -634,6 +643,7 @@ def flash_attention_quantized(
       k_scale, v_scale: [B, S, KVH] fp32 per-slot-per-head scales.
       q_pos, kv_pos, block_q, block_k: as in ``flash_attention``.
     """
+    _maybe_fault()
     H, KVH = q.shape[2], k.shape[2]
     assert H % KVH == 0, (H, KVH)
     group = H // KVH

@@ -48,6 +48,13 @@ from .flash_attention import (
     _resolve_interpret,
 )
 
+def _maybe_fault() -> None:
+    """Chaos-drill hook: fires faults.py's trace-time registry (site
+    "paged_kernel") — the paged twin of ops.flash_attention's hook."""
+    from ..faults import fire_trace
+
+    fire_trace("paged_kernel")
+
 
 def _paged_kernel(
     tbl_ref,    # [B * MB] int32 scalar-prefetch: physical block id (NB = dead)
@@ -240,6 +247,7 @@ def paged_pool_attention(
     new-token merge (fp32 end-to-end through the merge — see the
     out_shape note in the kernel call).
     """
+    _maybe_fault()
     if k_pool.ndim == 4:
         k_pool, v_pool = k_pool[None], v_pool[None]
         if k_scale is not None:

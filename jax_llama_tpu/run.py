@@ -76,10 +76,11 @@ def main() -> None:
                     help="deterministic fault injection for chaos runs "
                          "(--http only): comma-separated "
                          "site[@N|~P]:kind[=v] rules — sites step, "
-                         "insert, suffix_insert, alloc; kinds error, "
-                         "oom, delay=SECONDS; e.g. 'step@5:error' or "
-                         "'step~0.01:error'.  Also read from the "
-                         "JLT_FAULTS env var")
+                         "insert, suffix_insert, alloc, flash_kernel, "
+                         "paged_kernel, spec_decode; kinds error, "
+                         "oom, delay=SECONDS, nan; e.g. 'step@5:error' "
+                         "or 'paged_kernel~0.01:error'.  Also read from "
+                         "the JLT_FAULTS env var")
     ap.add_argument("--fault-seed", type=int, default=0,
                     help="seed for probabilistic (site~P) fault rules")
     ap.add_argument("--max-recoveries", type=int, default=3,
@@ -91,6 +92,21 @@ def main() -> None:
                     help="flip /healthz degraded when the serving loop "
                          "heartbeat stalls past this many seconds "
                          "(0 disables the watchdog thread)")
+    ap.add_argument("--quarantine-threshold", type=int, default=3,
+                    help="failures attributable to one feature (flash/"
+                         "paged kernel, speculative decode, prefix "
+                         "cache) inside --quarantine-window-s before it "
+                         "is quarantined onto its XLA/plain fallback "
+                         "(the server stays up, degraded)")
+    ap.add_argument("--quarantine-window-s", type=float, default=60.0)
+    ap.add_argument("--quarantine-cooldown-s", type=float, default=30.0,
+                    help="how long a quarantined feature stays on its "
+                         "fallback before one probe re-trial")
+    ap.add_argument("--drain-timeout-s", type=float, default=30.0,
+                    help="SIGTERM/SIGINT drain budget: in-flight "
+                         "requests run to completion (new POSTs get "
+                         "503 + Retry-After); stragglers past this "
+                         "many seconds are failed with 503")
     args = ap.parse_args()
     if args.logprobs and args.http is None:
         raise SystemExit(
@@ -215,11 +231,16 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
     )
     injector = None
     if fault_spec:
-        from .faults import FaultInjector
+        from .faults import FaultInjector, install_trace_hook
 
         injector = FaultInjector(
             fault_spec, seed=getattr(args, "fault_seed", 0)
         )
+        # Arm the kernel/spec modules' trace-time hooks too (one
+        # registry covers flash_kernel / paged_kernel / spec_decode),
+        # so a drill can also exercise the first-compile (Mosaic-style)
+        # failure mode — the batcher fires the same sites per dispatch.
+        install_trace_hook(injector.fire)
         print(f"fault injection armed: {fault_spec}", flush=True)
     cb = ContinuousBatcher(
         params, config, n_slots=args.slots,
@@ -238,26 +259,81 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
 
         chat_format = ChatFormat(tokenizer)
     watchdog_s = getattr(args, "watchdog_s", 60.0)
-    with LLMServer(
-        cb, tokenizer=tokenizer, host=args.host, port=args.http,
-        chat_format=chat_format,
-        max_recoveries=getattr(args, "max_recoveries", 3),
-        recovery_window_s=getattr(args, "recovery_window_s", 60.0),
-        watchdog_deadline_s=watchdog_s if watchdog_s > 0 else None,
-    ) as srv:
-        endpoints = "POST /generate" + (
-            ", /chat" if chat_format is not None else ""
-        )
-        print(f"serving on {srv.address} "
-              f"({endpoints}, GET /metrics, /healthz)", flush=True)
-        if _test_hook is not None:
-            _test_hook(srv)
-            return
-        try:
-            while True:
-                time.sleep(3600)
-        except KeyboardInterrupt:
-            print("\nshutting down", flush=True)
+    drain_timeout_s = getattr(args, "drain_timeout_s", 30.0)
+    try:
+        with LLMServer(
+            cb, tokenizer=tokenizer, host=args.host, port=args.http,
+            chat_format=chat_format,
+            max_recoveries=getattr(args, "max_recoveries", 3),
+            recovery_window_s=getattr(args, "recovery_window_s", 60.0),
+            watchdog_deadline_s=watchdog_s if watchdog_s > 0 else None,
+            quarantine_threshold=getattr(args, "quarantine_threshold", 3),
+            quarantine_window_s=getattr(args, "quarantine_window_s", 60.0),
+            quarantine_cooldown_s=getattr(
+                args, "quarantine_cooldown_s", 30.0
+            ),
+            drain_timeout_s=drain_timeout_s,
+        ) as srv:
+            endpoints = "POST /generate" + (
+                ", /chat" if chat_format is not None else ""
+            )
+            print(f"serving on {srv.address} "
+                  f"({endpoints}, GET /metrics, /healthz)", flush=True)
+            if _test_hook is not None:
+                _test_hook(srv)
+                return
+            # Drain-on-signal: SIGTERM (orchestrator shutdown) and the
+            # first Ctrl-C flip the server into drain mode — in-flight
+            # requests finish, new POSTs 503 with Retry-After, bounded
+            # by --drain-timeout-s.  The handler only flips a plain
+            # flag (a dict-slot store is async-signal-safe; calling
+            # Event.set()/begin_drain() from the handler could deadlock
+            # on the Event's non-reentrant lock if the signal lands
+            # inside the main thread's own wait) and restores the
+            # default SIGINT disposition so a SECOND Ctrl-C hard-stops;
+            # the polling loop below does the actual drain.
+            import signal
+
+            state = {"signaled": False}
+
+            def _on_signal(signum, frame):
+                state["signaled"] = True
+                signal.signal(signal.SIGINT, signal.default_int_handler)
+
+            previous = []
+            try:
+                for sig in (signal.SIGTERM, signal.SIGINT):
+                    previous.append((sig, signal.signal(sig, _on_signal)))
+            except ValueError:
+                previous = []  # not the main thread; no signal wiring
+            try:
+                while not state["signaled"]:
+                    time.sleep(0.2)
+                srv.begin_drain()
+                print(
+                    f"\nsignal received: draining (in-flight requests "
+                    f"finish, new requests 503; timeout "
+                    f"{drain_timeout_s:.0f}s)", flush=True,
+                )
+                if srv.wait_drained(drain_timeout_s + 10):
+                    print("drained; shutting down", flush=True)
+                else:
+                    print("drain timed out; shutting down", flush=True)
+            except KeyboardInterrupt:
+                srv.begin_drain(timeout_s=0.0)
+                print("\nsecond interrupt: hard shutdown", flush=True)
+            finally:
+                for sig, old in previous:
+                    try:
+                        signal.signal(sig, old)
+                    except (ValueError, TypeError):
+                        pass
+    finally:
+        if injector is not None:
+            # The trace-time hook is a module global: clear it so an
+            # embedding process (or the test suite) does not keep firing
+            # a dead drill's injector on later traces.
+            install_trace_hook(None)
 
 
 def _serve(params, config, tokenizer, mesh, args) -> None:

@@ -18,6 +18,52 @@ parity.  Design constraints, in order:
   * **Observability.**  ``GET /metrics`` exposes the batcher counters
     (tokens, steps, slot/block occupancy, speculative acceptance) in
     Prometheus text format; ``GET /healthz`` for liveness.
+  * **Degrade before dying.**  Every accelerated feature has a slower
+    always-correct fallback, and a feature that keeps failing is
+    QUARANTINED onto it (``degrade.py``) instead of burning the crash-
+    recovery budget: after ``quarantine_threshold`` attributable
+    failures inside ``quarantine_window_s`` the batcher is rebuilt with
+    the feature disabled (flash attention -> XLA attention, paged
+    kernel -> gathered-view XLA decode, speculative -> plain decode,
+    prefix cache -> cold prefill), in-flight requests replay exactly as
+    in crash recovery, and after ``quarantine_cooldown_s`` the feature
+    is re-probed (one trial: success re-enables it, failure re-
+    quarantines).  A non-finite guard fails just the request whose
+    logits came back NaN/Inf (HTTP 500 with a clean error) instead of
+    streaming garbage.
+
+/healthz schema (200 when ``ok``, 503 otherwise)::
+
+    {
+      "ok": bool,              # loop alive, not stalled, not draining
+      "stalled": bool,         # step watchdog tripped
+      "loop_alive": bool,
+      "last_step_age_s": float,
+      "recoveries_total": int,
+      "watchdog_stalls_total": int,
+      "draining": bool,        # drain mode (see below)
+      "drain_remaining_s": float | null,
+      "degraded": bool,        # any feature quarantined or probing
+      "quarantined": [feature, ...],
+      "features": {            # per degradable feature
+        "<name>": {"state": "healthy"|"quarantined"|"probing",
+                    "failures_in_window": int, "failures_total": int,
+                    "quarantines_total": int, "probes_total": int,
+                    "probe_in_s": float | null},  # cooldown countdown
+        ...
+      }
+    }
+
+Drain semantics: ``begin_drain()`` (run.py wires it to SIGTERM/SIGINT)
+finishes every in-flight request, answers new POSTs ``503`` with a
+``Retry-After`` header, and exits the serving loop once idle — bounded
+by ``drain_timeout_s`` (``--drain-timeout-s``), past which stragglers
+are failed with 503.  ``/healthz`` flips to 503 immediately so load
+balancers stop routing here while streams finish.
+
+Request bodies are capped at ``max_body_bytes`` (default 8 MiB): an
+oversized or missing ``Content-Length`` is refused up front with
+``413`` — the body is never read, so a hostile length claims no memory.
 
 Endpoints:
   POST /chat       {"messages": [{"role": ..., "content": ...}, ...]}
@@ -60,7 +106,24 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
+from .degrade import DegradeManager
 from .serving import ContinuousBatcher, _round_up
+
+# Injection-site -> degradable-feature attribution for dispatch
+# exceptions that carry a site name (InjectedFault.site; the generic
+# step/insert/alloc sites stay unattributed and use the crash-recovery
+# budget).  Real device errors carry no site — they attribute through
+# _KERNEL_ERROR_MARKERS + the batcher's last-dispatch record instead.
+_SITE_FEATURES = {
+    "flash_kernel": "flash_attention",
+    "paged_kernel": "paged_kernel",
+    "spec_decode": "spec_decode",
+    "suffix_insert": "prefix_cache",
+}
+# Substrings that mark a real (non-injected) dispatch error as coming
+# out of a Pallas kernel (Mosaic compile/runtime failures name their
+# origin); matched case-insensitively against the exception text.
+_KERNEL_ERROR_MARKERS = ("mosaic", "pallas", "custom-call", "custom_call")
 
 _DONE = object()  # stream sentinel
 
@@ -142,11 +205,18 @@ class LLMServer:
         recovery_window_s: float = 60.0,
         watchdog_deadline_s: Optional[float] = 60.0,
         watchdog_interval_s: float = 1.0,
+        degrade: Optional[DegradeManager] = None,
+        quarantine_threshold: int = 3,
+        quarantine_window_s: float = 60.0,
+        quarantine_cooldown_s: float = 30.0,
+        drain_timeout_s: float = 30.0,
+        max_body_bytes: int = 8 << 20,
     ):
         self.batcher = batcher
         self.tokenizer = tokenizer
         self.chat_format = chat_format
         self.max_queue = max_queue
+        self.max_body_bytes = int(max_body_bytes)
         # Crash-recovery circuit breaker: at most ``max_recoveries``
         # batcher rebuilds per sliding ``recovery_window_s`` window; one
         # more failure hard-drains (every client 503s) instead of
@@ -154,7 +224,37 @@ class LLMServer:
         self.max_recoveries = max_recoveries
         self.recovery_window_s = recovery_window_s
         self.recoveries_total = 0
+        # Monotonic times of UNATTRIBUTABLE recoveries only — failures
+        # attributed to a degradable feature are budgeted by the
+        # quarantine threshold/window instead (see _recover).
         self._recovery_times: List[float] = []
+        # Degradation layer: failures attributable to a quarantinable
+        # feature feed this state machine; a quarantine rebuilds the
+        # batcher onto the feature's fallback path instead of tripping
+        # the breaker.  The ORIGINAL construction is captured here so a
+        # later probe can rebuild with the feature restored (a rebuilt
+        # batcher only remembers its own, possibly-degraded, ctor args).
+        self.degrade = degrade if degrade is not None else DegradeManager(
+            threshold=quarantine_threshold,
+            window_s=quarantine_window_s,
+            cooldown_s=quarantine_cooldown_s,
+        )
+        self._base_ctor = (
+            batcher.params, batcher.config, dict(batcher._ctor_kwargs)
+        )
+        self.quarantine_rebuilds_total = 0
+        self.probe_rebuilds_total = 0
+        self.nonfinite_failed_total = 0
+        # Features whose LAST completed step's success is still
+        # unconfirmed by a host sync (see the probe-success note in
+        # _loop); cleared on every rebuild.
+        self._pending_success: tuple = ()
+        # Drain-on-signal: once set, new POSTs 503 with Retry-After,
+        # in-flight requests run to completion (bounded by the deadline)
+        # and the loop exits cleanly.
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._draining = threading.Event()
+        self._drain_deadline: Optional[float] = None
         # Step watchdog: the loop heartbeats every iteration; a monitor
         # thread flips /healthz to a degraded payload when the heartbeat
         # goes stale past the deadline (a wedged dispatch, not a crash —
@@ -184,16 +284,21 @@ class LLMServer:
             def log_message(self, *args):  # quiet test output
                 pass
 
-            def _reply(self, code: int, body: bytes, ctype: str):
+            def _reply(self, code: int, body: bytes, ctype: str,
+                       headers: Optional[Dict[str, str]] = None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _reply_json(self, code: int, obj: Dict[str, Any]):
+            def _reply_json(self, code: int, obj: Dict[str, Any],
+                            headers: Optional[Dict[str, str]] = None):
                 self._reply(
-                    code, json.dumps(obj).encode(), "application/json"
+                    code, json.dumps(obj).encode(), "application/json",
+                    headers,
                 )
 
             def do_GET(self):
@@ -212,14 +317,56 @@ class LLMServer:
                 if self.path not in ("/generate", "/chat"):
                     self._reply_json(404, {"error": "not found"})
                     return
+                if server._draining.is_set() or server._closed.is_set():
+                    # Drain mode / shutdown: refuse BEFORE reading the
+                    # body, with Retry-After so well-behaved clients back
+                    # off until a replacement instance is routable.
+                    self._reply_json(
+                        503,
+                        {"error": (
+                            "server draining; retry later"
+                            if server._draining.is_set()
+                            and not server._closed.is_set()
+                            else "server shutting down"
+                        )},
+                        headers={
+                            "Retry-After": str(server._retry_after_s())
+                        },
+                    )
+                    return
+                # Body-size cap: the client-supplied Content-Length used
+                # to be trusted unboundedly — a hostile length could pin
+                # max_queue * max_body bytes of handler-thread memory.
+                # Oversized or missing lengths are refused before any
+                # read.
+                cl = self.headers.get("Content-Length")
+                if cl is None:
+                    self._reply_json(
+                        413, {"error": "Content-Length required"}
+                    )
+                    return
                 try:
-                    n = int(self.headers.get("Content-Length", "0"))
+                    n = int(cl)
+                    if n < 0:
+                        raise ValueError(cl)
+                except ValueError:
+                    self._reply_json(
+                        400, {"error": f"bad Content-Length: {cl!r}"}
+                    )
+                    return
+                if n > server.max_body_bytes:
+                    self._reply_json(
+                        413,
+                        {"error": (
+                            f"request body too large ({n} bytes > "
+                            f"{server.max_body_bytes} allowed)"
+                        )},
+                    )
+                    return
+                try:
                     payload = json.loads(self.rfile.read(n) or b"{}")
                 except (ValueError, json.JSONDecodeError) as e:
                     self._reply_json(400, {"error": f"bad request: {e}"})
-                    return
-                if server._closed.is_set():
-                    self._reply_json(503, {"error": "server shutting down"})
                     return
                 # Admission bound: each blocked POST holds an OS thread for
                 # the full generation, so an unbounded inbox is an
@@ -408,6 +555,40 @@ class LLMServer:
         if self._watchdog_thread is not None:
             self._watchdog_thread.join(timeout=10)
 
+    def begin_drain(self, timeout_s: Optional[float] = None) -> None:
+        """Flip the server into drain mode (the SIGTERM/SIGINT path):
+        in-flight requests run to completion, new POSTs get 503 +
+        Retry-After, and the serving loop exits once idle — or once
+        ``timeout_s`` (default ``drain_timeout_s``) elapses, at which
+        point stragglers are failed with 503.  Idempotent: the first
+        call pins the deadline.  HTTP listeners stay up through the
+        drain (clients must be able to read their streams and /healthz
+        must report the drain); call ``stop()`` after ``wait_drained``
+        to close the sockets."""
+        if self._draining.is_set():
+            return
+        t = self.drain_timeout_s if timeout_s is None else float(timeout_s)
+        self._drain_deadline = time.monotonic() + max(0.0, t)
+        self._draining.set()
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Block until the serving loop has exited (drain complete or
+        hard stop); returns False on timeout."""
+        return self._closed.wait(timeout)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def _retry_after_s(self) -> int:
+        """Retry-After value for drain-mode 503s: the remaining drain
+        budget, rounded up — after that a replacement instance should be
+        routable."""
+        dl = self._drain_deadline
+        if dl is None:
+            return max(1, int(math.ceil(self.drain_timeout_s)))
+        return max(1, int(math.ceil(dl - time.monotonic())))
+
     def __enter__(self) -> "LLMServer":
         return self.start()
 
@@ -526,6 +707,43 @@ class LLMServer:
                 p.timed_out = True
                 p.fail("generation timed out", 504)
 
+    def _attribute(self, exc: BaseException) -> Optional[str]:
+        """Map a dispatch exception to the degradable feature that
+        caused it, or None (generic failure -> crash-recovery budget).
+        Injected faults from the kernel/spec/suffix sites carry their
+        site name; real device errors are recognized by Pallas/Mosaic
+        markers in the text plus the batcher's last-dispatch record."""
+        site = getattr(exc, "site", None)
+        if site in _SITE_FEATURES:
+            return _SITE_FEATURES[site]
+        text = f"{type(exc).__name__}: {exc}".lower()
+        if any(m in text for m in _KERNEL_ERROR_MARKERS):
+            feats = getattr(self.batcher, "last_dispatch_features", ())
+            for f in ("paged_kernel", "flash_attention"):
+                if f in feats:
+                    return f
+        return None
+
+    def _build_batcher(self) -> ContinuousBatcher:
+        """Fresh batcher from the ORIGINAL construction with every
+        currently-quarantined feature swapped for its fallback.  Probing
+        features count as enabled — that is what a probe rebuild is."""
+        params, config, kwargs = self._base_ctor
+        kw = dict(kwargs)
+        if not self.degrade.enabled("paged_kernel"):
+            kw["use_pallas_kernel"] = False
+        if not self.degrade.enabled("spec_decode"):
+            kw["draft_params"] = None
+            kw["draft_config"] = None
+        if not self.degrade.enabled("prefix_cache"):
+            kw["prefix_cache"] = False
+        if (
+            not self.degrade.enabled("flash_attention")
+            and config.attn_impl != "xla"
+        ):
+            config = config.replace(attn_impl="xla")
+        return ContinuousBatcher(params, config, **kw)
+
     def _recover(self, exc: BaseException) -> bool:
         """Crash recovery: rebuild the batcher (fresh pool + host state
         from the still-held params) and resubmit every live request from
@@ -537,9 +755,27 @@ class LLMServer:
         tokens, never a repeat, because the replay prompt already
         contains everything they received.
 
+        Failures attributable to a degradable feature are budgeted by
+        the QUARANTINE state machine instead of the breaker: each one
+        rebuilds and replays like any recovery, but the bound on them is
+        the feature's threshold/window (past it the feature falls back
+        and the failures stop), not ``max_recoveries`` — so quarantine
+        is reachable for ANY threshold, including thresholds above the
+        breaker budget.  Once a feature is on its fallback, continuing
+        crashes are unattributable and fill the breaker window normally,
+        which keeps the hard-drain backstop for wrong attributions.
+
         Returns False when the circuit breaker trips (``max_recoveries``
-        rebuilds inside ``recovery_window_s``): the caller re-raises and
-        the finally-drain 503s every client instead of crash-looping."""
+        unattributable rebuilds inside ``recovery_window_s``): the
+        caller re-raises and the finally-drain 503s every client
+        instead of crash-looping."""
+        feature = self._attribute(exc)
+        if feature is not None:
+            if self.degrade.record_failure(feature):
+                self.quarantine_rebuilds_total += 1
+            self.recoveries_total += 1
+            self._rebuild_and_replay()
+            return True
         now = time.monotonic()
         self._recovery_times = [
             t for t in self._recovery_times
@@ -549,13 +785,24 @@ class LLMServer:
             return False
         self._recovery_times.append(now)
         self.recoveries_total += 1
+        self._rebuild_and_replay()
+        return True
+
+    def _rebuild_and_replay(self) -> None:
+        """The recovery primitive shared by crash recovery, quarantine
+        fallbacks, and probe re-enables: fresh batcher (base ctor +
+        current feature overrides), then resubmit every live request
+        from its CPU-side snapshot."""
         # Rebuild BEFORE detaching _active: if the rebuild itself dies
         # (e.g. a real OOM re-allocating the pool), the exception must
         # propagate with _active intact so the finally-drain still
         # delivers the crash reason to every in-flight client.
-        new_batcher = self.batcher.rebuild()
+        new_batcher = self._build_batcher()
         old_active, self._active = self._active, {}
         self.batcher = new_batcher
+        # Any un-credited step success died with the old batcher: the
+        # exception that brought us here may have been its async work.
+        self._pending_success = ()
         bs = self.batcher.block_size
         for p in old_active.values():
             prompt = list(p.prompt_tokens) + list(p.tokens)
@@ -586,7 +833,6 @@ class LLMServer:
                 continue
             p.request_id = rid
             self._active[rid] = p
-        return True
 
     def _watchdog(self) -> None:
         """Monitor thread: flag a stall when the serving loop's heartbeat
@@ -606,11 +852,23 @@ class LLMServer:
                 self._stalled = False
 
     def _health(self) -> Dict[str, Any]:
-        """The /healthz payload: liveness + watchdog/recovery state.
-        ``ok`` is False (HTTP 503) when the loop is dead or stalled."""
+        """The /healthz payload (schema in the module docstring):
+        liveness + watchdog/recovery state + the full degraded state.
+        ``ok`` is False (HTTP 503) when the loop is dead, stalled, or
+        draining — load balancers must stop routing here in all three.
+        A merely DEGRADED server (features quarantined, fallbacks
+        serving) stays ``ok``: staying routable on the slow path is the
+        whole point of quarantine."""
         alive = self._loop_thread.is_alive() and not self._closed.is_set()
+        draining = self._draining.is_set()
+        features = self.degrade.snapshot()
+        remaining = None
+        if draining and self._drain_deadline is not None:
+            remaining = round(
+                max(0.0, self._drain_deadline - time.monotonic()), 3
+            )
         return {
-            "ok": alive and not self._stalled,
+            "ok": alive and not self._stalled and not draining,
             "stalled": self._stalled,
             "loop_alive": alive,
             "last_step_age_s": round(
@@ -618,6 +876,11 @@ class LLMServer:
             ),
             "recoveries_total": self.recoveries_total,
             "watchdog_stalls_total": self.watchdog_stalls_total,
+            "draining": draining,
+            "drain_remaining_s": remaining,
+            "degraded": self.degrade.degraded(),
+            "quarantined": list(self.degrade.quarantined()),
+            "features": features,
         }
 
     def _loop(self) -> None:
@@ -628,6 +891,42 @@ class LLMServer:
         try:
             while not self._stop.is_set():
                 self._heartbeat = time.monotonic()
+                if self._draining.is_set():
+                    # Drain mode: finish in-flight work, then exit
+                    # cleanly; past the deadline fail the stragglers
+                    # (the finally-drain delivers the 503s).
+                    idle = (
+                        not self._active
+                        and self._inbox.empty()
+                        and not self.batcher.pending()
+                    )
+                    if idle:
+                        break
+                    if (
+                        self._drain_deadline is not None
+                        and time.monotonic() >= self._drain_deadline
+                    ):
+                        reason = (
+                            "drain timeout: server shutting down before "
+                            "this request finished"
+                        )
+                        break
+                # Quarantined features whose cooldown expired get ONE
+                # probe re-trial: rebuild with the feature re-enabled
+                # (live requests replay, exactly as in crash recovery).
+                # Success on the next exercising dispatch restores it;
+                # failure re-quarantines via the normal recovery path.
+                # Not while draining — a probe rebuild would discard the
+                # very device state the drain is trying to finish.
+                due = (
+                    [] if self._draining.is_set()
+                    else self.degrade.due_probes()
+                )
+                if due:
+                    for f in due:
+                        self.degrade.start_probe(f)
+                    self.probe_rebuilds_total += 1
+                    self._rebuild_and_replay()
                 # Admit whatever is waiting; block briefly when fully idle
                 # so shutdown and new work are both responsive.
                 try:
@@ -659,11 +958,33 @@ class LLMServer:
                     events = self.batcher.step()
                 except Exception as e:
                     # A step/insert dispatch died (device error, injected
-                    # fault, allocation failure).  Rebuild + replay; past
-                    # the retry budget, re-raise into the hard drain.
+                    # fault, allocation failure).  Rebuild + replay —
+                    # onto a fallback path when the failure quarantined
+                    # a feature; past the retry budget, re-raise into
+                    # the hard drain.
                     if self._recover(e):
                         continue
                     raise
+                # Probe-success recording runs ONE STEP BEHIND: jax
+                # dispatch is async, so step N's device work is only
+                # proven good once step N+1's host sync (the emit scan's
+                # np.asarray) returns without raising.  Crediting step N
+                # immediately would flip a probing feature healthy while
+                # its re-enabled kernel is still in flight — a deferred
+                # device error would then land on the HEALTHY state and
+                # burn crash-recovery budget instead of re-quarantining.
+                for f in self._pending_success:
+                    self.degrade.record_success(f)
+                self._pending_success = tuple(
+                    getattr(self.batcher, "last_step_features", ())
+                )
+                # Non-finite guard: fail just the poisoned requests (the
+                # batcher already freed their slots and blocks).
+                for rid, msg in self.batcher.pop_failed():
+                    p = self._active.pop(rid, None)
+                    if p is not None:
+                        self.nonfinite_failed_total += 1
+                        p.fail(msg, 500)
                 for ev in events:
                     rid, tok, done = ev[0], ev[1], ev[2]
                     lp = ev[3] if len(ev) > 3 else None
@@ -694,6 +1015,7 @@ class LLMServer:
 
     def _metrics_text(self) -> str:
         stats = dict(self.batcher.stats())
+        stats.update(self.degrade.stats())
         stats.update({
             # Server-level fault tolerance (batcher counters above carry
             # the injection-site totals when an injector is attached).
@@ -703,6 +1025,11 @@ class LLMServer:
             "watchdog_last_step_age_seconds": round(
                 time.monotonic() - self._heartbeat, 3
             ),
+            # Degradation / drain / non-finite-guard state.
+            "quarantine_rebuilds_total": self.quarantine_rebuilds_total,
+            "probe_rebuilds_total": self.probe_rebuilds_total,
+            "nonfinite_requests_failed_total": self.nonfinite_failed_total,
+            "draining": int(self._draining.is_set()),
         })
         lines = []
         for k, v in stats.items():

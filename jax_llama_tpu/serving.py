@@ -60,7 +60,7 @@ import numpy as np
 from jax import lax
 
 from .config import LLaMAConfig
-from .engine import prompt_positions
+from .engine import finite_rows, prompt_positions
 from .faults import FaultInjector
 from .models.llama import (
     KVCache,
@@ -407,6 +407,12 @@ def _paged_decode_step(
         # all_greedy): without it the fp32 [B, V] cast + logsumexp never
         # enter the compiled program.
         lp = _token_logprob(logits[:, -1], nxt) if with_logprobs else None
+        # Non-finite guard: a row whose raw logits contain NaN/Inf gets
+        # the -1 token sentinel instead of a draw from garbage; the host
+        # emit scan fails just that request (tokens are never negative,
+        # so the sentinel cannot collide).  Folding the flag into tau
+        # keeps the guard free of extra device->host fetches.
+        nxt = jnp.where(finite_rows(logits[:, -1]), nxt, -1)
         return nxt, lp, keys, pool
 
 
@@ -493,6 +499,9 @@ def _paged_insert(
         tau_lp = (
             _token_logprob(logits_last, tau) if with_logprobs else None
         )
+        # Non-finite guard (see _paged_decode_step): -1 sentinel rows are
+        # failed by the host at the next emit boundary.
+        tau = jnp.where(finite_rows(logits_last), tau, -1)
 
         L, KVH, _, _, hd = pool.k.shape
         nb = P // BLK
@@ -596,6 +605,9 @@ def _paged_suffix_insert(
         keys, sub = _split_rows(keys)
         tau = sample_rows(sub, logits_last, temperature, top_p, top_k)
         lp = _token_logprob(logits_last, tau) if with_logprobs else None
+        # Non-finite guard (see _paged_decode_step): -1 sentinel rows are
+        # failed by the host at the next emit boundary.
+        tau = jnp.where(finite_rows(logits_last), tau, -1)
         return tau, lp, keys, pool
 
 
@@ -812,6 +824,12 @@ def _spec_round(
             is_greedy = temperature <= 0.0
             outs = jnp.where(is_greedy[:, None], greedy_outs, outs_s)
             acc = jnp.where(is_greedy, greedy_acc, acc_s)
+        # Non-finite guard: a row whose target logits contain NaN/Inf
+        # anywhere in the verify block gets acc = -1 — the commit below
+        # then invalidates every slot this round wrote for the row, and
+        # the host fails just that request (acc is never negative
+        # otherwise, so the sentinel cannot collide).
+        acc = jnp.where(jnp.all(finite_rows(t_logits), axis=-1), acc, -1)
 
         if with_logprobs:
             # t_logits[:, j] is the target's raw distribution the token
@@ -871,6 +889,11 @@ class _Slot:
     max_new: int
     stop_tokens: frozenset
     blocks: List[int]
+    # Leading blocks[:shared] were REUSED prefix-cache hits (KV written
+    # by earlier healthy dispatches); blocks[shared:] are this request's
+    # own writes — the distinction the non-finite guard needs to
+    # unpublish only suspect KV.
+    shared: int = 0
 
 
 def _round_up(n: int, m: int) -> int:
@@ -1035,6 +1058,19 @@ class ContinuousBatcher:
         self._prefix_index: Dict[bytes, int] = {}  # chain key -> block
         # refcount-0 keyed blocks, insertion order = eviction order
         self._reusable: "OrderedDict[int, None]" = OrderedDict()
+        # Non-finite-guard channel: (request_id, message) pairs for
+        # requests whose dispatch produced NaN/Inf logits — the slot is
+        # freed immediately and the server fails just that request with
+        # a clean error instead of streaming garbage (``pop_failed``).
+        self.failed: List[Tuple[int, str]] = []
+        self.nonfinite_rows_total = 0
+        # Degradation attribution: the features (degrade.FEATURES names)
+        # in play for the most recent jitted dispatch, and the union over
+        # the current step() call.  The server reads the former to
+        # attribute a dispatch exception and the latter to credit
+        # probe successes.
+        self.last_dispatch_features: Tuple[str, ...] = ()
+        self.last_step_features: set = set()
         # Observability counters (exposed via the HTTP /metrics endpoint).
         self.emitted_total = 0
         self.steps_total = 0
@@ -1073,7 +1109,10 @@ class ContinuousBatcher:
         The crash-recovery path: after a dispatch exception the old
         instance's device state is suspect; callers resubmit every
         in-flight request (prompt + delivered tokens as the new prompt)
-        against the rebuilt instance and drop this one."""
+        against the rebuilt instance and drop this one.  (The
+        degradation layer does NOT go through this method — it rebuilds
+        from the server-retained original ctor state with fallback
+        substitutions, see ``LLMServer._build_batcher``.)"""
         return ContinuousBatcher(
             self.params, self.config, **self._ctor_kwargs
         )
@@ -1089,6 +1128,47 @@ class ContinuousBatcher:
         """Named fault-injection hook (no-op without an injector)."""
         if self.fault_injector is not None:
             self.fault_injector.fire(site)
+
+    def _record_dispatch(self, features: Sequence[str]) -> None:
+        """Note which degradable features the NEXT jitted dispatch
+        exercises (set before the site hooks fire, so an exception out
+        of either the hook or the dispatch itself is attributable)."""
+        self.last_dispatch_features = tuple(features)
+        self.last_step_features.update(features)
+
+    def _take_nan(self) -> bool:
+        """Consume an armed ``nan`` fault (the non-finite guard's test
+        lever); no-op without an injector."""
+        return (
+            self.fault_injector is not None
+            and self.fault_injector.take_nan()
+        )
+
+    def pop_failed(self) -> List[Tuple[int, str]]:
+        """Drain (request_id, message) for requests failed by the
+        non-finite guard since the last call.  Their slots and blocks
+        are already freed; the server maps these to per-request HTTP
+        errors."""
+        out, self.failed = self.failed, []
+        return out
+
+    def _fail_slot(self, b: int, message: str) -> None:
+        """Fail slot ``b``'s request with ``message``: record it for
+        ``pop_failed`` and free the slot.  The request's freshly written
+        prompt blocks are UNPUBLISHED from the prefix index first — KV
+        produced by a dispatch that emitted non-finite logits must never
+        be retained for future cache hits.  Reused hit blocks
+        (``slot.shared`` leading ones) hold earlier healthy dispatches'
+        KV and stay published — dropping a popular shared system
+        prompt's chain over one poisoned suffix would cold-prefill the
+        whole fleet."""
+        slot = self.slots[b]
+        assert slot is not None
+        for blk in slot.blocks[slot.shared:]:
+            self._drop_chain_entry(blk)
+        self.failed.append((slot.request_id, message))
+        self.nonfinite_rows_total += 1
+        self._free_slot(b)
 
     def submit(
         self,
@@ -1209,6 +1289,7 @@ class ContinuousBatcher:
             "prefix_cached_blocks": len(self._reusable),
             "prefix_requests_hit_total": self.prefix_requests_hit,
             "prefix_blocks_reused_total": self.prefix_blocks_reused,
+            "nonfinite_rows_total": self.nonfinite_rows_total,
         })
         return out
 
@@ -1223,6 +1304,21 @@ class ContinuousBatcher:
         Finished slots free their blocks and queued requests are
         admitted for the NEXT step.
         """
+        self.last_step_features = set()
+        if (
+            self.queue
+            and any(s is not None for s in self.slots.values())
+            and any(s is None for s in self.slots.values())
+        ):
+            # Deferred-error barrier, only when _admit is about to
+            # record NEW dispatches: jax dispatch is async, so the
+            # previous step's device error can surface at the next host
+            # sync — which must happen while ``last_dispatch_features``
+            # still names the dispatch that produced it, not after
+            # admission overwrites the attribution record.  Admissions
+            # are rare relative to steps, so the extra [B] fetch stays
+            # off the steady-state hot path.
+            np.asarray(self.tau)
         self._admit()
         if not any(s is not None for s in self.slots.values()):
             return []
@@ -1232,10 +1328,24 @@ class ContinuousBatcher:
         # forward whose output would be discarded.
         out: List[Tuple] = []
         taus = np.asarray(self.tau)
+        # Non-finite guard: a -1 tau is the step programs' sentinel for
+        # "this row's logits contained NaN/Inf" — fail just that request
+        # with a clean error instead of streaming a garbage token.  An
+        # armed ``nan`` fault (chaos drills) poisons the first active
+        # row the same way.
+        forced_nan = self._take_nan()
         for b, slot in self.slots.items():
             if slot is None:
                 continue
             tok = int(taus[b])
+            if tok < 0 or forced_nan:
+                forced_nan = False
+                self._fail_slot(
+                    b,
+                    "non-finite logits: the model produced NaN/Inf for "
+                    "this request; it was aborted (server healthy)",
+                )
+                continue
             slot.emitted.append(tok)
             self.emitted_total += 1
             done = (
@@ -1258,7 +1368,25 @@ class ContinuousBatcher:
             # returned to the caller.  Recovery must therefore replay
             # from the tokens it DELIVERED, not from slot.emitted (the
             # server keeps its own per-request token record).
+            # The kernel/spec sites fire after "step" (same dispatch,
+            # finer attribution: their exceptions carry a site name the
+            # degradation layer maps to a quarantinable feature).
+            feats: List[str] = []
+            if self.spec:
+                feats.append("spec_decode")
+                if self._spec_kernel_ok():
+                    feats.append("paged_kernel")
+            elif self.use_pallas_kernel and _kernel_eligible(
+                self.block_size, self.mesh, self.config.kv_heads,
+                self.n_slots,
+            ):
+                feats.append("paged_kernel")
+            self._record_dispatch(feats)
             self._fault("step")
+            if "spec_decode" in feats:
+                self._fault("spec_decode")
+            if "paged_kernel" in feats:
+                self._fault("paged_kernel")
             self.steps_total += 1
             if self.spec:
                 self._spec_tail(out)
@@ -1322,6 +1450,17 @@ class ContinuousBatcher:
             if slot is None:
                 continue
             a = int(acc[b])
+            if a < 0:
+                # _spec_round's non-finite sentinel: the row's verify
+                # logits held NaN/Inf; its round was never committed
+                # (all slots invalidated in-jit) — fail just this
+                # request.
+                self._fail_slot(
+                    b,
+                    "non-finite logits: the model produced NaN/Inf for "
+                    "this request; it was aborted (server healthy)",
+                )
+                continue
             self.drafts_proposed += self.n_draft
             self.drafts_accepted += a
             # Emit accepted drafts outs[0..a-1] (== the draft tokens);
@@ -1634,6 +1773,13 @@ class ContinuousBatcher:
             table_rows[i, : len(blocks)] = blocks
             n_alloc_arr[i] = len(blocks)
             fill0s[i] = L0
+        # No flash here regardless of T: the gathered view carries
+        # PER-ROW cache offsets (fill0 is a vector), which forces
+        # forward()'s must_xla path — "auto" resolves to XLA for every
+        # suffix chunk.  Claiming flash would fire the wrong fault site
+        # and, worse, credit a probing flash kernel with a success it
+        # never executed.
+        self._record_dispatch(["prefix_cache"])
         self._fault("suffix_insert")
         tau, tau_lp, keys_out, self.pool = _paged_suffix_insert(
             self.params, self.pool, jnp.asarray(table_rows),
@@ -1679,7 +1825,7 @@ class ContinuousBatcher:
             self.top_k_arr[b] = req.top_k
             self.slots[b] = _Slot(
                 request_id=req.rid, emitted=[], max_new=req.max_new,
-                stop_tokens=req.stops, blocks=blocks,
+                stop_tokens=req.stops, blocks=blocks, shared=n_share,
             )
             self._claim_blocks(row_fresh[i])
             # Extend the published chain with this request's own full
@@ -1780,7 +1926,23 @@ class ContinuousBatcher:
                 bid[i, : Pb // self.block_size] = blocks[
                     : Pb // self.block_size
                 ]
+            # Host mirror of forward()'s "auto" resolution for the
+            # batched prefill: flash runs iff a chunk exceeds 8 tokens
+            # (the chunked loop forwards ``chunk`` tokens at a time, so
+            # prefill_chunk <= 8 keeps every chunk on XLA; the batch
+            # cache is a fresh scalar-index init_cache, so must_xla
+            # never triggers here).
+            chunk = (
+                self.prefill_chunk
+                if self.prefill_chunk and self.prefill_chunk < P else P
+            )
+            flash = self.config.attn_impl in ("auto", "flash") and chunk > 8
+            self._record_dispatch(
+                ["flash_attention"] if flash else []
+            )
             self._fault("insert")
+            if flash:
+                self._fault("flash_kernel")
             taus, tau_lps, plens, keys_out, self.pool = _paged_insert(
                 self.params, self.pool, jnp.asarray(bid),
                 jnp.asarray(pt), jnp.asarray(pm), jnp.asarray(keys),

@@ -46,6 +46,15 @@ from .models.llama import forward, init_cache
 from .ops.sampling import sample, warped_probs
 from .parallel.mesh import use_mesh
 
+def _maybe_fault() -> None:
+    """Chaos-drill hook: fires faults.py's trace-time registry (site
+    "spec_decode") at ``generate_speculative``'s trace time.  The
+    serving batcher's per-round injection is the batcher-side site of
+    the same name (serving.ContinuousBatcher.step)."""
+    from .faults import fire_trace
+
+    fire_trace("spec_decode")
+
 
 @functools.partial(
     jax.jit,
@@ -89,6 +98,7 @@ def generate_speculative(
        after stop; accept_counts [B] int32 — total accepted draft tokens
        per row, for observability/acceptance-rate monitoring).
     """
+    _maybe_fault()
     gc = gen_config
     if gc.temperature != 0.0 and rng is None:
         raise ValueError(

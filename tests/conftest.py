@@ -61,6 +61,12 @@ def pytest_configure(config):
         "slow: long-running tests excluded from the tier-1 run "
         "(pytest -m 'not slow')",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: full fault-matrix smoke drills (make chaos / "
+        "pytest -m 'chaos or faults'); the heavy ones are also marked "
+        "slow so tier-1 keeps its time headroom",
+    )
 
 
 @pytest.fixture(autouse=True)

@@ -382,3 +382,66 @@ def test_orbax_d_first_layout_checkpoint_migrates(tmp_path):
         ),
         restored_q, qp,
     )
+
+
+def test_checkpoint_manifest_rejects_corrupt_shard(tmp_path):
+    """The save-time sha256 manifest makes a flipped byte (or a
+    truncated file) in any shard fail the restore loudly BEFORE serving
+    starts — never silent garbage weights."""
+    from jax_llama_tpu.convert.checkpoint import (
+        MANIFEST_NAME,
+        verify_manifest,
+    )
+
+    cfg = cfg_lib.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(ckpt, params, cfg)
+    manifest = json.loads((ckpt / MANIFEST_NAME).read_text())
+    assert manifest["files"]  # every file hashed at save time
+    assert verify_manifest(ckpt) is True
+
+    # Flip one byte in the LARGEST shard (an actual array payload).
+    rel = max(manifest["files"], key=lambda r: manifest["files"][r]["bytes"])
+    shard = ckpt / rel
+    blob = bytearray(shard.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    shard.write_bytes(bytes(blob))
+    with pytest.raises(ValueError, match="sha256 mismatch"):
+        load_checkpoint(ckpt)
+    # verify=False opts out (storage-layer-integrity escape hatch).
+    load_checkpoint(ckpt, verify=False)
+
+    # Truncation is reported as truncation, checked before hashing.
+    shard.write_bytes(bytes(blob[: len(blob) // 2]))
+    with pytest.raises(ValueError, match="truncated"):
+        load_checkpoint(ckpt)
+
+    # A deleted shard is reported missing.
+    shard.unlink()
+    with pytest.raises(ValueError, match="missing"):
+        load_checkpoint(ckpt)
+
+
+def test_checkpoint_atomic_overwrite_keeps_manifest_consistent(tmp_path):
+    """Re-saving over an existing checkpoint swaps the whole tree: the
+    manifest always describes exactly the files on disk (no stale trash
+    or temp siblings left behind)."""
+    import os
+
+    cfg = cfg_lib.tiny()
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(ckpt, init_params(jax.random.PRNGKey(0), cfg), cfg)
+    save_checkpoint(ckpt, init_params(jax.random.PRNGKey(1), cfg), cfg)
+    restored, _ = load_checkpoint(ckpt)
+    want = init_params(jax.random.PRNGKey(1), cfg)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        want, restored,
+    )
+    # No .tmp-/.trash- siblings survive a completed save.
+    leftovers = [n for n in os.listdir(tmp_path)
+                 if ".tmp-" in n or ".trash-" in n]
+    assert leftovers == []

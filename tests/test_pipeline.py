@@ -153,6 +153,7 @@ def test_pipeline_rejects_cache_decode():
                     cache=cache)
 
 
+@pytest.mark.slow  # ~17 s; pipeline+dropout composition, tier-1 headroom
 def test_pipeline_dropout_training():
     """Dropout composes with stage > 1: per-layer keys ride the staged
     tree and each stage folds in its current microbatch index, so every

@@ -663,3 +663,46 @@ def test_http_mixed_concurrent_load(model):
     assert len(cb.free_blocks) + len(cb._reusable) == total_blocks
     assert all(s is None for s in cb.slots.values())
     assert not cb._block_refs  # no dangling refcounts
+
+
+def test_http_body_size_cap(model):
+    """Oversized or missing Content-Length is refused with 413 BEFORE
+    any body read; a bad length is a 400; normal requests still work.
+    urllib always sets the header, so drive http.client directly."""
+    import http.client
+
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=32)
+    with LLMServer(cb, max_body_bytes=1024) as srv:
+        host, port = srv.httpd.server_address[:2]
+
+        def raw_post(headers, body=b""):
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            try:
+                conn.putrequest("POST", "/generate")
+                for k, v in headers.items():
+                    conn.putheader(k, v)
+                conn.endheaders()
+                if body:
+                    conn.send(body)
+                r = conn.getresponse()
+                return r.status, json.loads(r.read())
+            finally:
+                conn.close()
+
+        # claimed length over the cap: refused up front, body never read
+        status, body = raw_post({"Content-Length": str(1 << 30)})
+        assert status == 413
+        assert "too large" in body["error"]
+        # missing Content-Length: 413 too (the length is required)
+        status, body = raw_post({})
+        assert status == 413
+        assert "Content-Length" in body["error"]
+        # unparseable length: 400
+        status, body = raw_post({"Content-Length": "banana"})
+        assert status == 400
+        # a normal request under the cap still works
+        status, body = _post(
+            srv.address, {"prompt": [1, 2, 3], "max_new_tokens": 4}
+        )
+        assert status == 200 and len(body["tokens"]) == 4

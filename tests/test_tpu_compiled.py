@@ -284,3 +284,52 @@ def test_device_op_times_compiled():
     assert "fusion" in top or "convolution" in top or "dot" in top, top
     by_src = device_op_times(lambda: float(f(a)), by="source")
     assert sum(by_src.values()) > 0
+
+
+@requires_tpu
+def test_suffix_admission_parity_on_chip():
+    """Prefix-cache hit admission vs cold full prefill, ON CHIP in the
+    serving dtype (bf16): token identity.
+
+    The CPU fp32 suite pins this (tests/test_prefix_cache.py), but the
+    suffix path computes its activations through a differently-shaped
+    dispatch than a cold prefill (gathered-view ``_paged_suffix_insert``
+    vs batched ``_paged_insert``), so bf16 on-chip identity was a
+    measured claim, not a theorem — this is the regression for it
+    (ADVICE r5 follow-up to the softened ``--no-prefix-cache`` doc)."""
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.serving import ContinuousBatcher
+
+    cfg = get_config(
+        "tiny", vocab_size=512, dim=256, n_layers=2, n_heads=4,
+        n_kv_heads=2, multiple_of=32, max_seq_len=256,
+        dtype="bfloat16", param_dtype="bfloat16",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(6)
+    system = rng.randint(1, 512, size=40).tolist()  # 2 full 16-blocks
+    submits = [
+        (system + rng.randint(1, 512, size=5).tolist(),
+         dict(max_new_tokens=8)),
+        (system + rng.randint(1, 512, size=7).tolist(),
+         dict(max_new_tokens=8, temperature=0.8, seed=7)),
+    ]
+
+    cold = ContinuousBatcher(params, cfg, n_slots=1, max_len=128,
+                             block_size=16, prefix_cache=False)
+    cold_out = []
+    for p, kw in submits:
+        rid = cold.submit(list(p), **kw)
+        cold_out.append(cold.run_to_completion()[rid])
+
+    warm = ContinuousBatcher(params, cfg, n_slots=1, max_len=128,
+                             block_size=16, prefix_cache=True)
+    warm_out = []
+    for p, kw in submits:
+        rid = warm.submit(list(p), **kw)
+        warm_out.append(warm.run_to_completion()[rid])
+
+    st = warm.stats()
+    assert st["prefix_requests_hit_total"] == 1  # the hit actually ran
+    assert st["prefix_blocks_reused_total"] == 2
+    assert warm_out == cold_out  # on-chip suffix insert is emit-identical

@@ -3,6 +3,7 @@
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from jax_llama_tpu import config as cfg_lib
 from jax_llama_tpu.models import init_params
@@ -168,6 +169,7 @@ def test_dropout_perturbs_loss_deterministically():
     np.testing.assert_allclose(z, float(lm_loss(params, tokens, zero)), rtol=1e-6)
 
 
+@pytest.mark.slow  # ~18 s of statistical averaging; tier-1 headroom
 def test_dropout_mean_approximates_deterministic_loss():
     """Inverted dropout preserves expectations: averaging over many masks
     should land near the no-dropout loss (loose tolerance, tiny model)."""
