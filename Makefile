@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 faults chaos tpu
+.PHONY: tier1 faults chaos tpu perf-smoke
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -22,6 +22,15 @@ faults:
 # tier-1 excludes for time.
 chaos:
 	$(PYTEST) tests/ -q -m 'chaos or faults'
+
+# Tier-1-safe perf guardrails (CPU, no accelerator needed): chunked
+# decode's host-boundary discipline — an instrumented counter test
+# asserting <= 1 device->host sync and 0 steady-state host->device
+# state uploads per chunk dispatch — plus the K>1 vs K=1 token-identity
+# matrix.  These also run inside tier1; this target is the fast
+# pre-push slice.
+perf-smoke:
+	$(PYTEST) tests/test_perf_smoke.py tests/test_serving_chunked.py -q -m 'not slow'
 
 # On-chip kernel regressions (run on a TPU host; self-skip elsewhere).
 tpu:

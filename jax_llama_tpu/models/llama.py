@@ -73,11 +73,40 @@ from ..config import LLaMAConfig
 from ..ops.attention import attention_bias, dropout as _dropout, sdpa, sdpa_cached
 from ..ops.flash_attention import flash_attention, flash_attention_quantized
 from ..ops.norm import rms_norm
-from ..ops.quant import matmul as qeinsum
+from ..ops.quant import QuantizedTensor as _QuantizedTensor
+from ..ops.quant import matmul as _quant_matmul
 from ..ops.rope import apply_rope, rope_table
 from ..parallel.mesh import constrain
 
 Params = Dict[str, Any]
+
+
+def qeinsum(
+    x: jnp.ndarray,
+    w: Any,
+    eq: str,
+    dtype: Optional[jnp.dtype] = None,
+    preferred_element_type: Optional[jnp.dtype] = None,
+) -> jnp.ndarray:
+    """Projection einsum that transparently handles int8 weights.
+
+    QuantizedTensor weights route through ``ops.quant.matmul`` (the
+    int8 dequant-fused contraction); plain arrays run the einsum HERE
+    so the xplane source attribution lands on this file.  Before this
+    split, bench.py's ``step_breakdown_us`` charged every bf16/fp32
+    projection matmul to ``quant.py`` (the thin wrapper's frame), which
+    made the breakdown's largest bucket unreadable — "quant.py
+    2,572 µs/step" was the plain weight stream, not quantization work.
+    Now ``quant.py`` in a trace means actual int8 dequant math.
+    """
+    dtype = dtype or x.dtype
+    if isinstance(w, _QuantizedTensor):
+        return _quant_matmul(x, w, eq, dtype, preferred_element_type)
+    y = jnp.einsum(
+        eq, x, w.astype(dtype),
+        preferred_element_type=preferred_element_type,
+    )
+    return y if preferred_element_type else y.astype(dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -333,12 +362,24 @@ def lm_head_logits(
 
 def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Symmetric int8 over the trailing head_dim: x [..., hd] ->
-    (int8 [..., hd], fp32 scale [...])."""
-    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    (int8 [..., hd], fp32 scale [...]).
+
+    Every int8-KV path quantizes INCREMENTALLY with this function — only
+    the step's newly appended projections ([L, B, T, KVH, hd]; T=1 in
+    decode) ever pass through it, with their per-slot-per-head scales
+    cached alongside the int8 payload (KVCache.k_scale / BlockPool
+    scale planes).  The stored pool is never round-tripped through
+    re-quantization: attention folds the cached scales at the
+    scores/probability level (sdpa_cached, flash/paged kernels) so the
+    int8 bytes stream from HBM untouched.  The single fp32 cast below is
+    shared by the amax and the rounding (one materialization, not two).
+    """
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
     scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(
-        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
-    ).astype(jnp.int8)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127).astype(
+        jnp.int8
+    )
     return q, scale
 
 

@@ -27,6 +27,17 @@ row logsumexp; the caller merges the current step's own K/V (one slot,
 always attendable) at the scores level — the same two-source softmax
 split as ``ops.attention.sdpa_cached``, so the pool stays immutable
 through the layer scan and the decode step applies one scatter per step.
+
+Scan compatibility: everything dynamic the kernel consumes — the block
+table, per-row query positions, the derived live-block grid bounds, the
+layer index, and the pool planes themselves — enters as traced operands
+(scalar-prefetch or BlockSpec-mapped), so the whole op nests inside
+``lax.scan`` loops without re-tracing: the model's layer scan selects
+planes via ``layer``, and serving's fused decode chunk
+(``serving._paged_decode_chunk``) additionally scans K decode
+iterations around the layer scan, re-deriving positions/bounds per
+iteration on device.  Under a mesh the shard_map wrapper nests inside
+those scans the same way.
 """
 
 from __future__ import annotations

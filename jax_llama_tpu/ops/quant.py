@@ -101,6 +101,13 @@ def matmul(
     the same relative order they hold in the weight (true for every
     projection in this model), so the scale broadcasts over the leading
     batch/seq dims of the output.
+
+    Profile-attribution note: the model's hot path
+    (``models.llama.qeinsum``) calls this only for QuantizedTensor
+    weights and runs the plain-array einsum in its own frame — so a
+    ``quant.py`` bucket in an xplane source breakdown (bench.py
+    ``step_breakdown_us``) now measures real int8 dequant work, not the
+    bf16 weight stream it used to swallow.
     """
     dtype = dtype or x.dtype
     if isinstance(w, QuantizedTensor):

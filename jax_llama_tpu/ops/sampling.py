@@ -75,6 +75,26 @@ def warped_probs(
     return jax.nn.softmax(logits, axis=-1)
 
 
+def stop_token_hits(
+    tokens: jnp.ndarray, stop_table: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-row stop-token membership — the ON-DEVICE half of serving's
+    stop detection, so a fused multi-token decode chunk can fold
+    finished rows out of its active mask without a host round-trip.
+
+    tokens: [B] int32 pending tokens.  Negative values (the serving
+    layer's non-finite sentinel, or stale inactive-row state) never
+    match — the guard below keeps them from colliding with the table's
+    -1 padding.
+    stop_table: [B, S] int32, each row's stop set right-padded with -1
+    (rows with fewer than S stops, or none at all).
+    Returns [B] bool, True where the row's token is one of its stops.
+    """
+    return jnp.any(
+        (tokens[:, None] >= 0) & (tokens[:, None] == stop_table), axis=1
+    )
+
+
 def sample(
     rng: jax.Array,
     logits: jnp.ndarray,

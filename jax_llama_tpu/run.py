@@ -56,6 +56,15 @@ def main() -> None:
                          "finish; requests share a slot pool")
     ap.add_argument("--slots", type=int, default=4,
                     help="slot-pool size for --serve / --http")
+    ap.add_argument("--decode-chunk", type=int, default=8,
+                    help="fuse up to this many decode iterations per "
+                         "jitted dispatch in --serve / --http "
+                         "(token-identical to 1; stop detection and "
+                         "batcher state live on device, the host syncs "
+                         "once per chunk instead of once per token; "
+                         "effective K adapts down to 1 around "
+                         "admissions and under speculative decode; "
+                         "1 restores the classic per-token loop)")
     ap.add_argument("--http", type=int, default=None, metavar="PORT",
                     help="serve over HTTP on this port (POST /generate "
                          "with blocking or NDJSON-streaming responses, "
@@ -250,6 +259,7 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         logprobs=getattr(args, "logprobs", False),
         prefix_cache=not getattr(args, "no_prefix_cache", False),
         fault_injector=injector,
+        decode_chunk=getattr(args, "decode_chunk", 8),
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).
@@ -351,6 +361,7 @@ def _serve(params, config, tokenizer, mesh, args) -> None:
         temperature=args.temperature, top_p=args.top_p,
         seed=args.seed, mesh=mesh,
         prefix_cache=not getattr(args, "no_prefix_cache", False),
+        decode_chunk=getattr(args, "decode_chunk", 8),
     )
     rid_prompt: dict = {}
     emitted: dict = {}

@@ -17,7 +17,27 @@ parity.  Design constraints, in order:
     web framework to vendor or pin.
   * **Observability.**  ``GET /metrics`` exposes the batcher counters
     (tokens, steps, slot/block occupancy, speculative acceptance) in
-    Prometheus text format; ``GET /healthz`` for liveness.
+    Prometheus text format; ``GET /healthz`` for liveness.  Chunked
+    decode adds: ``llm_decode_chunk_size`` (gauge — the effective K of
+    the most recent fused decode dispatch; 1 around admissions and
+    under speculative decode), ``llm_decode_dispatches_total``
+    (counter — jitted decode dispatches; tokens/dispatch trends toward
+    K), ``llm_host_syncs_total`` / ``llm_state_uploads_total``
+    (counters — device->host fetches and host->device state-sync
+    dispatches the serving loop performed), and
+    ``llm_host_syncs_per_token`` (gauge — trends toward 1/K in steady
+    state; ~1.0 means the loop is paying one round-trip per token).
+  * **Chunked decode is transparent here.**  The batcher's ``step()``
+    may return up to K tokens per slot per call
+    (``serving.ContinuousBatcher`` ``decode_chunk``, run.py
+    ``--decode-chunk``); the loop below already iterates per-token
+    events, so streaming clients still receive one NDJSON line per
+    token, delivered-token accounting (the crash-recovery replay
+    record) stays token-exact, and a mid-chunk stop/max_new/non-finite
+    ends the request at exactly the token it would under the per-token
+    loop.  Dispatch-failure attribution and fault sites fire once per
+    chunk dispatch; an aborted chunk delivers nothing, so replay
+    regenerates the whole chunk from the delivered record.
   * **Degrade before dying.**  Every accelerated feature has a slower
     always-correct fallback, and a feature that keeps failing is
     QUARANTINED onto it (``degrade.py``) instead of burning the crash-

@@ -44,6 +44,28 @@ TPU-native mechanics:
     are -1 (masked), their sampled token is ignored by the host, and
     their cache write-back is dropped (sentinel block id, scatter mode
     "drop").
+  * **Chunked decode (Orca-style iteration batching).**  With
+    ``decode_chunk`` > 1 the non-speculative step fuses K decode
+    iterations into ONE jitted ``lax.scan`` program
+    (``_paged_decode_chunk``): stop-token sets, per-row max_new budgets
+    and the non-finite -1 sentinel are evaluated ON DEVICE (finished
+    rows fold out of the active mask mid-chunk — they stop attending and
+    writing), and the host gets the whole [B, K] token block (+ bitcast
+    [B, K] logprobs when enabled) back in ONE ``np.asarray``.  Batcher
+    state (block table, fills, positions, active mask, sampling
+    policies, budgets, stop sets) is device-resident: admission / free /
+    cancel mark rows dirty and one ``_scatter_rows`` dispatch syncs them
+    before the next chunk — steady-state decode performs zero
+    host->device state uploads and one device->host fetch per K tokens
+    per slot, instead of the five uploads + one fetch PER TOKEN the
+    K=1 loop pays.  K adapts (1 right after an admission, clamped small
+    while the queue holds capacity-blocked requests, pow2 up to
+    ``decode_chunk`` once slots are steady) so admission latency and
+    time-to-first-token match the K=1 loop while saturated load keeps
+    amortizing dispatches.  Chunked output is
+    token-identical to K=1 under greedy and seeded sampling — per-row
+    key chains split once per iteration exactly as one K=1 dispatch
+    would (pinned by tests/test_serving_chunked.py).
 """
 
 from __future__ import annotations
@@ -72,6 +94,7 @@ from .models.llama import (
     paged_write_indices,
 )
 from .ops.attention import NEG_INF
+from .ops.sampling import stop_token_hits
 from .parallel.mesh import use_mesh
 from .spec_decode import draft_categorical, leviathan_verify, place_extra
 
@@ -336,6 +359,58 @@ def _kernel_eligible(block_size, mesh, kv_heads, n_rows, draft_config=None):
     return bool(ok)
 
 
+def _decode_step_core(
+    params, pool, table, n_alloc, fill, tau, pos, active, keys,
+    temperature, top_p, top_k, *, config, all_greedy, use_kernel,
+    with_logprobs,
+):
+    """One [n_slots, 1] decode iteration over the paged pool — the shared
+    body of the single-step program (``_paged_decode_step``) and each
+    ``lax.scan`` iteration of the fused chunk program
+    (``_paged_decode_chunk``), so the two cannot drift numerically.
+
+    Returns (next token [B] with the -1 non-finite sentinel folded in,
+    its model logprob or None, carried keys, updated pool)."""
+    positions = jnp.where(active, pos, -1)[:, None]
+    if use_kernel:
+        pcache = PagedKVCache(
+            k=pool.k, v=pool.v, pos=pool.pos,
+            table=table, fill=fill,
+            k_scale=pool.k_scale, v_scale=pool.v_scale,
+        )
+        logits, pcache = forward(
+            params, tau[:, None], positions, config, cache=pcache,
+            attn_mask=active[:, None],
+        )
+        pool = dataclasses.replace(
+            pool, k=pcache.k, v=pcache.v, pos=pcache.pos,
+            k_scale=pcache.k_scale, v_scale=pcache.v_scale,
+        )
+    else:
+        view = _gather_cache(pool, table, n_alloc, fill)
+        logits, view = forward(
+            params, tau[:, None], positions, config, cache=view,
+            attn_mask=active[:, None],
+        )
+        pool = _scatter_back(pool, view, table, fill, active, T=1)
+    if all_greedy:
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    else:
+        keys, subs = _split_rows(keys)
+        nxt = sample_rows(subs, logits[:, -1], temperature, top_p, top_k)
+    # with_logprobs is static (trace-time specialization, like
+    # all_greedy): without it the fp32 [B, V] cast + logsumexp never
+    # enter the compiled program.
+    lp = _token_logprob(logits[:, -1], nxt) if with_logprobs else None
+    # Non-finite guard: a row whose raw logits contain NaN/Inf gets
+    # the -1 token sentinel instead of a draw from garbage; the host
+    # emit scan fails just that request (tokens are never negative,
+    # so the sentinel cannot collide).  Folding the flag into tau
+    # keeps the guard free of extra device->host fetches.
+    nxt = jnp.where(finite_rows(logits[:, -1]), nxt, -1)
+    return nxt, lp, keys, pool
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -369,7 +444,6 @@ def _paged_decode_step(
     n_slots % data != 0, or active seq/stage axes).
     """
     with use_mesh(mesh):
-        positions = jnp.where(active, pos, -1)[:, None]
         # Sub-128 (narrow-lane) block sizes are verified compiled on
         # hardware — bf16 and int8 kernels match interpret mode exactly at
         # BLK 8/16/32/64/128 on a v5e chip (regression-tested in
@@ -377,43 +451,143 @@ def _paged_decode_step(
         use_kernel = allow_kernel and _kernel_eligible(
             pool.block_size, mesh, config.kv_heads, tau.shape[0]
         )
-        if use_kernel:
-            pcache = PagedKVCache(
-                k=pool.k, v=pool.v, pos=pool.pos,
-                table=table, fill=fill,
-                k_scale=pool.k_scale, v_scale=pool.v_scale,
+        return _decode_step_core(
+            params, pool, table, n_alloc, fill, tau, pos, active, keys,
+            temperature, top_p, top_k, config=config,
+            all_greedy=all_greedy, use_kernel=use_kernel,
+            with_logprobs=with_logprobs,
+        )
+
+
+# "No token emitted this chunk column" marker in the [B, K] token block
+# (the row was already inactive).  Distinct from the -1 non-finite
+# sentinel: real tokens are never negative, so both are unambiguous.
+_CHUNK_PAD = -2
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "config", "n_iter", "mesh", "all_greedy", "allow_kernel",
+        "with_logprobs",
+    ),
+    donate_argnames=(
+        "pool", "fill", "tau", "tau_lp", "pos", "active", "remaining",
+        "keys",
+    ),
+)
+def _paged_decode_chunk(
+    params, pool, table, n_alloc, fill, tau, tau_lp, pos, active,
+    remaining, stops, keys, temperature, top_p, top_k, *,
+    config, n_iter, all_greedy=False, mesh=None, allow_kernel=True,
+    with_logprobs=False,
+):
+    """``n_iter`` fused decode iterations in ONE jitted program — the
+    chunked-decode hot path.  Each ``lax.scan`` iteration replays the
+    host's K=1 contract exactly, ON DEVICE:
+
+      1. *emit* the pending token ``tau`` into the output block
+         (column i), recording -1 for a non-finite-sentinel row and
+         ``_CHUNK_PAD`` for rows that were already inactive;
+      2. *stop-detect*: a row whose emitted token is in its stop set
+         (``stops``, a [B, S] -1-padded per-row table) or whose
+         ``remaining`` generation budget is exhausted (or whose tau
+         carries the -1 sentinel) folds out of ``active`` — it stops
+         attending and writing for the REST of the chunk, exactly as the
+         host frees the slot before the next K=1 dispatch;
+      3. run one ``_decode_step_core`` iteration for the surviving rows
+         (same keys-split topology per iteration as one K=1 dispatch, so
+         sampled streams are bit-identical) and advance fill/pos.
+
+    The host touches the device once per CHUNK, not per token: the token
+    block (and, under ``with_logprobs``, the per-token logprobs,
+    bitcast to int32) comes back as ONE packed int32 array
+    [1 or 2, B, n_iter], and all decode state (fill/pos/active/remaining/
+    tau/tau_lp/keys + the pool) stays resident — returned as fresh
+    donated buffers, never re-uploaded from numpy.
+
+    Token-identity with K=1 (pinned by tests/test_serving_chunked.py):
+    iteration i's sample sees exactly the state a K=1 dispatch sequence
+    would have, and key chains split once per iteration regardless of
+    liveness — the same [B]-wide split a K=1 dispatch performs.
+
+    Iterations after every row has folded out run MASKED rather than
+    being lax.cond-skipped: guarding a cached decode forward with a
+    cond was measured to cost more than the wasted forward (the
+    branch-merge forced full-cache relayout copies — see the engine
+    while-loop's note, engine.py).  The host bounds the waste anyway:
+    ``_pick_chunk`` clamps K to the largest remaining budget, so a
+    fully-dead tail only arises from stop tokens landing early.
+    """
+    with use_mesh(mesh):
+        use_kernel = allow_kernel and _kernel_eligible(
+            pool.block_size, mesh, config.kv_heads, tau.shape[0]
+        )
+
+        def body(carry, _):
+            pool, tau, tau_lp, fill, pos, active, remaining, keys = carry
+            # --- the host emit scan, on device ---
+            nonfinite = tau < 0
+            hit_stop = stop_token_hits(tau, stops)
+            out_tok = jnp.where(
+                active,
+                jnp.where(nonfinite, -1, tau),
+                _CHUNK_PAD,
+            ).astype(jnp.int32)
+            out_lp = tau_lp
+            done = active & (nonfinite | hit_stop | (remaining <= 1))
+            remaining = remaining - active.astype(jnp.int32)
+            active = active & ~done
+            # --- one decode iteration for the surviving rows ---
+            nxt, lp, keys, pool = _decode_step_core(
+                params, pool, table, n_alloc, fill, tau, pos, active,
+                keys, temperature, top_p, top_k, config=config,
+                all_greedy=all_greedy, use_kernel=use_kernel,
+                with_logprobs=with_logprobs,
             )
-            logits, pcache = forward(
-                params, tau[:, None], positions, config, cache=pcache,
-                attn_mask=active[:, None],
+            tau = jnp.where(active, nxt, tau)
+            if with_logprobs:
+                tau_lp = jnp.where(active, lp, tau_lp)
+            fill = fill + active
+            pos = pos + active
+            return (
+                (pool, tau, tau_lp, fill, pos, active, remaining, keys),
+                (out_tok, out_lp),
             )
-            pool = dataclasses.replace(
-                pool, k=pcache.k, v=pcache.v, pos=pcache.pos,
-                k_scale=pcache.k_scale, v_scale=pcache.v_scale,
+
+        carry, (toks, lps) = lax.scan(
+            body,
+            (pool, tau, tau_lp, fill, pos, active, remaining, keys),
+            None,
+            length=n_iter,
+        )
+        pool, tau, tau_lp, fill, pos, active, remaining, keys = carry
+        toks = jnp.swapaxes(toks, 0, 1)  # [B, K]
+        if with_logprobs:
+            # One packed transfer: fp32 logprobs ride bitcast to int32
+            # alongside the tokens, so logprobs mode still pays exactly
+            # one device->host fetch per chunk.
+            lp_bits = lax.bitcast_convert_type(
+                jnp.swapaxes(lps, 0, 1).astype(jnp.float32), jnp.int32
             )
+            packed = jnp.stack([toks, lp_bits])  # [2, B, K]
         else:
-            view = _gather_cache(pool, table, n_alloc, fill)
-            logits, view = forward(
-                params, tau[:, None], positions, config, cache=view,
-                attn_mask=active[:, None],
-            )
-            pool = _scatter_back(pool, view, table, fill, active, T=1)
-        if all_greedy:
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        else:
-            keys, subs = _split_rows(keys)
-            nxt = sample_rows(subs, logits[:, -1], temperature, top_p, top_k)
-        # with_logprobs is static (trace-time specialization, like
-        # all_greedy): without it the fp32 [B, V] cast + logsumexp never
-        # enter the compiled program.
-        lp = _token_logprob(logits[:, -1], nxt) if with_logprobs else None
-        # Non-finite guard: a row whose raw logits contain NaN/Inf gets
-        # the -1 token sentinel instead of a draw from garbage; the host
-        # emit scan fails just that request (tokens are never negative,
-        # so the sentinel cannot collide).  Folding the flag into tau
-        # keeps the guard free of extra device->host fetches.
-        nxt = jnp.where(finite_rows(logits[:, -1]), nxt, -1)
-        return nxt, lp, keys, pool
+            packed = toks[None]  # [1, B, K]
+        return (
+            packed, tau, tau_lp, fill, pos, active, remaining, keys, pool
+        )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(state, idx, rows):
+    """Update per-slot device-resident decode state for the (padded,
+    pow2-bucketed) row indices ``idx`` in ONE dispatch — the admission/
+    free/cancel sync primitive of the chunked path.  Pad entries carry
+    the out-of-range index n_slots and drop."""
+    return tuple(
+        a.at[idx].set(v.astype(a.dtype), mode="drop")
+        for a, v in zip(state, rows)
+    )
 
 
 def _token_logprob(logits: jnp.ndarray, tok: jnp.ndarray) -> jnp.ndarray:
@@ -931,6 +1105,13 @@ class ContinuousBatcher:
     reserves ceil((padded_prompt + max_new) / block_size) blocks and
     requests queue until their reservation fits.
 
+    ``decode_chunk`` fuses up to that many decode iterations per jitted
+    dispatch (module docstring, "Chunked decode"): each ``step()`` call
+    may emit up to K tokens per slot, token-identically to the K=1 loop,
+    at one host round-trip per chunk.  1 (the default) preserves the
+    classic one-dispatch-per-token behavior; serving entry points
+    (run.py ``--decode-chunk``) default higher.
+
     Passing ``draft_params``/``draft_config`` turns on speculative
     decoding inside the batcher: each step drafts ``n_draft`` tokens per
     slot and verifies them in one target forward.  Greedy slots emit
@@ -963,6 +1144,7 @@ class ContinuousBatcher:
         logprobs: bool = False,
         prefix_cache: bool = True,
         fault_injector: Optional[FaultInjector] = None,
+        decode_chunk: int = 1,
     ):
         # Raw construction arguments, captured before any derivation so
         # ``rebuild()`` (crash recovery) reproduces this batcher exactly
@@ -977,6 +1159,7 @@ class ContinuousBatcher:
             draft_config=draft_config, n_draft=n_draft, mesh=mesh,
             use_pallas_kernel=use_pallas_kernel, logprobs=logprobs,
             prefix_cache=prefix_cache, fault_injector=fault_injector,
+            decode_chunk=decode_chunk,
         )
         self.fault_injector = fault_injector
         if config.attn_impl not in ("xla", "auto"):
@@ -1078,14 +1261,24 @@ class ContinuousBatcher:
         self.drafts_accepted = 0
         self.prefix_requests_hit = 0
         self.prefix_blocks_reused = 0
-        # Host-side numpy mirrors; uploaded per step (tiny) — the KV pool
-        # is the only state that stays resident/donated on device.
+        # Host-side numpy mirrors of the per-slot decode state — the
+        # AUTHORITATIVE copy for all host bookkeeping (admission
+        # capacity, slot frees, replay).  The chunked decode path keeps
+        # DEVICE-RESIDENT twins (``d_*`` below) that are written
+        # incrementally at admission/free/cancel time via ``_scatter_rows``
+        # (one dispatch per batch of dirty rows) and advanced ON DEVICE
+        # by ``_paged_decode_chunk`` — steady-state decode uploads
+        # nothing and fetches one packed token block per chunk.  The
+        # speculative path (always K=1) still uploads the mirrors per
+        # round, as before.
         B, MB = n_slots, self.blocks_per_slot
         self.table = np.full((B, MB), self.n_blocks, np.int32)
         self.n_alloc = np.zeros((B,), np.int32)
         self.fill = np.zeros((B,), np.int32)
         self.tau = jnp.zeros((B,), jnp.int32)
         # Model logprob of each slot's pending tau (valid while active).
+        # The numpy mirror serves the speculative emit scan; the chunked
+        # path carries the device twin through the chunk program.
         self.tau_lp = np.zeros((B,), np.float32)
         self.pos = np.zeros((B,), np.int32)
         self.active = np.zeros((B,), bool)
@@ -1093,6 +1286,47 @@ class ContinuousBatcher:
         self.temp_arr = np.zeros((B,), np.float32)
         self.top_p_arr = np.ones((B,), np.float32)
         self.top_k_arr = np.zeros((B,), np.int32)
+        # Per-slot generation budget (max_new - emitted) and -1-padded
+        # per-slot stop sets — the on-device stop detection's inputs.
+        # The stop table's width grows in pow2 buckets as requests with
+        # larger stop sets arrive (bounded jit-cache growth).
+        self.remaining = np.zeros((B,), np.int32)
+        w0 = (
+            1 << max(len(self.default_stop) - 1, 0).bit_length()
+            if self.default_stop else 1
+        )
+        self.stop_tab = np.full((B, w0), -1, np.int32)
+        # decode_chunk: max fused decode iterations per dispatch (the
+        # effective K per dispatch adapts — see _pick_chunk — and is
+        # always a power of two <= this).  1 = the classic one-dispatch-
+        # per-token loop.
+        self.decode_chunk = max(1, int(decode_chunk))
+        # Device-resident twins (chunked path only).
+        self.d_table = jnp.asarray(self.table)
+        self.d_n_alloc = jnp.asarray(self.n_alloc)
+        self.d_fill = jnp.asarray(self.fill)
+        self.d_pos = jnp.asarray(self.pos)
+        self.d_active = jnp.asarray(self.active)
+        self.d_temps = jnp.asarray(self.temp_arr)
+        self.d_top_ps = jnp.asarray(self.top_p_arr)
+        self.d_top_ks = jnp.asarray(self.top_k_arr)
+        self.d_remaining = jnp.asarray(self.remaining)
+        self.d_stops = jnp.asarray(self.stop_tab)
+        self.d_tau_lp = jnp.zeros((B,), jnp.float32)
+        # Rows whose mirrors changed since the last device sync
+        # (admission / free / cancel); flushed in one _scatter_rows
+        # dispatch before the next chunk.
+        self._dirty_rows: set = set()
+        # Host-boundary instrumentation (asserted by make perf-smoke):
+        # device->host fetches and host->device state-sync dispatches
+        # performed by step()/admission — the quantities chunked decode
+        # exists to amortize.
+        self.host_syncs_total = 0
+        self.state_uploads_total = 0
+        self.decode_dispatches_total = 0
+        self.decode_chunk_last = 0
+        self._admit_dispatches = 0
+        self._admits_at_last_chunk = 0
 
         self.slots: Dict[int, Optional[_Slot]] = {
             b: None for b in range(n_slots)
@@ -1152,7 +1386,9 @@ class ContinuousBatcher:
         out, self.failed = self.failed, []
         return out
 
-    def _fail_slot(self, b: int, message: str) -> None:
+    def _fail_slot(
+        self, b: int, message: str, device_done: bool = False
+    ) -> None:
         """Fail slot ``b``'s request with ``message``: record it for
         ``pop_failed`` and free the slot.  The request's freshly written
         prompt blocks are UNPUBLISHED from the prefix index first — KV
@@ -1161,14 +1397,14 @@ class ContinuousBatcher:
         (``slot.shared`` leading ones) hold earlier healthy dispatches'
         KV and stay published — dropping a popular shared system
         prompt's chain over one poisoned suffix would cold-prefill the
-        whole fleet."""
+        whole fleet.  ``device_done`` — see ``_free_slot``."""
         slot = self.slots[b]
         assert slot is not None
         for blk in slot.blocks[slot.shared:]:
             self._drop_chain_entry(blk)
         self.failed.append((slot.request_id, message))
         self.nonfinite_rows_total += 1
-        self._free_slot(b)
+        self._free_slot(b, device_done=device_done)
 
     def submit(
         self,
@@ -1290,19 +1526,42 @@ class ContinuousBatcher:
             "prefix_requests_hit_total": self.prefix_requests_hit,
             "prefix_blocks_reused_total": self.prefix_blocks_reused,
             "nonfinite_rows_total": self.nonfinite_rows_total,
+            # Chunked-decode observability: the effective K of the most
+            # recent chunk dispatch, dispatch count, and the host-
+            # boundary traffic the chunking amortizes (syncs per emitted
+            # token trends toward 1/K in steady state).
+            "decode_chunk_size": self.decode_chunk_last,
+            "decode_dispatches_total": self.decode_dispatches_total,
+            "host_syncs_total": self.host_syncs_total,
+            "state_uploads_total": self.state_uploads_total,
+            "host_syncs_per_token": (
+                self.host_syncs_total / max(1, self.emitted_total)
+            ),
         })
         return out
 
     def step(self) -> List[Tuple]:
-        """One decode step for every active slot.
+        """One decode dispatch for every active slot.
 
-        Returns [(request_id, token, done)] for tokens emitted this step
-        (one per active slot; up to ``n_draft + 1`` per slot in
-        speculative mode).  With ``logprobs=True`` each tuple carries a
+        Returns [(request_id, token, done)] for tokens emitted this call
+        — up to the effective chunk size K per slot on the chunked path
+        (``decode_chunk`` > 1), up to ``n_draft + 1`` per slot in
+        speculative mode.  With ``logprobs=True`` each tuple carries a
         4th element: the token's model logprob (fp32 log-softmax of the
         raw logits — what ``engine.score`` reports for the position).
         Finished slots free their blocks and queued requests are
         admitted for the NEXT step.
+
+        Chunked decode contract (non-speculative path): one call runs K
+        fused decode iterations inside a single jitted program
+        (``_paged_decode_chunk``), with stop-token / max_new / non-finite
+        handling ON DEVICE, and pays exactly one device->host fetch (the
+        packed token block).  Batcher state lives device-resident; the
+        host mirrors advance by replaying the block.  K adapts: 1 when
+        an admission just landed, <= _QUEUED_CHUNK_CAP while the queue
+        holds capacity-blocked requests (slot turnaround / admission
+        latency), up to ``decode_chunk`` (pow2, clamped to the largest
+        remaining budget) once slots are steady.
         """
         self.last_step_features = set()
         if (
@@ -1319,15 +1578,207 @@ class ContinuousBatcher:
             # are rare relative to steps, so the extra [B] fetch stays
             # off the steady-state hot path.
             np.asarray(self.tau)
+            self.host_syncs_total += 1
         self._admit()
         if not any(s is not None for s in self.slots.values()):
             return []
+        if self.spec:
+            return self._step_spec()
+        return self._step_chunked()
 
+    _NONFINITE_MSG = (
+        "non-finite logits: the model produced NaN/Inf for "
+        "this request; it was aborted (server healthy)"
+    )
+
+    # Chunk clamp while the queue is capacity-blocked: small enough that
+    # a finishing slot is detected within a few iterations (bounded
+    # admission latency for the queue head), large enough that a
+    # SATURATED server — the normal high-throughput regime, where the
+    # queue is never empty — still amortizes the per-dispatch host
+    # overhead instead of reverting to one dispatch per token.
+    _QUEUED_CHUNK_CAP = 4
+
+    def _pick_chunk(self, admitted: bool) -> int:
+        """Effective K for the next chunk dispatch.  K=1 right after an
+        admission (the fresh request's first token should not wait out a
+        full chunk); K <= _QUEUED_CHUNK_CAP while the queue holds
+        capacity-blocked requests (their admission waits on a slot
+        finishing, which the host only learns at a chunk boundary);
+        otherwise the largest power of two <= min(decode_chunk, max
+        remaining budget) — pow2 throughout, so the jit cache holds
+        O(log decode_chunk) chunk programs."""
+        if self.decode_chunk <= 1 or admitted:
+            return 1
+        rem = max(
+            s.max_new - len(s.emitted)
+            for s in self.slots.values() if s is not None
+        )
+        k = max(1, min(self.decode_chunk, rem))
+        if self.queue:
+            k = min(k, self._QUEUED_CHUNK_CAP)
+        return 1 << (k.bit_length() - 1)
+
+    def _sync_device_rows(self) -> None:
+        """Flush host-side per-row state changes (admission / free /
+        cancel) to the device-resident twins in ONE ``_scatter_rows``
+        dispatch.  No dirty rows (the steady state) -> no upload."""
+        if not self._dirty_rows:
+            return
+        if self.d_stops.shape != self.stop_tab.shape:
+            # Stop-table width grew (pow2-bucketed): rebuild the device
+            # twin wholesale before the row scatter — admission-time
+            # only, and the array is [B, S] ints.
+            self.d_stops = jnp.asarray(self.stop_tab)
+        rows = sorted(self._dirty_rows)
+        self._dirty_rows.clear()
+        R = len(rows)
+        Rb = 1 << max(R - 1, 0).bit_length()  # pow2 jit-cache bucket
+        idx = np.full((Rb,), self.n_slots, np.int32)  # pads drop
+        idx[:R] = rows
+
+        def take(a: np.ndarray) -> jnp.ndarray:
+            out = np.zeros((Rb,) + a.shape[1:], a.dtype)
+            out[:R] = a[rows]
+            return jnp.asarray(out)
+
+        (self.d_table, self.d_n_alloc, self.d_fill, self.d_pos,
+         self.d_active, self.d_temps, self.d_top_ps, self.d_top_ks,
+         self.d_remaining, self.d_stops) = _scatter_rows(
+            (self.d_table, self.d_n_alloc, self.d_fill, self.d_pos,
+             self.d_active, self.d_temps, self.d_top_ps, self.d_top_ks,
+             self.d_remaining, self.d_stops),
+            jnp.asarray(idx),
+            (take(self.table), take(self.n_alloc), take(self.fill),
+             take(self.pos), take(self.active), take(self.temp_arr),
+             take(self.top_p_arr), take(self.top_k_arr),
+             take(self.remaining), take(self.stop_tab)),
+        )
+        self.state_uploads_total += 1
+
+    def _step_chunked(self) -> List[Tuple]:
+        """Non-speculative step: one fused K-iteration chunk dispatch,
+        one packed fetch, then the host replays the block to advance its
+        mirrors and emit events."""
+        # Admissions since the last chunk dispatch — including one this
+        # step() performed at the PREVIOUS call's trailing _admit().
+        admitted = self._admit_dispatches > self._admits_at_last_chunk
+        if admitted:
+            # Surface any async admission-dispatch error NOW, while
+            # last_dispatch_features still names the insert (the chunk's
+            # _record_dispatch below would otherwise steal attribution).
+            np.asarray(self.tau)
+            self.host_syncs_total += 1
+        self._admits_at_last_chunk = self._admit_dispatches
+        K = self._pick_chunk(admitted)
+        self._sync_device_rows()
+        # Injection site "step": fires BEFORE the chunk dispatch; an
+        # exception out of the dispatch (or its packed fetch below)
+        # reaches the caller with nothing appended to slot.emitted or
+        # delivered — recovery replays from the server's delivered-token
+        # record, exactly as in the K=1 contract.  The paged_kernel site
+        # fires once per CHUNK dispatch, not per token (same for the
+        # dispatch-attribution record).
+        feats: List[str] = []
+        if self.use_pallas_kernel and _kernel_eligible(
+            self.block_size, self.mesh, self.config.kv_heads,
+            self.n_slots,
+        ):
+            feats.append("paged_kernel")
+        self._record_dispatch(feats)
+        self._fault("step")
+        if "paged_kernel" in feats:
+            self._fault("paged_kernel")
+        self.steps_total += K
+        self.decode_dispatches_total += 1
+        self.decode_chunk_last = K
+        all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
+        (packed, self.tau, self.d_tau_lp, self.d_fill, self.d_pos,
+         self.d_active, self.d_remaining, self.keys,
+         self.pool) = _paged_decode_chunk(
+            self.params, self.pool, self.d_table, self.d_n_alloc,
+            self.d_fill, self.tau, self.d_tau_lp, self.d_pos,
+            self.d_active, self.d_remaining, self.d_stops, self.keys,
+            self.d_temps, self.d_top_ps, self.d_top_ks,
+            config=self.config, n_iter=K, all_greedy=all_greedy,
+            mesh=self.mesh, allow_kernel=self.use_pallas_kernel,
+            with_logprobs=self.logprobs,
+        )
+        # THE one device->host sync of the chunk: tokens (+ bitcast
+        # logprobs) in a single packed array.
+        arr = np.asarray(packed)
+        self.host_syncs_total += 1
+        toks = arr[0]
+        lps = arr[1].view(np.float32) if self.logprobs else None
+
+        out: List[Tuple] = []
+        forced_nan = self._take_nan()
+        for b, slot in self.slots.items():
+            if slot is None:
+                continue
+            if forced_nan:
+                # An armed ``nan`` fault (chaos drills) poisons the
+                # first active row, exactly like the K=1 emit scan; the
+                # row's chunk tokens are discarded (the request fails
+                # with a clean error either way).
+                forced_nan = False
+                self._fail_slot(b, self._NONFINITE_MSG)
+                continue
+            advanced = 0
+            ended = False
+            for i in range(toks.shape[1]):
+                tok = int(toks[b, i])
+                if tok == _CHUNK_PAD:
+                    break
+                if tok < 0:
+                    # On-device non-finite sentinel: the device already
+                    # folded the row out of the chunk; fail just this
+                    # request (tokens before the sentinel were emitted).
+                    self._fail_slot(
+                        b, self._NONFINITE_MSG, device_done=True
+                    )
+                    ended = True
+                    break
+                slot.emitted.append(tok)
+                self.emitted_total += 1
+                done = (
+                    tok in slot.stop_tokens
+                    or len(slot.emitted) >= slot.max_new
+                )
+                if self.logprobs:
+                    out.append((
+                        slot.request_id, tok, done, float(lps[b, i])
+                    ))
+                else:
+                    out.append((slot.request_id, tok, done))
+                if done:
+                    # The device made the same call mid-chunk (stop set
+                    # and budget live on device), so the row is already
+                    # inactive there — no deactivation upload needed.
+                    self._free_slot(b, device_done=True)
+                    ended = True
+                    break
+                advanced += 1
+            if not ended:
+                # Mirror advance by replay: the device ran one forward
+                # per emitted-and-continued token.
+                self.fill[b] += advanced
+                self.pos[b] += advanced
+                self.remaining[b] = slot.max_new - len(slot.emitted)
+        self._admit()
+        return out
+
+    def _step_spec(self) -> List[Tuple]:
+        """Speculative step (always one round per dispatch): emit each
+        active slot's pending tau, then draft + verify.  This path keeps
+        the classic per-round mirror uploads — chunking composes with
+        plain decode only (``_pick_chunk`` forces K=1 under spec)."""
         # Emit each active slot's current tau; free finished slots BEFORE
-        # the decode so a completing request doesn't pay for one more
+        # the round so a completing request doesn't pay for one more
         # forward whose output would be discarded.
         out: List[Tuple] = []
         taus = np.asarray(self.tau)
+        self.host_syncs_total += 1
         # Non-finite guard: a -1 tau is the step programs' sentinel for
         # "this row's logits contained NaN/Inf" — fail just that request
         # with a clean error instead of streaming a garbage token.  An
@@ -1340,11 +1791,7 @@ class ContinuousBatcher:
             tok = int(taus[b])
             if tok < 0 or forced_nan:
                 forced_nan = False
-                self._fail_slot(
-                    b,
-                    "non-finite logits: the model produced NaN/Inf for "
-                    "this request; it was aborted (server healthy)",
-                )
+                self._fail_slot(b, self._NONFINITE_MSG)
                 continue
             slot.emitted.append(tok)
             self.emitted_total += 1
@@ -1359,7 +1806,7 @@ class ContinuousBatcher:
             else:
                 out.append((slot.request_id, tok, done))
             if done:
-                self._free_slot(b)
+                self._free_slot(b, device_done=True)
 
         if any(s is not None for s in self.slots.values()):
             # Injection site "step": fires AFTER the emit/free scan above
@@ -1371,46 +1818,16 @@ class ContinuousBatcher:
             # The kernel/spec sites fire after "step" (same dispatch,
             # finer attribution: their exceptions carry a site name the
             # degradation layer maps to a quarantinable feature).
-            feats: List[str] = []
-            if self.spec:
-                feats.append("spec_decode")
-                if self._spec_kernel_ok():
-                    feats.append("paged_kernel")
-            elif self.use_pallas_kernel and _kernel_eligible(
-                self.block_size, self.mesh, self.config.kv_heads,
-                self.n_slots,
-            ):
+            feats: List[str] = ["spec_decode"]
+            if self._spec_kernel_ok():
                 feats.append("paged_kernel")
             self._record_dispatch(feats)
             self._fault("step")
-            if "spec_decode" in feats:
-                self._fault("spec_decode")
+            self._fault("spec_decode")
             if "paged_kernel" in feats:
                 self._fault("paged_kernel")
             self.steps_total += 1
-            if self.spec:
-                self._spec_tail(out)
-            else:
-                all_greedy = bool(
-                    np.all(self.temp_arr[self.active] == 0.0)
-                )
-                self.tau, step_lp, self.keys, self.pool = _paged_decode_step(
-                    self.params, self.pool,
-                    jnp.array(self.table), jnp.array(self.n_alloc),
-                    jnp.array(self.fill), self.tau, jnp.array(self.pos),
-                    jnp.array(self.active), self.keys,
-                    jnp.array(self.temp_arr), jnp.array(self.top_p_arr),
-                    jnp.array(self.top_k_arr),
-                    config=self.config, all_greedy=all_greedy,
-                    mesh=self.mesh, allow_kernel=self.use_pallas_kernel,
-                    with_logprobs=self.logprobs,
-                )
-                if self.logprobs:
-                    # np.array (copy): asarray of a jax array is a
-                    # read-only view, and _admit writes rows in place.
-                    self.tau_lp = np.array(step_lp)
-                self.fill += self.active
-                self.pos += self.active
+            self._spec_tail(out)
         self._admit()
         return out
 
@@ -1443,8 +1860,13 @@ class ContinuousBatcher:
         )
         outs = np.asarray(outs)
         acc = np.asarray(acc)
+        self.host_syncs_total += 2
         if self.logprobs:
             lps = np.asarray(lps)
+            self.host_syncs_total += 1
+        # NOTE: the per-row fill/pos advances below touch the numpy
+        # mirrors only — the spec path re-uploads them every round and
+        # never consumes the chunked path's device-resident twins.
         new_tau = np.zeros((self.n_slots,), np.int32)
         for b, slot in self.slots.items():
             if slot is None:
@@ -1455,11 +1877,7 @@ class ContinuousBatcher:
                 # logits held NaN/Inf; its round was never committed
                 # (all slots invalidated in-jit) — fail just this
                 # request.
-                self._fail_slot(
-                    b,
-                    "non-finite logits: the model produced NaN/Inf for "
-                    "this request; it was aborted (server healthy)",
-                )
+                self._fail_slot(b, self._NONFINITE_MSG)
                 continue
             self.drafts_proposed += self.n_draft
             self.drafts_accepted += a
@@ -1641,7 +2059,14 @@ class ContinuousBatcher:
         # latency apiece in this environment).
         self._invalidate_and_free(superseded)
 
-    def _free_slot(self, b: int) -> None:
+    def _free_slot(self, b: int, device_done: bool = False) -> None:
+        """Free slot ``b``.  ``device_done=True`` means the chunk program
+        already folded the row out of its on-device active mask (stop /
+        budget / non-finite detected in-jit), so no deactivation upload
+        is owed; a HOST-initiated free (cancel, forced-nan drill) must
+        mark the row dirty so the next chunk dispatch deactivates it on
+        device — a stale device-active row would keep decoding into
+        blocks the allocator may hand to someone else."""
         slot = self.slots[b]
         assert slot is not None
         # Keyed blocks with no remaining users are RETAINED (prefix
@@ -1668,6 +2093,10 @@ class ContinuousBatcher:
         self.n_alloc[b] = 0
         self.fill[b] = 0
         self.active[b] = False
+        self.remaining[b] = 0
+        self.stop_tab[b, :] = -1
+        if not device_done:
+            self._dirty_rows.add(b)
 
     def _suffix_pad(self, n_suffix_tokens: int, n_share: int) -> int:
         """Padded suffix length for the grouped suffix-insert: round to a
@@ -1690,6 +2119,26 @@ class ContinuousBatcher:
         nb_b = 1 << (nb - 1).bit_length()
         cap = self.blocks_per_slot - n_share
         return (min(nb_b, cap) if cap >= nb else nb) * self.block_size
+
+    def _ensure_stop_width(self, n: int) -> None:
+        """Grow the -1-padded per-slot stop table to hold ``n`` stops
+        (pow2-bucketed width, so the chunk program's jit cache sees
+        O(log max_stops) shapes).  The device twin is rebuilt wholesale
+        at the next ``_sync_device_rows``."""
+        if n <= self.stop_tab.shape[1]:
+            return
+        w = 1 << (n - 1).bit_length()
+        tab = np.full((self.n_slots, w), -1, np.int32)
+        tab[:, : self.stop_tab.shape[1]] = self.stop_tab
+        self.stop_tab = tab
+
+    def _set_stop_row(self, b: int, stops: frozenset) -> None:
+        """Write slot ``b``'s stop set into the on-device stop table's
+        host mirror (order irrelevant — membership test only)."""
+        self._ensure_stop_width(max(1, len(stops)))
+        self.stop_tab[b, :] = -1
+        if stops:
+            self.stop_tab[b, : len(stops)] = sorted(stops)
 
     def _row_bucket(self, reqs: List["_Request"]):
         """Shared admission-row-bucket setup: the pow2 row count (jit
@@ -1781,6 +2230,7 @@ class ContinuousBatcher:
         # never executed.
         self._record_dispatch(["prefix_cache"])
         self._fault("suffix_insert")
+        self._admit_dispatches += 1
         tau, tau_lp, keys_out, self.pool = _paged_suffix_insert(
             self.params, self.pool, jnp.asarray(table_rows),
             jnp.asarray(n_alloc_arr), jnp.asarray(fill0s),
@@ -1808,7 +2258,12 @@ class ContinuousBatcher:
         idx = jnp.asarray(np.asarray(slots, np.int32))
         self.tau = self.tau.at[idx].set(tau[:k])
         if self.logprobs:
-            self.tau_lp[np.asarray(slots)] = np.asarray(tau_lp)[:k]
+            # Device twin always; the numpy mirror only feeds the
+            # speculative emit scan (fetching it costs an admission-time
+            # device->host sync the chunked path doesn't need).
+            self.d_tau_lp = self.d_tau_lp.at[idx].set(tau_lp[:k])
+            if self.spec:
+                self.tau_lp[np.asarray(slots)] = np.asarray(tau_lp)[:k]
         self.keys = self.keys.at[idx].set(keys_out[:k])
         for i, (req, chain, hits) in enumerate(grp):
             b = slots[i]
@@ -1823,6 +2278,9 @@ class ContinuousBatcher:
             self.temp_arr[b] = req.temperature
             self.top_p_arr[b] = req.top_p
             self.top_k_arr[b] = req.top_k
+            self.remaining[b] = req.max_new
+            self._set_stop_row(b, req.stops)
+            self._dirty_rows.add(b)
             self.slots[b] = _Slot(
                 request_id=req.rid, emitted=[], max_new=req.max_new,
                 stop_tokens=req.stops, blocks=blocks, shared=n_share,
@@ -1943,6 +2401,7 @@ class ContinuousBatcher:
             self._fault("insert")
             if flash:
                 self._fault("flash_kernel")
+            self._admit_dispatches += 1
             taus, tau_lps, plens, keys_out, self.pool = _paged_insert(
                 self.params, self.pool, jnp.asarray(bid),
                 jnp.asarray(pt), jnp.asarray(pm), jnp.asarray(keys),
@@ -1969,7 +2428,11 @@ class ContinuousBatcher:
             idx = jnp.asarray(np.asarray(slot_ids, np.int32))
             self.tau = self.tau.at[idx].set(taus[:k])
             if self.logprobs:
-                self.tau_lp[np.asarray(slot_ids)] = np.asarray(tau_lps)[:k]
+                self.d_tau_lp = self.d_tau_lp.at[idx].set(tau_lps[:k])
+                if self.spec:
+                    self.tau_lp[np.asarray(slot_ids)] = (
+                        np.asarray(tau_lps)[:k]
+                    )
             self.keys = self.keys.at[idx].set(keys_out[:k])
             plens_np = np.asarray(plens)
             for i, req in enumerate(batch):
@@ -1984,6 +2447,9 @@ class ContinuousBatcher:
                 self.temp_arr[b] = req.temperature
                 self.top_p_arr[b] = req.top_p
                 self.top_k_arr[b] = req.top_k
+                self.remaining[b] = req.max_new
+                self._set_stop_row(b, req.stops)
+                self._dirty_rows.add(b)
                 self.slots[b] = _Slot(
                     request_id=req.rid, emitted=[], max_new=req.max_new,
                     stop_tokens=req.stops, blocks=blocks,

@@ -264,6 +264,58 @@ def test_paged_decode_step_no_full_pool_copies_compiled():
 
 
 @requires_tpu
+def test_paged_decode_chunk_no_full_pool_copies_compiled():
+    """The fused K-iteration chunk program (the serving hot path since
+    chunked decode) must uphold the same no-full-pool-copy invariant as
+    the single-step program above: the pool rides the decode scan as a
+    donated carry, and the classic way THAT breaks is XLA materializing
+    a pool-sized copy at the scan boundary — which would double KV HBM
+    and regress ~ms/step silently.  Same HLO-text assertion, against the
+    n_iter=4 chunk executable with the device-resident state args the
+    batcher actually dispatches."""
+    import re
+
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.serving import ContinuousBatcher
+
+    cfg = get_config(
+        "tiny", dim=256, n_layers=4, n_heads=4, n_kv_heads=2,
+        vocab_size=512, max_seq_len=256, param_dtype="bfloat16",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cb = ContinuousBatcher(params, cfg, n_slots=4, max_len=256,
+                           block_size=32, decode_chunk=4)
+    rng = np.random.RandomState(5)
+    for _ in range(4):
+        cb.submit(list(rng.randint(1, cfg.vocab_size, 100)),
+                  max_new_tokens=8)
+    cb.step()  # admission; chunk program now has concrete args
+
+    from jax_llama_tpu import serving as srv
+
+    L, KVH = cfg.n_layers, cfg.kv_heads
+    NB, BLK = cb.pool.pos.shape
+    d = cfg.head_dim
+    lowered = srv._paged_decode_chunk.lower(
+        cb.params, cb.pool, cb.d_table, cb.d_n_alloc, cb.d_fill,
+        cb.tau, cb.d_tau_lp, cb.d_pos, cb.d_active, cb.d_remaining,
+        cb.d_stops, cb.keys, cb.d_temps, cb.d_top_ps, cb.d_top_ks,
+        config=cb.config, n_iter=4, all_greedy=True, mesh=None,
+        allow_kernel=True, with_logprobs=False,
+    )
+    txt = lowered.compile().as_text()
+    pool_shape = rf"{L},{KVH},{NB},{BLK},{d}"
+    plane_shape = rf"{KVH},{NB},{BLK},{d}"
+    offenders = [
+        line.strip()[:140]
+        for line in txt.splitlines()
+        if re.search(rf"(copy|dynamic-slice)[^=]*=[^=]*\[({pool_shape}|{plane_shape})\]", line)
+        or (" copy(" in line and f"[{pool_shape}]" in line)
+    ]
+    assert not offenders, offenders
+
+
+@requires_tpu
 def test_device_op_times_compiled():
     """utils.profiling.device_op_times — the measurement primitive behind
     every bench/ROADMAP perf number — attributes device time to a known
