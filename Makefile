@@ -24,13 +24,14 @@ chaos:
 	$(PYTEST) tests/ -q -m 'chaos or faults'
 
 # Tier-1-safe perf guardrails (CPU, no accelerator needed): chunked
-# decode's host-boundary discipline — an instrumented counter test
-# asserting <= 1 device->host sync and 0 steady-state host->device
-# state uploads per chunk dispatch — plus the K>1 vs K=1 token-identity
-# matrix.  These also run inside tier1; this target is the fast
-# pre-push slice.
+# decode's AND chunked speculative serving's host-boundary discipline —
+# instrumented counter tests asserting <= 1 device->host sync and 0
+# steady-state host->device state uploads per fused dispatch (K decode
+# iterations or R draft+verify rounds) — plus the K>1 vs K=1 and
+# spec_rounds>1 vs 1 token-identity matrices.  These also run inside
+# tier1; this target is the fast pre-push slice.
 perf-smoke:
-	$(PYTEST) tests/test_perf_smoke.py tests/test_serving_chunked.py -q -m 'not slow'
+	$(PYTEST) tests/test_perf_smoke.py tests/test_serving_chunked.py tests/test_serving_spec.py -q -m 'not slow'
 
 # On-chip kernel regressions (run on a TPU host; self-skip elsewhere).
 tpu:

@@ -322,19 +322,52 @@ def main() -> None:
         chunk_sweep[f"K{K}"] = round(n_k / t_k, 2)
 
     # ------------------------------------------------------------------
-    # Speculative serving (target as its own draft => 100% acceptance):
-    # isolates the speculative round's mechanics.  Kernel path (T=1 draft
-    # steps + one multi-token verify kernel pass, pools never gathered)
-    # vs the gathered-view fallback via the explicit toggle — SAME block
-    # size and pool geometry on both sides, so the delta is purely the
-    # attention path (the r3 version forced the fallback with an odd
-    # block size, which also changed pool capacity and queueing).
+    # Speculative serving.  The draft is the target NUDGED by ~2%
+    # deterministic relative noise (below): acceptance stays high — the
+    # regime speculative decoding targets — but strictly < 1, so the
+    # Leviathan reject/replacement path is actually exercised (the old
+    # self-draft setup reported spec_serving_kernel_acceptance 1.0 on
+    # the gathered path and its "kernel acceptance < 1" was a bf16
+    # tiling artifact, not a verified rejection).  The HEADLINE runs
+    # FUSED rounds (spec_rounds=8: up to 8 draft+verify rounds per
+    # jitted dispatch with batcher state device-resident — BENCH_r05
+    # measured the per-round loop at 46.3 tok/s wall vs 927.4 device,
+    # ~20x host/tunnel overhead, the worst gap in the repo);
+    # spec_serving_rounds_sweep records where that gap goes.  Kernel vs
+    # gathered-view fallback at IDENTICAL block size and pool geometry,
+    # as before.
     # ------------------------------------------------------------------
-    def spec_run(use_kernel):
+    import zlib
+
+    def _perturbed_draft(p):
+        """±2% relative Gaussian nudge on every float leaf, keyed by a
+        stable per-leaf path hash (crc32, NOT Python's salted hash()):
+        a deterministic draft that closely tracks the target without
+        equalling it — the same logits family, slightly wrong."""
+        base_key = jax.random.PRNGKey(42)
+
+        def nudge(path, x):
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                return x
+            key = jax.random.fold_in(
+                base_key,
+                zlib.crc32(jax.tree_util.keystr(path).encode())
+                & 0x7FFFFFFF,
+            )
+            noise = jax.random.normal(key, x.shape, jnp.float32)
+            return (
+                x.astype(jnp.float32) * (1.0 + 0.02 * noise)
+            ).astype(x.dtype)
+
+        return jax.tree_util.tree_map_with_path(nudge, p)
+
+    draft_params = _perturbed_draft(params)
+
+    def spec_run(use_kernel, spec_rounds=8):
         cb = ContinuousBatcher(
             params, config, n_slots=4, max_len=1024, block_size=128,
-            draft_params=params, draft_config=config, n_draft=3,
-            use_pallas_kernel=use_kernel,
+            draft_params=draft_params, draft_config=config, n_draft=3,
+            use_pallas_kernel=use_kernel, spec_rounds=spec_rounds,
         )
         _salt[0] += 1
         srng = np.random.RandomState(2000 + _salt[0])  # salted prompts
@@ -347,12 +380,21 @@ def main() -> None:
             emitted += len(cb.step())
         return time.time() - t0, emitted, cb.stats()["draft_acceptance_rate"]
 
-    spec_run(True)  # warmup
+    spec_run(True)  # warmup (insert + fused-round programs, R ramp)
     sk_t, sk_n, spec_kernel_accept = min(spec_run(True) for _ in range(3))
     spec_kernel_toks_per_s = sk_n / sk_t
     spec_run(False)  # warmup
     sg_t, sg_n, spec_gathered_accept = min(spec_run(False) for _ in range(3))
     spec_gathered_toks_per_s = sg_n / sg_t
+
+    # Spec-rounds sweep (wall tok/s at R ∈ {1, 2, 4, 8}, kernel path):
+    # R1 reproduces the pre-fusion one-dispatch-per-round loop — the
+    # r05 46.3 tok/s baseline — so R8/R1 is the dispatch-amortization
+    # win.  R8 is the headline above (min-of-3); smaller Rs min-of-2.
+    spec_rounds_sweep = {"R8": round(spec_kernel_toks_per_s, 2)}
+    for R in (1, 2, 4):
+        t_r, n_r, _ = min(spec_run(True, spec_rounds=R) for _ in range(2))
+        spec_rounds_sweep[f"R{R}"] = round(n_r / t_r, 2)
 
     # Larger serving batch (B=16): decode is weight-bandwidth-bound, so
     # tokens/sec/chip scales with rows — extra evidence beyond the
@@ -704,36 +746,55 @@ def main() -> None:
         except Exception:
             serve_device = None
         try:
+            # Fused batcher (the headline's configuration):
+            # device_ms_per_round normalizes by the ROUNDS the traced
+            # window executed (steps_total delta — each fused dispatch
+            # carries up to R=8), keeping the figure per-round-
+            # comparable with the classic loop's per-dispatch number;
+            # the acceptance bar is that fusing R rounds into one
+            # program does not regress per-round device time.
             cb = ContinuousBatcher(
                 params, config, n_slots=4, max_len=1024, block_size=128,
-                draft_params=params, draft_config=config, n_draft=3,
+                draft_params=draft_params, draft_config=config,
+                n_draft=3, spec_rounds=8,
             )
             _salt[0] += 1
             srng = np.random.RandomState(7000 + _salt[0])
             for _ in range(4):
+                # max_new 96 (512 + 96 <= 1024) so the traced window
+                # holds full fused chunks.
                 cb.submit(list(srng.randint(1, config.vocab_size, 500)),
-                          max_new_tokens=48)
-            cb.step(); cb.step()  # admission + spec-round compile warmup
+                          max_new_tokens=96)
+            cb.step(); cb.step()  # admission + fused-round compile warmup
             emitted = [0]
+            rounds0 = cb.steps_total
 
             def _rounds():
-                emitted[0] = sum(len(cb.step()) for _ in range(6))
+                emitted[0] = sum(len(cb.step()) for _ in range(4))
 
             agg = device_op_times(_rounds, by="source")
+            rounds = max(cb.steps_total - rounds0, 1)
             while cb.pending():
                 cb.step()
-            ms = sum(agg.values()) / 6 / 1e9
+            ms = sum(agg.values()) / rounds / 1e9
             spec_device = {
                 "device_ms_per_round": round(ms, 2),
                 # Tokens actually emitted over the traced rounds — the
                 # honest numerator for a speculative round (acceptance
                 # decides it, not the slot count).
                 "device_tokens_per_s": round(
-                    emitted[0] / 6 / ms * 1e3, 1
+                    emitted[0] / rounds / ms * 1e3, 1
                 ),
+                "traced_rounds": rounds,
             }
         except Exception:
             spec_device = None
+        finally:
+            # Last consumer of the perturbed draft copy: free its ~2 GB
+            # (and the batcher still referencing it + its pools) before
+            # the training section allocates its 6 GB state.
+            cb = None  # noqa: F841
+            draft_params = None  # noqa: F841
 
         # --------------------------------------------------------------
         # Training step throughput (the subsystem the reference lacks
@@ -958,15 +1019,15 @@ def main() -> None:
             # One AdamW train step, B=4 x S=2048, bf16 + remat + flash
             # VJP (device time; MFU excludes remat recompute).
             "training": train_metrics,
-            # Speculative serving (self-draft, n_draft=3): Pallas path
-            # (T=1 draft steps + multi-token verify kernel) vs the
-            # gathered-view fallback at IDENTICAL pool geometry.  NOTE:
-            # self-draft acceptance on the kernel path is <1.0 because
-            # the draft chain (T=1 tiles) and verify (T=4 tiles) differ
-            # in fp reduction order and a bf16 near-tie argmax flips —
-            # tokens stay correct (rejections fall back to the target's
-            # token), it just costs extra rounds; the acceptance fields
-            # attribute any throughput gap between the two paths.
+            # Speculative serving (perturbed-target draft, n_draft=3,
+            # FUSED spec_rounds=8 headline): Pallas path (T=1-shaped
+            # draft chain + multi-token verify kernel) vs the
+            # gathered-view fallback at IDENTICAL pool geometry.  The
+            # draft is the target nudged by ±2% deterministic noise, so
+            # acceptance is genuinely < 1 and the reject/replacement
+            # path is exercised (self-draft used to pin it at 1.0);
+            # the acceptance fields attribute any throughput gap
+            # between the two paths.
             "spec_serving_kernel_tokens_per_s": round(
                 spec_kernel_toks_per_s, 2
             ),
@@ -977,19 +1038,32 @@ def main() -> None:
             "spec_serving_gathered_acceptance": round(
                 spec_gathered_accept, 3
             ),
-            # Device-time per speculative round (kernel path) — closes
-            # the one unmeasured r4 perf claim (the verify-shaped draft
-            # chain's "cost is a wash").
+            # Wall tok/s at spec_rounds R ∈ {1, 2, 4, 8} (kernel path):
+            # R1 reproduces the pre-fusion per-round loop (the r05
+            # 46.3 tok/s baseline), so R8/R1 is the fused-dispatch
+            # amortization win.
+            "spec_serving_rounds_sweep": spec_rounds_sweep,
+            # Device-time per speculative round (kernel path, fused
+            # batcher, steps_total-normalized) — the jitter-immune
+            # denominator for the host-overhead ratios below.
             "spec_serving_device": spec_device,
-            # Same wall-vs-device host-overhead ratio for the
-            # speculative drain (spec rounds stay one-dispatch-per-round
-            # — chunking composes with plain decode only — so this
-            # ratio is the remaining per-round tunnel cost).
+            # Wall-vs-device host-overhead ratios for the speculative
+            # drain (>= 1; 1.0 = the host/tunnel adds nothing): the
+            # headline fused-R8 figure, and the R1 classic-loop
+            # companion (r05 measured ~20x there) the fusion is
+            # amortizing away.
             "spec_serving_host_overhead_ratio": (
                 round(
                     spec_device["device_tokens_per_s"]
                     / spec_kernel_toks_per_s, 2
                 ) if spec_device else None
+            ),
+            "spec_serving_host_overhead_ratio_r1": (
+                round(
+                    spec_device["device_tokens_per_s"]
+                    / spec_rounds_sweep["R1"], 2
+                ) if spec_device and spec_rounds_sweep.get("R1")
+                else None
             ),
             # Batch-16 steady-state decode (headline stays B=8 for
             # round-over-round comparability; wall + device).

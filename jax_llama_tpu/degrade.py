@@ -13,6 +13,15 @@ accelerated feature it runs (``serving.py``):
   prefix_cache        cold full prefill (``prefix_cache=False``)
   ==================  =============================================
 
+Quarantine swaps ONLY the failing feature: a ``spec_decode`` fallback
+rebuild drops the draft model but keeps the original ``decode_chunk``
+and ``spec_rounds`` configuration (the rebuild reuses the base ctor
+kwargs), so a quarantined speculative server degrades onto plain
+CHUNKED decode, not the per-token loop — and a later probe re-enable
+restores fused speculative serving with the same R.  Failures are
+attributed once per fused chunk dispatch (the R rounds inside one
+jitted program are one dispatch).
+
 PR 1 gave the server crash *recovery* (rebuild + replay); this module
 gives it a notion of *degraded* operation: a Pallas kernel that starts
 failing on real hardware (a Mosaic compile regression, a driver fault,

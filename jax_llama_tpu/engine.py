@@ -118,12 +118,13 @@ def finite_rows(logits: jnp.ndarray) -> jnp.ndarray:
     logprob of any sample is undefined.  The serving step programs fold
     this flag into a -1 token sentinel so the batcher can fail just the
     poisoned request with a clean error instead of emitting from a
-    corrupt distribution; serving's fused chunk program
-    (``_paged_decode_chunk``) additionally folds the sentinel row out of
-    its on-device active mask mid-chunk, so a poisoned request stops
-    attending and writing without a host round-trip (raw logits from a
-    healthy model are always finite; -inf only ever appears post-warp,
-    which this guard runs before)."""
+    corrupt distribution; serving's fused chunk programs
+    (``_paged_decode_chunk``, and ``_spec_rounds_chunk`` via the
+    speculative verify's -1 *acceptance* sentinel) additionally fold the
+    sentinel row out of their on-device active masks mid-chunk, so a
+    poisoned request stops attending and writing without a host
+    round-trip (raw logits from a healthy model are always finite; -inf
+    only ever appears post-warp, which this guard runs before)."""
     return jnp.all(jnp.isfinite(logits.astype(jnp.float32)), axis=-1)
 
 

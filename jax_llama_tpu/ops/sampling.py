@@ -82,16 +82,19 @@ def stop_token_hits(
     stop detection, so a fused multi-token decode chunk can fold
     finished rows out of its active mask without a host round-trip.
 
-    tokens: [B] int32 pending tokens.  Negative values (the serving
-    layer's non-finite sentinel, or stale inactive-row state) never
-    match — the guard below keeps them from colliding with the table's
-    -1 padding.
+    tokens: [B] int32 pending tokens, or [B, T] token blocks (the fused
+    speculative chunk checks a whole round's accepted drafts at once).
+    Negative values (the serving layer's non-finite sentinel, or stale
+    inactive-row state) never match — the guard below keeps them from
+    colliding with the table's -1 padding.
     stop_table: [B, S] int32, each row's stop set right-padded with -1
     (rows with fewer than S stops, or none at all).
-    Returns [B] bool, True where the row's token is one of its stops.
+    Returns bool of ``tokens``' shape, True where the token is one of
+    its row's stops.
     """
+    tab = stop_table[:, None, :] if tokens.ndim == 2 else stop_table
     return jnp.any(
-        (tokens[:, None] >= 0) & (tokens[:, None] == stop_table), axis=1
+        (tokens[..., None] >= 0) & (tokens[..., None] == tab), axis=-1
     )
 
 

@@ -63,8 +63,25 @@ def main() -> None:
                          "batcher state live on device, the host syncs "
                          "once per chunk instead of once per token; "
                          "effective K adapts down to 1 around "
-                         "admissions and under speculative decode; "
-                         "1 restores the classic per-token loop)")
+                         "admissions; 1 restores the classic per-token "
+                         "loop.  Speculative serving chunks by ROUNDS "
+                         "through --spec-rounds instead)")
+    ap.add_argument("--draft-ckpt-dir", default=None,
+                    help="Orbax checkpoint dir of a DRAFT model for "
+                         "speculative serving in --serve / --http "
+                         "(must share the target's vocabulary; the "
+                         "draft only changes speed, never content)")
+    ap.add_argument("--n-draft", type=int, default=4,
+                    help="draft tokens proposed per speculative round "
+                         "(with --draft-ckpt-dir)")
+    ap.add_argument("--spec-rounds", type=int, default=8,
+                    help="fuse up to this many speculative draft+verify "
+                         "rounds per jitted dispatch (the speculative "
+                         "twin of --decode-chunk; token-identical to 1 "
+                         "including the acceptance pattern; the "
+                         "effective R adapts down to 1 around "
+                         "admissions; 1 restores the classic "
+                         "per-round loop)")
     ap.add_argument("--http", type=int, default=None, metavar="PORT",
                     help="serve over HTTP on this port (POST /generate "
                          "with blocking or NDJSON-streaming responses, "
@@ -217,6 +234,24 @@ def main() -> None:
     print(f"\n[{stats.summary()}] (incl. compile)")
 
 
+def _load_draft(args, mesh):
+    """Optional speculative-serving draft model (--draft-ckpt-dir):
+    returns (draft_params, draft_config) or (None, None).  Loaded the
+    same sharded way as the target; attn_impl follows the --attn
+    override so both models resolve the same attention paths."""
+    ckpt = getattr(args, "draft_ckpt_dir", None)
+    if not ckpt:
+        return None, None
+    from .convert.checkpoint import load_checkpoint
+
+    draft_params, draft_config = load_checkpoint(
+        ckpt, mesh=mesh, fsdp=args.fsdp > 1
+    )
+    if args.attn:
+        draft_config = draft_config.replace(attn_impl=args.attn)
+    return draft_params, draft_config
+
+
 def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
     """HTTP front-end: LLMServer over the batcher until interrupted.
 
@@ -251,6 +286,7 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         # failure mode — the batcher fires the same sites per dispatch.
         install_trace_hook(injector.fire)
         print(f"fault injection armed: {fault_spec}", flush=True)
+    draft_params, draft_config = _load_draft(args, mesh)
     cb = ContinuousBatcher(
         params, config, n_slots=args.slots,
         max_len=config.max_seq_len, stop_tokens=stops,
@@ -260,6 +296,9 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         prefix_cache=not getattr(args, "no_prefix_cache", False),
         fault_injector=injector,
         decode_chunk=getattr(args, "decode_chunk", 8),
+        draft_params=draft_params, draft_config=draft_config,
+        n_draft=getattr(args, "n_draft", 4),
+        spec_rounds=getattr(args, "spec_rounds", 8),
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).
@@ -355,6 +394,7 @@ def _serve(params, config, tokenizer, mesh, args) -> None:
     stops = tuple(
         int(s) for s in getattr(tokenizer, "stop_tokens", [tokenizer.eos_id])
     )
+    draft_params, draft_config = _load_draft(args, mesh)
     cb = ContinuousBatcher(
         params, config, n_slots=args.slots,
         max_len=config.max_seq_len, stop_tokens=stops,
@@ -362,6 +402,9 @@ def _serve(params, config, tokenizer, mesh, args) -> None:
         seed=args.seed, mesh=mesh,
         prefix_cache=not getattr(args, "no_prefix_cache", False),
         decode_chunk=getattr(args, "decode_chunk", 8),
+        draft_params=draft_params, draft_config=draft_config,
+        n_draft=getattr(args, "n_draft", 4),
+        spec_rounds=getattr(args, "spec_rounds", 8),
     )
     rid_prompt: dict = {}
     emitted: dict = {}

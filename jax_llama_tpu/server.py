@@ -19,14 +19,29 @@ parity.  Design constraints, in order:
     (tokens, steps, slot/block occupancy, speculative acceptance) in
     Prometheus text format; ``GET /healthz`` for liveness.  Chunked
     decode adds: ``llm_decode_chunk_size`` (gauge — the effective K of
-    the most recent fused decode dispatch; 1 around admissions and
-    under speculative decode), ``llm_decode_dispatches_total``
+    the most recent fused decode dispatch; 1 around admissions; under
+    speculative serving it mirrors the fused ROUND count R),
+    ``llm_decode_dispatches_total``
     (counter — jitted decode dispatches; tokens/dispatch trends toward
     K), ``llm_host_syncs_total`` / ``llm_state_uploads_total``
     (counters — device->host fetches and host->device state-sync
     dispatches the serving loop performed), and
     ``llm_host_syncs_per_token`` (gauge — trends toward 1/K in steady
     state; ~1.0 means the loop is paying one round-trip per token).
+    Speculative serving (a batcher with a draft model) adds:
+    ``llm_spec_rounds_per_dispatch`` (gauge — the effective R of the
+    most recent fused draft+verify dispatch; 1 right after an
+    admission, powers of two up to ``--spec-rounds`` once slots are
+    steady), ``llm_spec_dispatches_total`` (counter — jitted
+    speculative dispatches, each carrying R rounds),
+    ``llm_spec_host_syncs_per_token`` (gauge — the speculative twin of
+    host_syncs_per_token: device->host fetches per emitted token on
+    the spec path; trends toward 1 / (R * (acceptance * n_draft + 1))
+    under the fused path, vs the 2-3 fetches PER ROUND the classic
+    loop pays), and ``llm_spec_window_acceptance_rate`` (gauge —
+    draft-token acceptance over the last 64 dispatches; unlike the
+    lifetime ``llm_draft_acceptance_rate`` it shows a draft going
+    stale mid-run).
   * **Chunked decode is transparent here.**  The batcher's ``step()``
     may return up to K tokens per slot per call
     (``serving.ContinuousBatcher`` ``decode_chunk``, run.py

@@ -66,6 +66,26 @@ TPU-native mechanics:
     token-identical to K=1 under greedy and seeded sampling — per-row
     key chains split once per iteration exactly as one K=1 dispatch
     would (pinned by tests/test_serving_chunked.py).
+  * **Chunked speculative serving.**  With ``spec_rounds`` > 1 the
+    speculative path gets the same treatment: R draft+verify rounds
+    fuse into ONE jitted ``lax.scan`` program (``_spec_rounds_chunk``,
+    sharing ``_spec_round_core`` with the kept single-round program),
+    with the per-round host work moved on device — the pending-tau
+    emit, the accepted-prefix emit scan with stop-token / max_new /
+    non-finite folding (``spec_decode.accepted_emit_counts``), the
+    fill rewind to ``+acc+1`` after each verify, and mid-chunk
+    fold-out of finished rows.  Host-boundary accounting: the classic
+    loop paid 2-3 device->host fetches (tau, outs/acc, logprobs) plus
+    FIVE mirror uploads (table/n_alloc/fill/pos/active + policies)
+    PER ROUND; the fused path pays ONE packed [B, R, G+2(+G+1)] fetch
+    per R rounds and zero steady-state uploads — both the target and
+    draft pools and all per-slot decode state are device-resident via
+    the same ``d_*`` twins / dirty-row ``_scatter_rows`` sync the
+    plain chunked path uses.  R adapts exactly like K (1 after an
+    admission, clamped while capacity-blocked, pow2 up to
+    ``spec_rounds``), and chunked output is token-identical to the
+    classic per-round path — including the acceptance pattern and
+    per-token logprobs (pinned by tests/test_serving_spec.py).
 """
 
 from __future__ import annotations
@@ -73,7 +93,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import hashlib
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -96,7 +116,12 @@ from .models.llama import (
 from .ops.attention import NEG_INF
 from .ops.sampling import stop_token_hits
 from .parallel.mesh import use_mesh
-from .spec_decode import draft_categorical, leviathan_verify, place_extra
+from .spec_decode import (
+    accepted_emit_counts,
+    draft_categorical,
+    leviathan_verify,
+    place_extra,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -806,22 +831,18 @@ def _cache_into_pool(pool: BlockPool, pcache: PagedKVCache) -> BlockPool:
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "t_config", "d_config", "n_draft", "all_greedy", "use_kernel",
-        "mesh", "with_logprobs",
-    ),
-    donate_argnames=("t_pool", "d_pool"),
-)
-def _spec_round(
+def _spec_round_core(
     t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau, pos,
     active, keys, temperature, top_p, top_k, *,
     t_config, d_config, n_draft, all_greedy, use_kernel, mesh=None,
     with_logprobs=False,
 ):
     """One speculative round for every active slot — greedy or sampled
-    verification, per-row policies.
+    verification, per-row policies.  The shared row-wise draft/verify
+    body of the single-round program (``_spec_round``) and each
+    ``lax.scan`` iteration of the fused R-round chunk program
+    (``_spec_rounds_chunk``), so the two cannot drift numerically (the
+    same discipline ``_decode_step_core`` enforces for plain decode).
 
     Draft proposes ``n_draft`` tokens autoregressively, the target
     verifies them in ONE [B, n_draft+1] forward (weights stream once per
@@ -1052,6 +1073,195 @@ def _spec_round(
         return outs, acc, lps, keys_out, t_pool, d_pool
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "t_config", "d_config", "n_draft", "all_greedy", "use_kernel",
+        "mesh", "with_logprobs",
+    ),
+    donate_argnames=("t_pool", "d_pool"),
+)
+def _spec_round(
+    t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau, pos,
+    active, keys, temperature, top_p, top_k, *,
+    t_config, d_config, n_draft, all_greedy, use_kernel, mesh=None,
+    with_logprobs=False,
+):
+    """One jitted speculative round — the classic one-dispatch-per-round
+    program (``spec_rounds=1``); a thin jit wrapper over
+    ``_spec_round_core`` (see its docstring for the full contract)."""
+    return _spec_round_core(
+        t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau,
+        pos, active, keys, temperature, top_p, top_k,
+        t_config=t_config, d_config=d_config, n_draft=n_draft,
+        all_greedy=all_greedy, use_kernel=use_kernel, mesh=mesh,
+        with_logprobs=with_logprobs,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "t_config", "d_config", "n_draft", "n_rounds", "all_greedy",
+        "use_kernel", "mesh", "with_logprobs",
+    ),
+    donate_argnames=(
+        "t_pool", "d_pool", "fill", "tau", "tau_lp", "pos", "active",
+        "remaining", "keys",
+    ),
+)
+def _spec_rounds_chunk(
+    t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau,
+    tau_lp, pos, active, remaining, stops, keys, temperature, top_p,
+    top_k, *, t_config, d_config, n_draft, n_rounds, all_greedy,
+    use_kernel, mesh=None, with_logprobs=False,
+):
+    """``n_rounds`` fused speculative rounds in ONE jitted program — the
+    speculative twin of ``_paged_decode_chunk``.  Each ``lax.scan``
+    iteration replays the host's classic per-round contract
+    (``_step_spec`` + ``_spec_tail``) exactly, ON DEVICE:
+
+      1. *emit* the pending token ``tau`` into the round's output row
+         (column 0), recording -1 for a non-finite-sentinel row and
+         ``_CHUNK_PAD`` for rows already inactive; a row whose tau hits
+         its stop set / exhausts its budget folds out of ``active``
+         before the round runs (the host freed the slot BEFORE the
+         round in the classic loop, so it never paid for a discarded
+         draft+verify);
+      2. run one ``_spec_round_core`` draft+verify for the surviving
+         rows (identical per-round key-split topology, warp math, and
+         commit/rewind as the classic program — it IS the same traced
+         function);
+      3. fold the host's accepted-prefix emit scan on device
+         (``spec_decode.accepted_emit_counts``): tokens ``outs[:acc]``
+         emit into columns 1..acc until a stop token or the max_new
+         budget lands mid-prefix, the fill/pos rewind to ``+acc+1``
+         happens in-carry for rows that continue, ``outs[acc]`` becomes
+         the next pending tau, and finished / non-finite rows fold out
+         of the active mask for the REST of the chunk.
+
+    The host touches the device once per CHUNK of R rounds, not once
+    per round: the packed int32 block [B, R, W] carries each round's
+    G+1 token columns, its acceptance count (-1 = the verify's
+    non-finite sentinel, ``_CHUNK_PAD`` = row inactive that round) and,
+    under ``with_logprobs``, the G+1 bitcast fp32 target logprobs —
+    ONE ``np.asarray`` fetch replaces the classic loop's 2-3 fetches +
+    five mirror uploads PER ROUND.  All speculative decode state
+    (tau/tau_lp/fill/pos/active/remaining/keys + BOTH pools) stays
+    device-resident between chunks.
+
+    Token-identity with the classic per-round path — including the
+    acceptance pattern and per-token logprobs — is pinned by
+    tests/test_serving_spec.py; rounds after every row has folded out
+    run masked rather than cond-skipped (same trade as
+    ``_paged_decode_chunk`` — the host clamps R to the largest
+    remaining budget, which bounds the dead tail)."""
+    G = n_draft
+    with use_mesh(mesh):
+
+        def body(carry, _):
+            (t_pool, d_pool, tau, tau_lp, fill, pos, active, remaining,
+             keys) = carry
+            # --- the host's step-start emit of the pending tau ---
+            nonfinite = tau < 0
+            hit_stop = stop_token_hits(tau, stops)
+            out0 = jnp.where(
+                active, jnp.where(nonfinite, -1, tau), _CHUNK_PAD
+            ).astype(jnp.int32)
+            out0_lp = tau_lp
+            done0 = active & (nonfinite | hit_stop | (remaining <= 1))
+            remaining = remaining - active.astype(jnp.int32)
+            active = active & ~done0
+            # --- one draft+verify round for the surviving rows ---
+            outs, acc, lps_r, keys, t_pool, d_pool = _spec_round_core(
+                t_params, d_params, t_pool, d_pool, table, n_alloc,
+                fill, tau, pos, active, keys, temperature, top_p,
+                top_k, t_config=t_config, d_config=d_config,
+                n_draft=G, all_greedy=all_greedy, use_kernel=use_kernel,
+                mesh=mesh, with_logprobs=with_logprobs,
+            )
+            # --- the host's accepted-prefix emit scan, on device ---
+            verify_nan = active & (acc < 0)
+            acc_c = jnp.clip(acc, 0, G)
+            stop_hits = stop_token_hits(outs[:, :G], stops)  # [B, G]
+            e, any_done = accepted_emit_counts(
+                acc_c, stop_hits, remaining
+            )
+            i = jnp.arange(G, dtype=jnp.int32)[None, :]
+            emit = (
+                (i < e[:, None]) & active[:, None]
+                & ~verify_nan[:, None]
+            )
+            out_rest = jnp.where(
+                emit, outs[:, :G], _CHUNK_PAD
+            ).astype(jnp.int32)
+            acc_out = jnp.where(
+                active, jnp.where(verify_nan, -1, acc_c), _CHUNK_PAD
+            ).astype(jnp.int32)
+            # --- advance / fold-out: the classic host loop's
+            # fill/pos += acc+1 rewind and slot frees, in-carry ---
+            cont = active & ~verify_nan & ~any_done
+            adv = jnp.where(cont, acc_c + 1, 0)
+            fill = fill + adv
+            pos = pos + adv
+            remaining = remaining - jnp.where(
+                active & ~verify_nan, e, 0
+            )
+            new_tau = jnp.take_along_axis(
+                outs, acc_c[:, None], axis=1
+            )[:, 0]
+            tau = jnp.where(cont, new_tau, tau)
+            if with_logprobs:
+                out_lp = jnp.concatenate(
+                    [out0_lp[:, None], lps_r[:, :G]], axis=1
+                )
+                new_lp = jnp.take_along_axis(
+                    lps_r, acc_c[:, None], axis=1
+                )[:, 0]
+                tau_lp = jnp.where(cont, new_lp, tau_lp)
+            else:
+                # Unused lane: keeps the scan's ys pytree shape static
+                # across the with_logprobs specializations.
+                out_lp = jnp.zeros((tau.shape[0], G + 1), jnp.float32)
+            active = cont
+            out_tok = jnp.concatenate([out0[:, None], out_rest], axis=1)
+            return (
+                (t_pool, d_pool, tau, tau_lp, fill, pos, active,
+                 remaining, keys),
+                (out_tok, acc_out, out_lp),
+            )
+
+        carry, (toks, accs, lps) = lax.scan(
+            body,
+            (t_pool, d_pool, tau, tau_lp, fill, pos, active, remaining,
+             keys),
+            None,
+            length=n_rounds,
+        )
+        (t_pool, d_pool, tau, tau_lp, fill, pos, active, remaining,
+         keys) = carry
+        toks = jnp.moveaxis(toks, 0, 1)   # [B, R, G+1]
+        accs = jnp.swapaxes(accs, 0, 1)   # [B, R]
+        if with_logprobs:
+            # fp32 logprobs ride bitcast to int32 alongside the tokens
+            # and acceptance counts: logprobs mode still pays exactly
+            # one device->host fetch per chunk.
+            lp_bits = lax.bitcast_convert_type(
+                jnp.moveaxis(lps, 0, 1).astype(jnp.float32), jnp.int32
+            )
+            packed = jnp.concatenate(
+                [toks, accs[:, :, None], lp_bits], axis=2
+            )  # [B, R, 2G+3]
+        else:
+            packed = jnp.concatenate(
+                [toks, accs[:, :, None]], axis=2
+            )  # [B, R, G+2]
+        return (
+            packed, tau, tau_lp, fill, pos, active, remaining, keys,
+            t_pool, d_pool,
+        )
+
+
 # ---------------------------------------------------------------------------
 # Host-side batcher
 # ---------------------------------------------------------------------------
@@ -1120,6 +1330,15 @@ class ContinuousBatcher:
     the same request (per-row Leviathan rejection sampling with per-slot
     key chains) — the draft only ever changes speed, never content (see
     ``acceptance_rate()``).
+
+    ``spec_rounds`` is ``decode_chunk``'s speculative twin: up to that
+    many draft+verify ROUNDS fuse into one jitted dispatch (module
+    docstring, "Chunked speculative serving"), token-identically to the
+    per-round loop — one ``step()`` may then emit up to
+    R * (n_draft + 1) tokens per slot at one host round-trip per chunk.
+    1 (the default) preserves the classic one-dispatch-per-round
+    behavior; serving entry points (run.py ``--spec-rounds``) default
+    higher.
     """
 
     def __init__(
@@ -1145,6 +1364,7 @@ class ContinuousBatcher:
         prefix_cache: bool = True,
         fault_injector: Optional[FaultInjector] = None,
         decode_chunk: int = 1,
+        spec_rounds: int = 1,
     ):
         # Raw construction arguments, captured before any derivation so
         # ``rebuild()`` (crash recovery) reproduces this batcher exactly
@@ -1159,7 +1379,7 @@ class ContinuousBatcher:
             draft_config=draft_config, n_draft=n_draft, mesh=mesh,
             use_pallas_kernel=use_pallas_kernel, logprobs=logprobs,
             prefix_cache=prefix_cache, fault_injector=fault_injector,
-            decode_chunk=decode_chunk,
+            decode_chunk=decode_chunk, spec_rounds=spec_rounds,
         )
         self.fault_injector = fault_injector
         if config.attn_impl not in ("xla", "auto"):
@@ -1267,10 +1487,10 @@ class ContinuousBatcher:
         # DEVICE-RESIDENT twins (``d_*`` below) that are written
         # incrementally at admission/free/cancel time via ``_scatter_rows``
         # (one dispatch per batch of dirty rows) and advanced ON DEVICE
-        # by ``_paged_decode_chunk`` — steady-state decode uploads
-        # nothing and fetches one packed token block per chunk.  The
-        # speculative path (always K=1) still uploads the mirrors per
-        # round, as before.
+        # by ``_paged_decode_chunk`` / ``_spec_rounds_chunk`` —
+        # steady-state decode uploads nothing and fetches one packed
+        # token block per chunk.  Only the CLASSIC speculative path
+        # (spec_rounds=1) still uploads the mirrors per round.
         B, MB = n_slots, self.blocks_per_slot
         self.table = np.full((B, MB), self.n_blocks, np.int32)
         self.n_alloc = np.zeros((B,), np.int32)
@@ -1301,6 +1521,11 @@ class ContinuousBatcher:
         # always a power of two <= this).  1 = the classic one-dispatch-
         # per-token loop.
         self.decode_chunk = max(1, int(decode_chunk))
+        # spec_rounds: max fused speculative draft+verify ROUNDS per
+        # dispatch (the speculative twin of decode_chunk; the effective
+        # R adapts through the same _pick_chunk policy).  1 = the
+        # classic one-dispatch-per-round loop.
+        self.spec_rounds = max(1, int(spec_rounds))
         # Device-resident twins (chunked path only).
         self.d_table = jnp.asarray(self.table)
         self.d_n_alloc = jnp.asarray(self.n_alloc)
@@ -1327,6 +1552,17 @@ class ContinuousBatcher:
         self.decode_chunk_last = 0
         self._admit_dispatches = 0
         self._admits_at_last_chunk = 0
+        # Speculative-path observability: the effective R of the most
+        # recent spec dispatch, its dispatch/sync/token counters (the
+        # spec twin of host_syncs_per_token), and a window of recent
+        # per-dispatch (proposed, accepted) pairs so /metrics can report
+        # a CURRENT acceptance rate (the lifetime ratio hides a draft
+        # going stale mid-run).
+        self.spec_rounds_last = 0
+        self.spec_dispatches_total = 0
+        self.spec_host_syncs_total = 0
+        self.spec_emitted_total = 0
+        self._accept_window: deque = deque(maxlen=64)
 
         self.slots: Dict[int, Optional[_Slot]] = {
             b: None for b in range(n_slots)
@@ -1537,8 +1773,28 @@ class ContinuousBatcher:
             "host_syncs_per_token": (
                 self.host_syncs_total / max(1, self.emitted_total)
             ),
+            # Speculative-path observability (zero / empty when the
+            # batcher has no draft model): the effective R of the most
+            # recent fused spec dispatch, its host-boundary cost per
+            # emitted token, and the acceptance rate over the recent
+            # dispatch window (the lifetime draft_acceptance_rate above
+            # cannot show a draft going stale mid-run).
+            "spec_rounds_per_dispatch": self.spec_rounds_last,
+            "spec_dispatches_total": self.spec_dispatches_total,
+            "spec_host_syncs_per_token": (
+                self.spec_host_syncs_total
+                / max(1, self.spec_emitted_total)
+            ),
+            "spec_window_acceptance_rate": self._window_acceptance(),
         })
         return out
+
+    def _window_acceptance(self) -> float:
+        """Acceptance rate over the recent spec-dispatch window."""
+        proposed = sum(p for p, _ in self._accept_window)
+        if not proposed:
+            return 0.0
+        return sum(a for _, a in self._accept_window) / proposed
 
     def step(self) -> List[Tuple]:
         """One decode dispatch for every active slot.
@@ -1599,22 +1855,26 @@ class ContinuousBatcher:
     # overhead instead of reverting to one dispatch per token.
     _QUEUED_CHUNK_CAP = 4
 
-    def _pick_chunk(self, admitted: bool) -> int:
+    def _pick_chunk(self, admitted: bool, cap: Optional[int] = None) -> int:
         """Effective K for the next chunk dispatch.  K=1 right after an
         admission (the fresh request's first token should not wait out a
         full chunk); K <= _QUEUED_CHUNK_CAP while the queue holds
         capacity-blocked requests (their admission waits on a slot
         finishing, which the host only learns at a chunk boundary);
-        otherwise the largest power of two <= min(decode_chunk, max
-        remaining budget) — pow2 throughout, so the jit cache holds
-        O(log decode_chunk) chunk programs."""
-        if self.decode_chunk <= 1 or admitted:
+        otherwise the largest power of two <= min(cap, max remaining
+        budget) — pow2 throughout, so the jit cache holds O(log cap)
+        chunk programs.  ``cap`` defaults to ``decode_chunk``; the
+        speculative path passes ``spec_rounds`` (each round emits at
+        least one token, so clamping R by the token budget bounds the
+        dead masked tail the same way it does for K)."""
+        cap = self.decode_chunk if cap is None else cap
+        if cap <= 1 or admitted:
             return 1
         rem = max(
             s.max_new - len(s.emitted)
             for s in self.slots.values() if s is not None
         )
-        k = max(1, min(self.decode_chunk, rem))
+        k = max(1, min(cap, rem))
         if self.queue:
             k = min(k, self._QUEUED_CHUNK_CAP)
         return 1 << (k.bit_length() - 1)
@@ -1769,16 +2029,22 @@ class ContinuousBatcher:
         return out
 
     def _step_spec(self) -> List[Tuple]:
-        """Speculative step (always one round per dispatch): emit each
-        active slot's pending tau, then draft + verify.  This path keeps
-        the classic per-round mirror uploads — chunking composes with
-        plain decode only (``_pick_chunk`` forces K=1 under spec)."""
+        """Speculative step.  With ``spec_rounds`` > 1 the fused
+        R-round chunk path (``_step_spec_chunked``) runs: R draft+verify
+        rounds per jitted dispatch, state device-resident, one packed
+        fetch per chunk.  The default (``spec_rounds=1``) keeps the
+        classic one-round-per-dispatch loop below, with its per-round
+        mirror uploads — the parity oracle the chunked path is pinned
+        against (tests/test_serving_spec.py)."""
+        if self.spec_rounds > 1:
+            return self._step_spec_chunked()
         # Emit each active slot's current tau; free finished slots BEFORE
         # the round so a completing request doesn't pay for one more
         # forward whose output would be discarded.
         out: List[Tuple] = []
         taus = np.asarray(self.tau)
         self.host_syncs_total += 1
+        self.spec_host_syncs_total += 1
         # Non-finite guard: a -1 tau is the step programs' sentinel for
         # "this row's logits contained NaN/Inf" — fail just that request
         # with a clean error instead of streaming a garbage token.  An
@@ -1795,6 +2061,7 @@ class ContinuousBatcher:
                 continue
             slot.emitted.append(tok)
             self.emitted_total += 1
+            self.spec_emitted_total += 1
             done = (
                 tok in slot.stop_tokens
                 or len(slot.emitted) >= slot.max_new
@@ -1827,7 +2094,184 @@ class ContinuousBatcher:
             if "paged_kernel" in feats:
                 self._fault("paged_kernel")
             self.steps_total += 1
+            self.spec_dispatches_total += 1
+            self.spec_rounds_last = 1
             self._spec_tail(out)
+        self._admit()
+        return out
+
+    def _step_spec_chunked(self) -> List[Tuple]:
+        """Speculative step, fused: ONE ``_spec_rounds_chunk`` dispatch
+        runs R draft+verify rounds with the pending-tau emit, the
+        accepted-prefix emit scan, stop/max_new/non-finite folding and
+        the fill rewind all ON DEVICE; the host gets one packed
+        [B, R, W] block (each round's G+1 token columns + its
+        acceptance count + bitcast logprobs) in ONE fetch and replays
+        it to advance the mirrors and produce the caller's events —
+        token-identically (including the acceptance pattern) to the
+        classic per-round loop.  Both pools and all per-slot decode
+        state are device-resident via the ``d_*`` twins; admission /
+        free / cancel sync dirty rows exactly as in ``_step_chunked``,
+        so steady state = 1 fetch + 0 uploads per R rounds."""
+        admitted = self._admit_dispatches > self._admits_at_last_chunk
+        if admitted:
+            # Surface any async admission-dispatch error NOW, while
+            # last_dispatch_features still names the insert (see
+            # _step_chunked).
+            np.asarray(self.tau)
+            self.host_syncs_total += 1
+            self.spec_host_syncs_total += 1
+        self._admits_at_last_chunk = self._admit_dispatches
+        R = self._pick_chunk(admitted, cap=self.spec_rounds)
+        self._sync_device_rows()
+        # Fault sites and dispatch attribution fire once per CHUNK
+        # dispatch, not once per round — an aborted chunk delivers
+        # nothing, so recovery replays all R rounds from the server's
+        # delivered-token record, exactly as in the chunked-decode
+        # contract.
+        feats: List[str] = ["spec_decode"]
+        if self._spec_kernel_ok():
+            feats.append("paged_kernel")
+        self._record_dispatch(feats)
+        self._fault("step")
+        self._fault("spec_decode")
+        if "paged_kernel" in feats:
+            self._fault("paged_kernel")
+        self.steps_total += R
+        self.decode_dispatches_total += 1
+        self.spec_dispatches_total += 1
+        self.decode_chunk_last = R
+        self.spec_rounds_last = R
+        all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
+        (packed, self.tau, self.d_tau_lp, self.d_fill, self.d_pos,
+         self.d_active, self.d_remaining, self.keys, self.pool,
+         self.draft_pool) = _spec_rounds_chunk(
+            self.params, self.draft_params, self.pool, self.draft_pool,
+            self.d_table, self.d_n_alloc, self.d_fill, self.tau,
+            self.d_tau_lp, self.d_pos, self.d_active, self.d_remaining,
+            self.d_stops, self.keys, self.d_temps, self.d_top_ps,
+            self.d_top_ks,
+            t_config=self.config, d_config=self.draft_config,
+            n_draft=self.n_draft, n_rounds=R, all_greedy=all_greedy,
+            use_kernel=self._spec_kernel_ok(), mesh=self.mesh,
+            with_logprobs=self.logprobs,
+        )
+        # THE one device->host sync of the chunk: tokens, acceptance
+        # counts and (bitcast) logprobs in a single packed array.
+        arr = np.asarray(packed)  # [B, R, W]
+        self.host_syncs_total += 1
+        self.spec_host_syncs_total += 1
+        G = self.n_draft
+        toks = arr[:, :, : G + 1]
+        accs = arr[:, :, G + 1]
+        lps = arr[:, :, G + 2:].view(np.float32) if self.logprobs else None
+
+        out: List[Tuple] = []
+        round_proposed = round_accepted = 0
+        forced_nan = self._take_nan()
+        for b, slot in self.slots.items():
+            if slot is None:
+                continue
+            if forced_nan:
+                # An armed ``nan`` fault poisons the first active row,
+                # exactly like the classic emit scan; the row's chunk
+                # tokens are discarded.
+                forced_nan = False
+                self._fail_slot(b, self._NONFINITE_MSG)
+                continue
+            fill_adv = 0
+            ended = False
+            for r in range(R):
+                # Column 0: the round's pending-tau emit.
+                tok0 = int(toks[b, r, 0])
+                if tok0 == _CHUNK_PAD:
+                    # Row folded out before this round (every later
+                    # round is PAD too).
+                    break
+                if tok0 < 0:
+                    # On-device non-finite sentinel on the pending
+                    # token (admission produced NaN/Inf logits).
+                    self._fail_slot(
+                        b, self._NONFINITE_MSG, device_done=True
+                    )
+                    ended = True
+                    break
+                slot.emitted.append(tok0)
+                self.emitted_total += 1
+                self.spec_emitted_total += 1
+                done = (
+                    tok0 in slot.stop_tokens
+                    or len(slot.emitted) >= slot.max_new
+                )
+                if self.logprobs:
+                    out.append((
+                        slot.request_id, tok0, done, float(lps[b, r, 0])
+                    ))
+                else:
+                    out.append((slot.request_id, tok0, done))
+                if done:
+                    # The device made the same call before running the
+                    # round (stop set and budget live on device), so
+                    # the row is already inactive there.
+                    self._free_slot(b, device_done=True)
+                    ended = True
+                    break
+                a = int(accs[b, r])
+                assert a >= -1, (b, r, a)  # PAD here would mean the
+                # device and host disagreed on liveness — impossible
+                # while both fold on the same stop/budget inputs.
+                if a < 0:
+                    # _spec_rounds_chunk's verify non-finite sentinel:
+                    # the round was never committed (all written slots
+                    # invalidated in-jit) — fail just this request.
+                    self._fail_slot(
+                        b, self._NONFINITE_MSG, device_done=True
+                    )
+                    ended = True
+                    break
+                self.drafts_proposed += G
+                self.drafts_accepted += a
+                round_proposed += G
+                round_accepted += a
+                # Columns 1..a: the round's accepted drafts (the device
+                # already blanked everything past a mid-prefix
+                # stop/budget hit to _CHUNK_PAD; the host re-detects
+                # done from its own stop sets, exactly like
+                # _step_chunked's replay).
+                for i in range(a):
+                    tok = int(toks[b, r, 1 + i])
+                    if tok == _CHUNK_PAD:
+                        break
+                    slot.emitted.append(tok)
+                    self.emitted_total += 1
+                    self.spec_emitted_total += 1
+                    done = (
+                        tok in slot.stop_tokens
+                        or len(slot.emitted) >= slot.max_new
+                    )
+                    if self.logprobs:
+                        out.append((
+                            slot.request_id, tok, done,
+                            float(lps[b, r, 1 + i]),
+                        ))
+                    else:
+                        out.append((slot.request_id, tok, done))
+                    if done:
+                        self._free_slot(b, device_done=True)
+                        ended = True
+                        break
+                if ended:
+                    break
+                # The round committed a+1 pool slots (tau + accepted
+                # drafts; outs[a] is the next pending tau) — the fill
+                # rewind the device already applied in-carry.
+                fill_adv += a + 1
+            if not ended:
+                self.fill[b] += fill_adv
+                self.pos[b] += fill_adv
+                self.remaining[b] = slot.max_new - len(slot.emitted)
+        if round_proposed:
+            self._accept_window.append((round_proposed, round_accepted))
         self._admit()
         return out
 
@@ -1861,12 +2305,16 @@ class ContinuousBatcher:
         outs = np.asarray(outs)
         acc = np.asarray(acc)
         self.host_syncs_total += 2
+        self.spec_host_syncs_total += 2
         if self.logprobs:
             lps = np.asarray(lps)
             self.host_syncs_total += 1
+            self.spec_host_syncs_total += 1
+        round_proposed = round_accepted = 0
         # NOTE: the per-row fill/pos advances below touch the numpy
-        # mirrors only — the spec path re-uploads them every round and
-        # never consumes the chunked path's device-resident twins.
+        # mirrors only — the CLASSIC (spec_rounds=1) path re-uploads
+        # them every round and never consumes the chunked paths'
+        # device-resident twins.
         new_tau = np.zeros((self.n_slots,), np.int32)
         for b, slot in self.slots.items():
             if slot is None:
@@ -1881,6 +2329,8 @@ class ContinuousBatcher:
                 continue
             self.drafts_proposed += self.n_draft
             self.drafts_accepted += a
+            round_proposed += self.n_draft
+            round_accepted += a
             # Emit accepted drafts outs[0..a-1] (== the draft tokens);
             # outs[a] becomes the next pending token, mirroring the plain
             # batcher's sampled-but-unemitted tau.
@@ -1889,6 +2339,7 @@ class ContinuousBatcher:
                 tok = int(outs[b, i])
                 slot.emitted.append(tok)
                 self.emitted_total += 1
+                self.spec_emitted_total += 1
                 done = (
                     tok in slot.stop_tokens
                     or len(slot.emitted) >= slot.max_new
@@ -1912,6 +2363,8 @@ class ContinuousBatcher:
                     self.tau_lp[b] = float(lps[b, a])
                 self.fill[b] += a + 1
                 self.pos[b] += a + 1
+        if round_proposed:
+            self._accept_window.append((round_proposed, round_accepted))
         self.tau = jnp.asarray(new_tau)
 
     def run_to_completion(self) -> Dict[int, List[int]]:
@@ -2259,10 +2712,11 @@ class ContinuousBatcher:
         self.tau = self.tau.at[idx].set(tau[:k])
         if self.logprobs:
             # Device twin always; the numpy mirror only feeds the
-            # speculative emit scan (fetching it costs an admission-time
-            # device->host sync the chunked path doesn't need).
+            # CLASSIC (spec_rounds=1) speculative emit scan — fetching
+            # it costs an admission-time device->host sync neither
+            # chunked path (plain or fused-spec) needs.
             self.d_tau_lp = self.d_tau_lp.at[idx].set(tau_lp[:k])
-            if self.spec:
+            if self.spec and self.spec_rounds == 1:
                 self.tau_lp[np.asarray(slots)] = np.asarray(tau_lp)[:k]
         self.keys = self.keys.at[idx].set(keys_out[:k])
         for i, (req, chain, hits) in enumerate(grp):
@@ -2429,7 +2883,7 @@ class ContinuousBatcher:
             self.tau = self.tau.at[idx].set(taus[:k])
             if self.logprobs:
                 self.d_tau_lp = self.d_tau_lp.at[idx].set(tau_lps[:k])
-                if self.spec:
+                if self.spec and self.spec_rounds == 1:
                     self.tau_lp[np.asarray(slot_ids)] = (
                         np.asarray(tau_lps)[:k]
                     )

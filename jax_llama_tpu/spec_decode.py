@@ -200,6 +200,35 @@ def place_extra(drafts, acc, extra):
     return outs.at[jnp.arange(B), acc].set(extra)
 
 
+def accepted_emit_counts(acc, stop_hits, remaining):
+    """How many of a round's accepted tokens the serving host's emit
+    scan would actually deliver — the ON-DEVICE mirror of the classic
+    per-round loop's token-by-token stop/budget walk over ``outs[:acc]``
+    (``serving.ContinuousBatcher._spec_tail``), so the fused R-round
+    chunk program can fold slot completion mid-chunk without a host
+    round-trip.
+
+    acc: [B] int32 accepted-prefix lengths (clipped to >= 0).
+    stop_hits: [B, G] bool, per-position stop-set membership of the
+      round's ``outs[:, :G]`` (``ops.sampling.stop_token_hits``).
+    remaining: [B] int32 generation budget AFTER the round's
+      pending-tau emit (the host checks ``len(emitted) >= max_new``
+      after appending each token; emitting outs token i makes that
+      ``i + 1 >= remaining``).
+    Returns (e [B], done [B]): tokens ``outs[0..e-1]`` are emitted —
+    ``e == acc`` when the row sails through, ``first_done + 1`` when
+    token ``first_done`` hits a stop or exhausts the budget — and
+    ``done`` marks rows whose request finished mid-prefix (their slot
+    frees; fill never advances for them, exactly as on the host)."""
+    G = stop_hits.shape[1]
+    i = jnp.arange(G, dtype=jnp.int32)[None, :]
+    cand = i < acc[:, None]
+    done_at = cand & (stop_hits | ((i + 1) >= remaining[:, None]))
+    done = jnp.any(done_at, axis=1)
+    first = jnp.argmax(done_at, axis=1)
+    return jnp.where(done, first + 1, acc), done
+
+
 def _spec_impl(tp, dp, prompt_tokens, prompt_mask, rng, tc, dc, gc, G):
     B, P = prompt_tokens.shape
     N = gc.max_new_tokens

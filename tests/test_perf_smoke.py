@@ -1,13 +1,16 @@
-"""Host-boundary discipline of chunked decode (make perf-smoke;
-tier-1-safe, CPU).
+"""Host-boundary discipline of chunked decode AND chunked speculative
+serving (make perf-smoke; tier-1-safe, CPU).
 
-The whole point of decode_chunk > 1 is amortizing host<->device traffic:
-steady-state decode must pay AT MOST ONE device->host sync (the packed
-token block) and ZERO host->device state uploads per chunk dispatch.
-These tests assert that contract through the batcher's instrumented
-counters (``host_syncs_total`` / ``state_uploads_total`` count every
-np.asarray fetch and every ``_scatter_rows`` state-sync dispatch the
-serving loop performs), plus the adaptive-K policy around admissions."""
+The whole point of decode_chunk / spec_rounds > 1 is amortizing
+host<->device traffic: steady-state decode must pay AT MOST ONE
+device->host sync (the packed token block) and ZERO host->device state
+uploads per chunk dispatch — whether the chunk carries K plain decode
+iterations or R speculative draft+verify rounds.  These tests assert
+that contract through the batcher's instrumented counters
+(``host_syncs_total`` / ``state_uploads_total`` count every np.asarray
+fetch and every ``_scatter_rows`` state-sync dispatch the serving loop
+performs; the ``spec_*`` twins attribute the speculative path's share),
+plus the adaptive-K/R policy around admissions."""
 
 import jax
 import numpy as np
@@ -127,3 +130,105 @@ def test_metrics_surface(model):
         assert key in stats, key
     assert stats["decode_dispatches_total"] > 0
     assert 0 < stats["host_syncs_per_token"] <= 1.5
+
+
+# ---------------------------------------------------------------------------
+# The speculative path (spec_rounds > 1) owes the same discipline
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec_models(model):
+    params, config = model
+    draft_config = get_config(
+        "tiny", **{**CFG, "dim": 32, "n_layers": 1, "n_heads": 2,
+                   "n_kv_heads": 1}
+    )
+    draft_params = init_params(jax.random.PRNGKey(1), draft_config)
+    return params, config, draft_params, draft_config
+
+
+def test_spec_steady_state_host_sync_discipline(spec_models):
+    """Steady-state fused-spec dispatches: exactly 1 device->host fetch
+    (the packed [B, R, W] block) and ZERO host->device state uploads
+    per R-round dispatch — the classic loop paid 2-3 fetches + a
+    5-array mirror upload PER ROUND."""
+    params, config, draft_params, draft_config = spec_models
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=128,
+        draft_params=draft_params, draft_config=draft_config,
+        n_draft=2, spec_rounds=4,
+    )
+    cb.submit(list(np.random.RandomState(0).randint(1, 128, 9)),
+              max_new_tokens=90)
+    cb.step()   # admission (R=1) + the one state sync it owes
+    cb.step()   # round-count ramp
+    assert cb.state_uploads_total == 1  # the admission's row sync
+    s0, u0, d0 = (
+        cb.host_syncs_total, cb.state_uploads_total,
+        cb.spec_dispatches_total,
+    )
+    for _ in range(4):
+        cb.step()
+    dispatches = cb.spec_dispatches_total - d0
+    assert dispatches == 4
+    # Exactly 1 sync per dispatch (the packed token/acc/logprob block)...
+    assert cb.host_syncs_total - s0 == dispatches
+    # ...and ZERO steady-state state uploads.
+    assert cb.state_uploads_total == u0
+    # The steady-state chunks ran fused (R > 1).
+    assert cb.spec_rounds_last == 4
+    while cb.pending():
+        cb.step()
+
+
+def test_spec_rounds_adapt_around_admissions(spec_models):
+    """R drops to 1 right after an admission (TTFT), stays clamped at
+    <= _QUEUED_CHUNK_CAP while the queue holds capacity-blocked
+    requests, then ramps to the configured spec_rounds — the same
+    _pick_chunk policy the plain chunked path follows."""
+    params, config, draft_params, draft_config = spec_models
+    cb = ContinuousBatcher(
+        params, config, n_slots=1, max_len=128,
+        draft_params=draft_params, draft_config=draft_config,
+        n_draft=2, spec_rounds=8,
+    )
+    cb.submit([4, 5, 6], max_new_tokens=40)
+    cb.submit([7, 8, 9], max_new_tokens=40)  # queued behind slot 0
+    cb.step()
+    assert cb.spec_rounds_last == 1   # admission step
+    cb.step()
+    # Queue capacity-blocked: clamped small but still > 1.
+    assert cb.spec_rounds_last == cb._QUEUED_CHUNK_CAP
+    seen = set()
+    guard = 0
+    while cb.pending():
+        guard += 1
+        assert guard < 200
+        cb.step()
+        seen.add(cb.spec_rounds_last)
+    assert 8 in seen
+    assert seen <= {1, 2, 4, 8}
+
+
+def test_spec_metrics_surface(spec_models):
+    """The speculative observability gauges are in stats() (and
+    therefore in the HTTP /metrics exposition)."""
+    params, config, draft_params, draft_config = spec_models
+    cb = ContinuousBatcher(
+        params, config, n_slots=1, max_len=64,
+        draft_params=draft_params, draft_config=draft_config,
+        n_draft=2, spec_rounds=4,
+    )
+    cb.submit([4, 5, 6], max_new_tokens=8)
+    cb.run_to_completion()
+    stats = cb.stats()
+    for key in (
+        "spec_rounds_per_dispatch", "spec_dispatches_total",
+        "spec_host_syncs_per_token", "spec_window_acceptance_rate",
+    ):
+        assert key in stats, key
+    assert stats["spec_dispatches_total"] > 0
+    # Fused rounds amortize: well under the classic loop's >= 2
+    # fetches per round (>= 2 per token at acceptance 0).
+    assert 0 < stats["spec_host_syncs_per_token"] <= 1.5
+    assert 0.0 <= stats["spec_window_acceptance_rate"] <= 1.0

@@ -1,12 +1,31 @@
 """Speculative decoding inside the continuous batcher: output must be
 token-identical to the plain greedy batcher — the draft model only changes
-speed (acceptance), never content."""
+speed (acceptance), never content.
+
+Fused R-round chunking (``spec_rounds`` > 1, ``_spec_rounds_chunk``)
+must additionally be token-identical to the classic per-round loop —
+including the ACCEPTANCE PATTERN (drafts proposed/accepted) and
+per-token logprobs — across greedy/seeded-sampled policies, stop tokens
+and max_new landing mid-chunk, non-finite logits mid-chunk, and the
+int8-KV pool; and the crash-recovery / non-finite-guard / quarantine
+semantics proven for the per-round loop must hold with round fusion
+(fault sites fire once per R-round chunk dispatch, replay works from
+delivered tokens, quarantine falls back to plain CHUNKED decode with
+the decode_chunk / spec_rounds configuration preserved)."""
+
+import dataclasses
+import json
+import threading
+import urllib.error
+import urllib.request
 
 import jax
 import numpy as np
 import pytest
 
 from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.faults import FaultInjector
+from jax_llama_tpu.server import LLMServer
 from jax_llama_tpu.serving import ContinuousBatcher
 
 CFG = dict(
@@ -232,6 +251,314 @@ def test_spec_batcher_sampled_only_batch(models):
         )
         want = np.asarray(buf)[0, P:P + 8].tolist()
         assert results[rid] == want, f"slot {rid}"
+
+
+# ---------------------------------------------------------------------------
+# Fused R-round chunking (spec_rounds > 1): CPU parity matrix
+# ---------------------------------------------------------------------------
+
+def _spec_matrix(models, R, *, logprobs=False, stop=(), int8=False,
+                 self_draft=False, **cb_kw):
+    """The shared request mix — greedy finishing mid-chunk (max_new 5),
+    greedy full-budget, two seeded sampled policies — 4 requests over
+    2 slots, so R also ramps around queue-driven admissions.  Returns
+    (per-request tokens, per-request logprobs, the acceptance pattern
+    (proposed, accepted))."""
+    params, config, draft_params, draft_config = models
+    if self_draft:
+        draft_params, draft_config = params, config
+    if int8:
+        config = dataclasses.replace(config, kv_cache_dtype="int8")
+        draft_config = dataclasses.replace(
+            draft_config, kv_cache_dtype="int8"
+        )
+        cb_kw.setdefault("block_size", 16)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 128, size=n).tolist() for n in (5, 9, 14, 6)]
+    policies = [
+        dict(max_new_tokens=5),
+        dict(max_new_tokens=11),
+        dict(max_new_tokens=9, temperature=0.9, seed=11),
+        dict(max_new_tokens=12, temperature=0.7, top_p=0.8, seed=12),
+    ]
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, spec_rounds=R,
+        draft_params=draft_params, draft_config=draft_config, n_draft=3,
+        logprobs=logprobs, stop_tokens=stop, **cb_kw,
+    )
+    rids = [cb.submit(p, **pol) for p, pol in zip(prompts, policies)]
+    toks, lps = {}, {}
+    guard = 0
+    while cb.pending():
+        guard += 1
+        assert guard < 500
+        for ev in cb.step():
+            toks.setdefault(ev[0], []).append(ev[1])
+            if logprobs:
+                lps.setdefault(ev[0], []).append(ev[3])
+    return (
+        [toks[r] for r in rids],
+        [lps.get(r) for r in rids],
+        (cb.drafts_proposed, cb.drafts_accepted),
+    )
+
+
+@pytest.mark.parametrize("R", [2, 4])
+def test_spec_rounds_token_identity_greedy_and_sampled(models, R):
+    """R ∈ {2, 4} × {greedy, seeded-sampled} × max_new mid-chunk:
+    tokens AND the acceptance pattern identical to the classic
+    per-round loop (which the tests above pin against standalone
+    engine/spec oracles)."""
+    base, _, base_acc = _spec_matrix(models, 1)
+    got, _, got_acc = _spec_matrix(models, R)
+    assert got == base
+    assert got_acc == base_acc
+
+
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
+def test_spec_rounds_token_identity_logprobs(models):
+    """logprobs ride the packed fetch bitcast: same values as the
+    classic loop, token for token, for carried-tau, accepted-draft and
+    replacement/bonus emissions alike."""
+    base, base_lp, _ = _spec_matrix(models, 1, logprobs=True)
+    got, got_lp, _ = _spec_matrix(models, 4, logprobs=True)
+    assert got == base
+    for a, b in zip(got_lp, base_lp):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_spec_rounds_stop_token_mid_chunk(models):
+    """A stop token landing INSIDE a round's accepted prefix, inside a
+    fused chunk (self-draft => high acceptance => multi-token
+    prefixes): the on-device accepted-prefix emit fold must end the
+    request at exactly the token the host loop would."""
+    params, config, _, _ = models
+    prompt = [5, 17, 99, 3, 42]
+
+    def run(R, stop=()):
+        cb = ContinuousBatcher(
+            params, config, n_slots=1, max_len=64, stop_tokens=stop,
+            draft_params=params, draft_config=config, n_draft=3,
+            spec_rounds=R,
+        )
+        rid = cb.submit(prompt, max_new_tokens=16)
+        return cb.run_to_completion()[rid]
+
+    free = run(1)
+    j = next(i for i in range(1, len(free)) if free[i] not in free[:i])
+    stop = free[j]
+    want = run(1, stop=(stop,))
+    got = run(4, stop=(stop,))
+    assert want == free[:j + 1]
+    assert got == want
+
+
+@pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
+def test_spec_rounds_int8_kv(models):
+    """The int8 pools' quantized branches (per-round scale-plane writes
+    for BOTH the target and draft pools inside the scan) must match
+    their classic per-round emissions."""
+    base, _, base_acc = _spec_matrix(models, 1, int8=True)
+    got, _, got_acc = _spec_matrix(models, 4, int8=True)
+    assert got == base
+    assert got_acc == base_acc
+
+
+def test_spec_rounds_nonfinite_mid_chunk(models):
+    """NaN target logits under round fusion: the verify's -1 acceptance
+    sentinel folds the row out mid-chunk, the round is never committed,
+    and exactly that request fails — same contract as the classic
+    loop's guard."""
+    params, config, _, _ = models
+    bad = dict(params)
+    bad["lm_head"] = params["lm_head"] * float("nan")
+    cb = ContinuousBatcher(
+        bad, config, n_slots=1, max_len=64,
+        draft_params=params, draft_config=config, n_draft=2,
+        spec_rounds=4,
+    )
+    rid = cb.submit([5, 17, 99, 3], max_new_tokens=8)
+    out = cb.run_to_completion()
+    failed = cb.pop_failed()
+    assert rid not in out
+    assert failed and failed[0][0] == rid
+    assert not cb.pending()
+    assert sorted(cb.free_blocks) == list(range(cb.n_blocks))
+
+
+# ---------------------------------------------------------------------------
+# Fault-tolerance semantics with round fusion enabled
+# ---------------------------------------------------------------------------
+
+PROMPTS = [[5, 17, 99, 3], [7, 8, 9], [11, 12, 13]]
+MAX_NEW = 12
+
+
+def _post(url, payload, timeout=300):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _stream_lines(url, payload, timeout=300):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.status == 200
+        return [json.loads(line) for line in r.read().splitlines()]
+
+
+@pytest.fixture(scope="module")
+def reference(models):
+    """Fault-free plain-greedy outputs (the identity oracle — the draft
+    only ever changes speed)."""
+    params, config, _, _ = models
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    rids = [cb.submit(list(p), max_new_tokens=MAX_NEW) for p in PROMPTS]
+    out = cb.run_to_completion()
+    return [out[r] for r in rids]
+
+
+@pytest.mark.faults
+def test_chunked_spec_fault_recovers_token_exact(models, reference):
+    """A spec_decode-site fault mid-chunk (the site fires once per
+    R-round dispatch): recovery rebuilds a fused-spec batcher and
+    replays from delivered tokens — greedy outputs identical to the
+    fault-free plain run, and a streaming client sees each token
+    exactly once even though tokens now arrive in R-round bursts."""
+    params, config, draft_params, draft_config = models
+    inj = FaultInjector("spec_decode@2:error")
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64,
+        draft_params=draft_params, draft_config=draft_config, n_draft=2,
+        spec_rounds=4, fault_injector=inj,
+    )
+    results = {}
+    # The spec_decode site is attributable: use a threshold ABOVE the
+    # faults this drill injects so the drill exercises rebuild+replay,
+    # not quarantine.
+    with LLMServer(cb, quarantine_threshold=5) as srv:
+        def call(i):
+            try:
+                if i == 0:  # one streaming client
+                    results[i] = _stream_lines(
+                        srv.address,
+                        {"prompt": PROMPTS[i], "max_new_tokens": MAX_NEW,
+                         "stream": True},
+                    )
+                else:
+                    _, body = _post(
+                        srv.address,
+                        {"prompt": PROMPTS[i], "max_new_tokens": MAX_NEW},
+                    )
+                    results[i] = body["tokens"]
+            except Exception as e:  # noqa: BLE001 — fail the test, not the thread
+                results[i] = f"{type(e).__name__}: {e}"
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(PROMPTS))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads)
+
+        lines = results[0]
+        assert isinstance(lines, list), lines
+        streamed = [ln["token"] for ln in lines[:-1]]
+        assert streamed == reference[0]          # no dup, no gap
+        assert lines[-1]["done"] is True
+        assert lines[-1]["tokens"] == reference[0]
+        for i in range(1, len(PROMPTS)):
+            assert results[i] == reference[i], i
+        assert inj.injected_total == 1
+        assert srv.recoveries_total == 1
+        # The rebuilt batcher still runs fused speculative serving.
+        assert srv.batcher.spec and srv.batcher.spec_rounds == 4
+
+
+@pytest.mark.faults
+def test_chunked_spec_nan_isolation_per_request(models, reference):
+    """An armed nan poison under round fusion fails exactly one request
+    with a clean 500 (its chunk tokens are discarded, never streamed);
+    the neighbor slot completes token-identically."""
+    params, config, draft_params, draft_config = models
+    inj = FaultInjector("step@1:nan")
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64,
+        draft_params=draft_params, draft_config=draft_config, n_draft=2,
+        spec_rounds=4, fault_injector=inj,
+    )
+    results = {}
+    with LLMServer(cb) as srv:
+        def call(i):
+            try:
+                results[i] = _post(
+                    srv.address,
+                    {"prompt": PROMPTS[i], "max_new_tokens": MAX_NEW},
+                )[1]["tokens"]
+            except urllib.error.HTTPError as e:
+                results[i] = (e.code, json.loads(e.read())["error"])
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads)
+    failed = [r for r in results.values() if isinstance(r, tuple)]
+    ok = {i: r for i, r in results.items() if isinstance(r, list)}
+    assert len(failed) == 1
+    code, msg = failed[0]
+    assert code == 500 and "non-finite" in msg
+    assert len(ok) == 1
+    (i, toks), = ok.items()
+    assert toks == reference[i]
+    assert inj.nans_armed_total == 1
+
+
+@pytest.mark.faults
+def test_chunked_spec_quarantine_falls_back_to_chunked_decode(
+    models, reference
+):
+    """spec_decode faults past the threshold quarantine the feature and
+    the batcher rebuilds WITHOUT the draft model but WITH the original
+    decode_chunk / spec_rounds configuration — degraded speculative
+    serving lands on plain CHUNKED decode, not the per-token loop, and
+    requests replay token-identically."""
+    params, config, draft_params, draft_config = models
+    inj = FaultInjector("spec_decode~1.0:error")
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, decode_chunk=4,
+        draft_params=draft_params, draft_config=draft_config, n_draft=2,
+        spec_rounds=4, fault_injector=inj,
+    )
+    with LLMServer(
+        cb, quarantine_threshold=2, quarantine_cooldown_s=600.0
+    ) as srv:
+        _, body = _post(
+            srv.address,
+            {"prompt": PROMPTS[0], "max_new_tokens": MAX_NEW},
+        )
+        assert body["tokens"] == reference[0]
+        assert srv.degrade.quarantined() == ("spec_decode",)
+        # The fallback batcher is plain (no draft) but keeps the whole
+        # chunk configuration for the day spec_decode probes healthy.
+        assert not srv.batcher.spec
+        assert srv.batcher.decode_chunk == 4
+        assert srv.batcher.spec_rounds == 4
+        # And keeps serving: a second request completes on the fallback.
+        _, body2 = _post(
+            srv.address,
+            {"prompt": PROMPTS[1], "max_new_tokens": MAX_NEW},
+        )
+        assert body2["tokens"] == reference[1]
 
 
 def test_spec_batcher_staggered_admission(models):

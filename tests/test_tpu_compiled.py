@@ -316,6 +316,62 @@ def test_paged_decode_chunk_no_full_pool_copies_compiled():
 
 
 @requires_tpu
+def test_spec_rounds_chunk_no_full_pool_copies_compiled():
+    """The fused R-round speculative program (``_spec_rounds_chunk``)
+    must uphold the same no-full-pool-copy invariant as the decode
+    chunk above — with TWO pools riding the scan carry (target +
+    draft), an XLA-materialized pool-sized copy at the scan boundary
+    would double BOTH KV footprints and silently regress every round.
+    Same HLO-text assertion, against the n_rounds=4 executable with the
+    device-resident state args the batcher actually dispatches
+    (self-draft, so one shape pattern covers both pools)."""
+    import re
+
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.serving import ContinuousBatcher
+
+    cfg = get_config(
+        "tiny", dim=256, n_layers=4, n_heads=4, n_kv_heads=2,
+        vocab_size=512, max_seq_len=256, param_dtype="bfloat16",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cb = ContinuousBatcher(params, cfg, n_slots=4, max_len=256,
+                           block_size=32, spec_rounds=4,
+                           draft_params=params, draft_config=cfg,
+                           n_draft=3)
+    rng = np.random.RandomState(5)
+    for _ in range(4):
+        cb.submit(list(rng.randint(1, cfg.vocab_size, 100)),
+                  max_new_tokens=16)
+    cb.step()  # admission; the fused spec program now has concrete args
+
+    from jax_llama_tpu import serving as srv
+
+    L, KVH = cfg.n_layers, cfg.kv_heads
+    NB, BLK = cb.pool.pos.shape
+    d = cfg.head_dim
+    lowered = srv._spec_rounds_chunk.lower(
+        cb.params, cb.draft_params, cb.pool, cb.draft_pool, cb.d_table,
+        cb.d_n_alloc, cb.d_fill, cb.tau, cb.d_tau_lp, cb.d_pos,
+        cb.d_active, cb.d_remaining, cb.d_stops, cb.keys, cb.d_temps,
+        cb.d_top_ps, cb.d_top_ks,
+        t_config=cb.config, d_config=cb.draft_config,
+        n_draft=cb.n_draft, n_rounds=4, all_greedy=True,
+        use_kernel=True, mesh=None, with_logprobs=False,
+    )
+    txt = lowered.compile().as_text()
+    pool_shape = rf"{L},{KVH},{NB},{BLK},{d}"
+    plane_shape = rf"{KVH},{NB},{BLK},{d}"
+    offenders = [
+        line.strip()[:140]
+        for line in txt.splitlines()
+        if re.search(rf"(copy|dynamic-slice)[^=]*=[^=]*\[({pool_shape}|{plane_shape})\]", line)
+        or (" copy(" in line and f"[{pool_shape}]" in line)
+    ]
+    assert not offenders, offenders
+
+
+@requires_tpu
 def test_device_op_times_compiled():
     """utils.profiling.device_op_times — the measurement primitive behind
     every bench/ROADMAP perf number — attributes device time to a known
