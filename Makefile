@@ -24,14 +24,17 @@ chaos:
 	$(PYTEST) tests/ -q -m 'chaos or faults'
 
 # Tier-1-safe perf guardrails (CPU, no accelerator needed): chunked
-# decode's AND chunked speculative serving's host-boundary discipline —
-# instrumented counter tests asserting <= 1 device->host sync and 0
-# steady-state host->device state uploads per fused dispatch (K decode
-# iterations or R draft+verify rounds) — plus the K>1 vs K=1 and
-# spec_rounds>1 vs 1 token-identity matrices.  These also run inside
+# decode's, chunked speculative serving's AND fused prefill-decode
+# scheduling's host-boundary discipline — instrumented counter tests
+# asserting <= 1 device->host sync and 0 steady-state host->device
+# state uploads per fused dispatch (K decode iterations, R draft+verify
+# rounds, or a prefill-carrying chunk), that decode rows keep emitting
+# while a long prompt is mid-prefill (zero full-prefill stalls) with K
+# un-collapsed — plus the K>1 vs K=1, spec_rounds>1 vs 1, and fused vs
+# classic-admission token-identity matrices.  These also run inside
 # tier1; this target is the fast pre-push slice.
 perf-smoke:
-	$(PYTEST) tests/test_perf_smoke.py tests/test_serving_chunked.py tests/test_serving_spec.py -q -m 'not slow'
+	$(PYTEST) tests/test_perf_smoke.py tests/test_serving_chunked.py tests/test_serving_spec.py tests/test_serving_fused.py -q -m 'not slow'
 
 # On-chip kernel regressions (run on a TPU host; self-skip elsewhere).
 tpu:

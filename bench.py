@@ -289,10 +289,14 @@ def main() -> None:
     # ------------------------------------------------------------------
     from jax_llama_tpu.serving import ContinuousBatcher
 
-    def serve_run(decode_chunk=16):
+    def serve_run(decode_chunk=16, p=params):
+        # prefill_budget mirrors the run.py serving default (fused
+        # prefill-decode scheduling); this COLD burst still admits
+        # through the classic batched insert — nobody is decoding yet —
+        # so the number stays comparable to r05's.
         cb = ContinuousBatcher(
-            params, config, n_slots=8, max_len=1024, block_size=128,
-            decode_chunk=decode_chunk,
+            p, config, n_slots=8, max_len=1024, block_size=128,
+            decode_chunk=decode_chunk, prefill_budget=512,
         )
         _salt[0] += 1
         srng = np.random.RandomState(1000 + _salt[0])  # salted prompts
@@ -320,6 +324,92 @@ def main() -> None:
     for K in (1, 4, 8):
         t_k, n_k, _ = min(serve_run(decode_chunk=K) for _ in range(2))
         chunk_sweep[f"K{K}"] = round(n_k / t_k, 2)
+
+    # int8 WEIGHT-only serving (reachable via run.py --quantize but
+    # never benched through the batcher before r06: the serving benches
+    # only ever measured int8 KV): the same burst drain on
+    # quantize_params weights — decode is weight-bandwidth-bound, so
+    # this is the serving-path realization of the standalone int8
+    # decode win.
+    serve_run(p=qparams)  # warmup (int8 insert + chunk programs)
+    i8_t, i8_n, _ = min(serve_run(p=qparams) for _ in range(2))
+    paged_serving_int8w_toks_per_s = i8_n / i8_t
+
+    # ------------------------------------------------------------------
+    # Fused prefill-decode scheduling: TTFT / ITL under a MIXED workload
+    # — 4 decode-heavy residents, then a burst of 3 long prompts lands
+    # mid-decode.  Classic admission (prefill_budget=0) stalls every
+    # resident for each whole-prompt prefill dispatch and collapses the
+    # decode chunk to K=1 right after; the fused scheduler
+    # (run.py --prefill-budget, default 512) advances the prompt inside
+    # the decode chunks instead.  serving_ttft_ms is submit -> first
+    # token of the burst requests; serving_itl_p99_ms is the residents'
+    # inter-token gap while the burst is being admitted (the stall
+    # shows up as a fat ITL tail).  The budget sweep records both at
+    # B ∈ {0 = classic, 128, 512}.
+    # ------------------------------------------------------------------
+    def mixed_run(prefill_budget):
+        cb = ContinuousBatcher(
+            params, config, n_slots=8, max_len=1024, block_size=128,
+            decode_chunk=16, prefill_budget=prefill_budget,
+        )
+        _salt[0] += 1
+        srng = np.random.RandomState(3000 + _salt[0])
+        residents = [
+            cb.submit(list(srng.randint(1, config.vocab_size, 100)),
+                      max_new_tokens=160)
+            for _ in range(4)
+        ]
+        for _ in range(4):
+            cb.step()  # residents admitted (cold, classic) + K ramp
+        burst, t_sub, ttft = [], {}, {}
+        for _ in range(3):
+            rid = cb.submit(
+                list(srng.randint(1, config.vocab_size, 850)),
+                max_new_tokens=16,
+            )
+            t_sub[rid] = time.time()
+            burst.append(rid)
+        last_seen: dict = {r: None for r in residents}
+        itl_gaps = []
+        while cb.pending():
+            evs = cb.step()
+            now = time.time()
+            burst_inflight = any(r not in ttft for r in burst)
+            for rid, _tok, _done in evs:
+                if rid in t_sub and rid not in ttft:
+                    ttft[rid] = (now - t_sub[rid]) * 1000.0
+                if rid in last_seen:
+                    if last_seen[rid] is not None and burst_inflight:
+                        itl_gaps.append(
+                            (now - last_seen[rid]) * 1000.0
+                        )
+                    last_seen[rid] = now
+        return (
+            sorted(ttft.values()),
+            itl_gaps,
+            cb.stats()["decode_stall_ms_total"],
+        )
+
+    mixed_run(512)  # warmup (fused-chunk programs at the 512 budget)
+    budget_sweep = {}
+    serving_ttft = serving_itl_p99 = None
+    for budget in (0, 128, 512):
+        ttfts, gaps, stall_ms = mixed_run(budget)
+        entry = {
+            "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 1),
+            "ttft_ms_p99": round(float(np.percentile(ttfts, 99)), 1),
+            "itl_ms_p99": (
+                round(float(np.percentile(gaps, 99)), 1) if gaps else None
+            ),
+            "decode_stall_ms": round(stall_ms, 1),
+        }
+        budget_sweep[f"B{budget}"] = entry
+        if budget == 512:  # the headline serving config (run.py default)
+            serving_ttft = {
+                "p50": entry["ttft_ms_p50"], "p99": entry["ttft_ms_p99"]
+            }
+            serving_itl_p99 = entry["itl_ms_p99"]
 
     # ------------------------------------------------------------------
     # Speculative serving.  The draft is the target NUDGED by ~2%
@@ -1011,6 +1101,23 @@ def main() -> None:
             ),
             # 8 submits -> ONE batched prefill dispatch + first decode.
             "burst_admission_s": round(admit_s, 3),
+            # int8 WEIGHT-only serving (the quantize_params path run.py
+            # --quantize reaches; the serving benches previously only
+            # ever measured int8 KV): same burst drain, quantized
+            # weight stream.
+            "paged_serving_int8w_tokens_per_s": round(
+                paged_serving_int8w_toks_per_s, 2
+            ),
+            # Fused prefill-decode scheduling (run.py --prefill-budget,
+            # the headline serving config): time-to-first-token of a
+            # 3 x 850-token burst landing against 4 mid-decode
+            # residents, and the residents' p99 inter-token latency
+            # while the burst admits.  The budget sweep's B0 entry is
+            # the classic whole-prompt-admission baseline — its
+            # decode_stall_ms is what fused scheduling drives to ~0.
+            "serving_ttft_ms": serving_ttft,
+            "serving_itl_p99_ms": serving_itl_p99,
+            "serving_prefill_budget_sweep": budget_sweep,
             # Long-context paged serving (2 slots, 8k/16k contexts):
             # device-op ms per decode step, kernel vs gathered view at
             # identical pool geometry (xplane; wall would be tunnel-

@@ -110,6 +110,24 @@ def prompt_positions(prompt_mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(prompt_mask, pos, -1)
 
 
+def window_positions(
+    base: jnp.ndarray, offset: jnp.ndarray, width: int, length: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Positions/mask for one chunk-windowed prefill slice — the fused
+    prefill-decode scheduler's window walk (``serving._fused_chunk``):
+    tokens ``[offset, offset + width)`` of a ``length``-token suffix
+    whose row KV begins at absolute position ``base`` (nonzero for
+    prefix-cache hits, which start their chunk walk at fill0).  Returns
+    ([1, width] int32 absolute positions with the -1 padding sentinel,
+    [1, width] bool mask) — the ``prompt_positions`` contract for a
+    window cut out of a longer right-padded prompt, without
+    materializing the whole prompt's position row."""
+    j = jnp.arange(width, dtype=jnp.int32)[None, :]
+    real = (offset + j) < length
+    pos = jnp.where(real, base + offset + j, -1).astype(jnp.int32)
+    return pos, real
+
+
 def finite_rows(logits: jnp.ndarray) -> jnp.ndarray:
     """Per-row non-finite guard: [..., V] logits -> [...] bool, True only
     where EVERY logit is finite.  A NaN/Inf here means the forward itself

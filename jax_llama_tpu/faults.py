@@ -21,6 +21,14 @@ Sites (fired by ``ContinuousBatcher`` just before the real operation):
                      indices count chunks, not tokens or rounds
   ``insert``         a batched full-prompt prefill (``_paged_insert``)
   ``suffix_insert``  a prefix-cache-hit suffix prefill
+  ``prefill_chunk``  a chunk dispatch CARRYING a fused prefill lane
+                     (``_fused_chunk``: fused prefill-decode
+                     scheduling, ``prefill_budget`` > 0) — the ``step``
+                     site fires for the same dispatch first; this one
+                     indexes prefill-carrying dispatches only, so
+                     ``@N`` deterministically lands a fault mid-prefill
+                     of an admission regardless of how many plain
+                     decode chunks ran before it
   ``alloc``          a block-pool allocation (``_alloc_blocks``)
   ``flash_kernel``   a dispatch whose prefill runs the Pallas flash
                      kernel (fired by the batcher per dispatch, AND by
@@ -72,7 +80,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Union
 
 SITES = (
-    "step", "insert", "suffix_insert", "alloc",
+    "step", "insert", "suffix_insert", "prefill_chunk", "alloc",
     "flash_kernel", "paged_kernel", "spec_decode",
 )
 KINDS = ("error", "oom", "delay", "nan")

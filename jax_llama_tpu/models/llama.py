@@ -261,6 +261,15 @@ def _remat(fn, config: LLaMAConfig):
 # docstring for the measured crossover.
 _POOL_WRITE_UNROLL_MAX = 256
 
+# attn_impl="auto" resolves to the Pallas flash kernel only for blocks
+# LONGER than this many tokens (decode-sized steps stay on the
+# append-free xla path, where flash's one-row grid loses).  Exported
+# because serving keeps HOST mirrors of the resolution — the classic
+# batched-prefill flash gate and the fused prefill chunk's
+# (serving._Prefill.flash) fault-site / quarantine attribution — which
+# must never drift from what forward() actually runs.
+FLASH_MIN_SEQ = 8
+
 
 def paged_pool_write(
     plane: jnp.ndarray,
@@ -979,7 +988,7 @@ def forward(
         # attention dropout run on both: the flash kernel folds dequant
         # scales — and generates dropout masks — in-kernel.)
         must_xla = cache is not None and cache.per_row_index
-        impl = "flash" if T > 8 and not must_xla else "xla"
+        impl = "flash" if T > FLASH_MIN_SEQ and not must_xla else "xla"
     if output_attentions:
         if impl == "ring":
             raise NotImplementedError(

@@ -21,6 +21,22 @@ a kv slot is attendable iff ``kv_pos <= q_pos`` (causality) and
 into the index map — query head ``h`` reads KV head ``h // group`` — so KV
 blocks are never replicated in memory (parity with the reference's
 repeat-after-cache semantics, model.py:269-270, with zero copies).
+
+Chunk-windowed prefill contract (fused prefill-decode scheduling,
+``serving._fused_chunk``): because masking is purely positional, the
+kernel needs NO special case to prefill a WINDOW of a prompt into an
+existing cache row at a nonzero base offset — the queries arrive as a
+[1, C] chunk whose positions start at ``base + off`` (``base`` = fill0
+for prefix-cache hit rows, which begin their chunk walk there), and the
+kv side is the row's gathered view where slots below the write offset
+carry earlier chunks' (or the reused prefix's) real positions and
+everything above carries -1.  Causality + the -1 rule then yield
+exactly the window's attention set; the only caller obligation is the
+scalar cache index (the per-row-index vector form routes to the XLA
+path before reaching this kernel) and the view-capacity clamp on the
+write window (``serving.ContinuousBatcher._pf_chunk``).  The serving
+fault drills exercise this path through the same ``_maybe_fault``
+trace hook as ordinary prefill.
 """
 
 from __future__ import annotations

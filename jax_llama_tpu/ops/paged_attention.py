@@ -38,6 +38,15 @@ planes via ``layer``, and serving's fused decode chunk
 iterations around the layer scan, re-deriving positions/bounds per
 iteration on device.  Under a mesh the shard_map wrapper nests inside
 those scans the same way.
+
+Fused prefill-decode scheduling (``serving._fused_chunk``) runs this
+kernel's decode scan WHILE an admission's prompt is mid-prefill in the
+same dispatch: the prefilling row rides the decode grid masked (its
+query position is -1 until its last prompt chunk lands, so it attends
+nothing and its write-back resolves to the sentinel block and drops) —
+the standard idle-row contract, no new kernel case.  Its partially
+written blocks are safe for the OTHER rows by construction: the table
+walk only visits each row's own blocks.
 """
 
 from __future__ import annotations

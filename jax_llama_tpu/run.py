@@ -66,6 +66,19 @@ def main() -> None:
                          "admissions; 1 restores the classic per-token "
                          "loop.  Speculative serving chunks by ROUNDS "
                          "through --spec-rounds instead)")
+    ap.add_argument("--prefill-budget", type=int, default=512,
+                    help="fused prefill-decode scheduling for --serve / "
+                         "--http: admissions that would stall decoding "
+                         "rows advance up to this many prompt tokens "
+                         "per decode-chunk dispatch instead of running "
+                         "a separate whole-prompt prefill (stall-free "
+                         "chunked prefill; token-identical, first token "
+                         "emitted by the dispatch that finishes the "
+                         "prompt).  The default amortizes a 16k prompt "
+                         "over ~32 steady decode chunks; 0 restores "
+                         "classic whole-prompt admission.  Ignored "
+                         "under --draft-ckpt-dir (speculative serving "
+                         "keeps classic admission)")
     ap.add_argument("--draft-ckpt-dir", default=None,
                     help="Orbax checkpoint dir of a DRAFT model for "
                          "speculative serving in --serve / --http "
@@ -102,8 +115,9 @@ def main() -> None:
                     help="deterministic fault injection for chaos runs "
                          "(--http only): comma-separated "
                          "site[@N|~P]:kind[=v] rules — sites step, "
-                         "insert, suffix_insert, alloc, flash_kernel, "
-                         "paged_kernel, spec_decode; kinds error, "
+                         "insert, suffix_insert, prefill_chunk, alloc, "
+                         "flash_kernel, paged_kernel, spec_decode; "
+                         "kinds error, "
                          "oom, delay=SECONDS, nan; e.g. 'step@5:error' "
                          "or 'paged_kernel~0.01:error'.  Also read from "
                          "the JLT_FAULTS env var")
@@ -299,6 +313,7 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         draft_params=draft_params, draft_config=draft_config,
         n_draft=getattr(args, "n_draft", 4),
         spec_rounds=getattr(args, "spec_rounds", 8),
+        prefill_budget=getattr(args, "prefill_budget", 512),
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).
@@ -405,6 +420,7 @@ def _serve(params, config, tokenizer, mesh, args) -> None:
         draft_params=draft_params, draft_config=draft_config,
         n_draft=getattr(args, "n_draft", 4),
         spec_rounds=getattr(args, "spec_rounds", 8),
+        prefill_budget=getattr(args, "prefill_budget", 512),
     )
     rid_prompt: dict = {}
     emitted: dict = {}

@@ -41,7 +41,18 @@ parity.  Design constraints, in order:
     loop pays), and ``llm_spec_window_acceptance_rate`` (gauge —
     draft-token acceptance over the last 64 dispatches; unlike the
     lifetime ``llm_draft_acceptance_rate`` it shows a draft going
-    stale mid-run).
+    stale mid-run).  Fused prefill-decode scheduling
+    (``--prefill-budget``) adds: ``llm_prefill_chunks_total``
+    (counter — chunk dispatches that also advanced an in-flight
+    admission's prompt), ``llm_prefill_tokens_inflight`` (gauge —
+    prompt tokens of the current admission still to prefill; 0 when
+    none), ``llm_fused_admissions_total`` (counter),
+    ``llm_decode_stall_ms_total`` (counter — wall time classic
+    whole-prompt admission dispatches spent while rows were
+    mid-decode; ≈0 once fused scheduling is on), and
+    ``llm_ttft_ms_ewma`` (gauge — exponentially-weighted
+    time-to-first-token over delivered requests, alpha 0.2; the
+    stall win surfaces here first).
   * **Chunked decode is transparent here.**  The batcher's ``step()``
     may return up to K tokens per slot per call
     (``serving.ContinuousBatcher`` ``decode_chunk``, run.py
@@ -212,6 +223,10 @@ class _Pending:
     # prompt's block padding ate capacity): the reply is shorter than a
     # fault-free run's and says so.
     truncated: bool = False
+    # Submit-time monotonic stamp: TTFT = first delivered token minus
+    # this (survives crash-recovery resubmits, so the gauge reflects
+    # what the CLIENT waited, recovery included).
+    submitted_at: Optional[float] = None
 
     def fail(self, message: str, code: int) -> None:
         self.error = message
@@ -280,6 +295,11 @@ class LLMServer:
         self.quarantine_rebuilds_total = 0
         self.probe_rebuilds_total = 0
         self.nonfinite_failed_total = 0
+        # Time-to-first-token EWMA (ms, alpha 0.2) over delivered
+        # requests — the latency the fused prefill-decode scheduler
+        # (serving.py, run.py --prefill-budget) exists to bound; None
+        # until the first request delivers.
+        self.ttft_ms_ewma: Optional[float] = None
         # Features whose LAST completed step's success is still
         # unconfirmed by a host sync (see the probe-success note in
         # _loop); cleared on every rebuild.
@@ -710,6 +730,8 @@ class LLMServer:
                 kwargs["stop_tokens"] = tuple(int(t) for t in stops)
         rid = self.batcher.submit(tokens, **kwargs)
         p.request_id = rid
+        if p.submitted_at is None:  # replays keep the original stamp
+            p.submitted_at = time.monotonic()
         # Snapshot the replay state (crash recovery resubmits from it):
         # original prompt, resolved sampling kwargs, and the seed pinned
         # to its resolved value — a replayed request gets a new id, so
@@ -1027,6 +1049,14 @@ class LLMServer:
                     if p is None:
                         continue
                     p.tokens.append(tok)
+                    if len(p.tokens) == 1 and p.submitted_at is not None:
+                        ttft_ms = (
+                            time.monotonic() - p.submitted_at
+                        ) * 1000.0
+                        self.ttft_ms_ewma = (
+                            ttft_ms if self.ttft_ms_ewma is None
+                            else 0.8 * self.ttft_ms_ewma + 0.2 * ttft_ms
+                        )
                     if p.want_lp and lp is not None:
                         p.lps.append(lp)
                     if p.stream:
@@ -1065,6 +1095,10 @@ class LLMServer:
             "probe_rebuilds_total": self.probe_rebuilds_total,
             "nonfinite_requests_failed_total": self.nonfinite_failed_total,
             "draining": int(self._draining.is_set()),
+            "ttft_ms_ewma": (
+                round(self.ttft_ms_ewma, 3)
+                if self.ttft_ms_ewma is not None else 0.0
+            ),
         })
         lines = []
         for k, v in stats.items():

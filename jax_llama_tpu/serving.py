@@ -86,6 +86,34 @@ TPU-native mechanics:
     ``spec_rounds``), and chunked output is token-identical to the
     classic per-round path — including the acceptance pattern and
     per-token logprobs (pinned by tests/test_serving_spec.py).
+  * **Fused prefill-decode scheduling (Sarathi-style stall-free
+    admission).**  With ``prefill_budget`` > 0 (run.py
+    ``--prefill-budget``, on by default there) the batched-prefill
+    bullet above only describes the COLD pool: once any row is
+    mid-decode, an admission no longer runs as a separate whole-prompt
+    dispatch at a step boundary — it moves through queued ->
+    prefilling(offset) -> decoding, advancing up to ``prefill_budget``
+    prompt tokens per chunk dispatch INSIDE ``_fused_chunk`` (the
+    K-iteration decode scan plus one bounded prefill chunk over the
+    row's gathered view: flash when the chunk exceeds 8 tokens,
+    gathered-XLA as the quarantine fallback; prefix-cache hit rows
+    start their chunk walk at fill0).  At most one admission is in
+    flight; its row rides the scan masked until the dispatch its last
+    prompt chunk lands, where it samples its first token (one key
+    split, exactly the classic insert's) and folds INTO the decode
+    mask mid-dispatch — first token out of the same dispatch.  Host
+    boundary: the whole prefill pays ONE admission-time upload (the
+    dirty-row sync + the one-off suffix/walk-scalar buffers) and the
+    usual one packed fetch per chunk — no per-prefill-chunk host
+    syncs; decode rows never stall and ``_pick_chunk`` no longer
+    collapses K to 1 on (fused) admissions.  Output is token- and
+    logprob-identical to the classic admit-then-decode path (pinned by
+    tests/test_serving_fused.py; on int8-KV pools the oracle is the
+    classic path at the SAME prefill chunking — chunk boundaries
+    decide where prompt KV quantizes, so identity to a single-shot
+    classic prefill holds only up to quantization noise there);
+    ``prefill_budget=0`` (the ctor default) and speculative batchers
+    keep classic admission everywhere.
 """
 
 from __future__ import annotations
@@ -93,6 +121,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import hashlib
+import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -102,9 +131,10 @@ import numpy as np
 from jax import lax
 
 from .config import LLaMAConfig
-from .engine import finite_rows, prompt_positions
+from .engine import finite_rows, prompt_positions, window_positions
 from .faults import FaultInjector
 from .models.llama import (
+    FLASH_MIN_SEQ,
     KVCache,
     PagedKVCache,
     forward,
@@ -548,59 +578,211 @@ def _paged_decode_chunk(
         use_kernel = allow_kernel and _kernel_eligible(
             pool.block_size, mesh, config.kv_heads, tau.shape[0]
         )
-
-        def body(carry, _):
-            pool, tau, tau_lp, fill, pos, active, remaining, keys = carry
-            # --- the host emit scan, on device ---
-            nonfinite = tau < 0
-            hit_stop = stop_token_hits(tau, stops)
-            out_tok = jnp.where(
-                active,
-                jnp.where(nonfinite, -1, tau),
-                _CHUNK_PAD,
-            ).astype(jnp.int32)
-            out_lp = tau_lp
-            done = active & (nonfinite | hit_stop | (remaining <= 1))
-            remaining = remaining - active.astype(jnp.int32)
-            active = active & ~done
-            # --- one decode iteration for the surviving rows ---
-            nxt, lp, keys, pool = _decode_step_core(
-                params, pool, table, n_alloc, fill, tau, pos, active,
-                keys, temperature, top_p, top_k, config=config,
-                all_greedy=all_greedy, use_kernel=use_kernel,
-                with_logprobs=with_logprobs,
-            )
-            tau = jnp.where(active, nxt, tau)
-            if with_logprobs:
-                tau_lp = jnp.where(active, lp, tau_lp)
-            fill = fill + active
-            pos = pos + active
-            return (
-                (pool, tau, tau_lp, fill, pos, active, remaining, keys),
-                (out_tok, out_lp),
-            )
-
-        carry, (toks, lps) = lax.scan(
-            body,
-            (pool, tau, tau_lp, fill, pos, active, remaining, keys),
-            None,
-            length=n_iter,
+        return _chunk_scan(
+            params, pool, table, n_alloc, fill, tau, tau_lp, pos,
+            active, remaining, stops, keys, temperature, top_p, top_k,
+            config=config, n_iter=n_iter, all_greedy=all_greedy,
+            use_kernel=use_kernel, with_logprobs=with_logprobs,
         )
+
+
+def _chunk_scan(
+    params, pool, table, n_alloc, fill, tau, tau_lp, pos, active,
+    remaining, stops, keys, temperature, top_p, top_k, *,
+    config, n_iter, all_greedy, use_kernel, with_logprobs,
+):
+    """The shared K-iteration fused decode scan — the body of
+    ``_paged_decode_chunk`` AND the decode half of ``_fused_chunk`` (the
+    fused prefill-decode program), factored out so the two cannot drift
+    (the same discipline ``_decode_step_core`` enforces one level down).
+    See ``_paged_decode_chunk``'s docstring for the full contract;
+    callers resolve ``use_kernel`` and enter the mesh."""
+
+    def body(carry, _):
         pool, tau, tau_lp, fill, pos, active, remaining, keys = carry
-        toks = jnp.swapaxes(toks, 0, 1)  # [B, K]
-        if with_logprobs:
-            # One packed transfer: fp32 logprobs ride bitcast to int32
-            # alongside the tokens, so logprobs mode still pays exactly
-            # one device->host fetch per chunk.
-            lp_bits = lax.bitcast_convert_type(
-                jnp.swapaxes(lps, 0, 1).astype(jnp.float32), jnp.int32
-            )
-            packed = jnp.stack([toks, lp_bits])  # [2, B, K]
-        else:
-            packed = toks[None]  # [1, B, K]
-        return (
-            packed, tau, tau_lp, fill, pos, active, remaining, keys, pool
+        # --- the host emit scan, on device ---
+        nonfinite = tau < 0
+        hit_stop = stop_token_hits(tau, stops)
+        out_tok = jnp.where(
+            active,
+            jnp.where(nonfinite, -1, tau),
+            _CHUNK_PAD,
+        ).astype(jnp.int32)
+        out_lp = tau_lp
+        done = active & (nonfinite | hit_stop | (remaining <= 1))
+        remaining = remaining - active.astype(jnp.int32)
+        active = active & ~done
+        # --- one decode iteration for the surviving rows ---
+        nxt, lp, keys, pool = _decode_step_core(
+            params, pool, table, n_alloc, fill, tau, pos, active,
+            keys, temperature, top_p, top_k, config=config,
+            all_greedy=all_greedy, use_kernel=use_kernel,
+            with_logprobs=with_logprobs,
         )
+        tau = jnp.where(active, nxt, tau)
+        if with_logprobs:
+            tau_lp = jnp.where(active, lp, tau_lp)
+        fill = fill + active
+        pos = pos + active
+        return (
+            (pool, tau, tau_lp, fill, pos, active, remaining, keys),
+            (out_tok, out_lp),
+        )
+
+    carry, (toks, lps) = lax.scan(
+        body,
+        (pool, tau, tau_lp, fill, pos, active, remaining, keys),
+        None,
+        length=n_iter,
+    )
+    pool, tau, tau_lp, fill, pos, active, remaining, keys = carry
+    toks = jnp.swapaxes(toks, 0, 1)  # [B, K]
+    if with_logprobs:
+        # One packed transfer: fp32 logprobs ride bitcast to int32
+        # alongside the tokens, so logprobs mode still pays exactly
+        # one device->host fetch per chunk.
+        lp_bits = lax.bitcast_convert_type(
+            jnp.swapaxes(lps, 0, 1).astype(jnp.float32), jnp.int32
+        )
+        packed = jnp.stack([toks, lp_bits])  # [2, B, K]
+    else:
+        packed = toks[None]  # [1, B, K]
+    return (
+        packed, tau, tau_lp, fill, pos, active, remaining, keys, pool
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "config", "n_iter", "pf_chunk", "all_greedy", "mesh",
+        "allow_kernel", "with_logprobs",
+    ),
+    donate_argnames=(
+        "pool", "fill", "tau", "tau_lp", "pos", "active", "remaining",
+        "keys", "pf_off",
+    ),
+)
+def _fused_chunk(
+    params, pool, table, n_alloc, fill, tau, tau_lp, pos, active,
+    remaining, stops, keys, temperature, top_p, top_k,
+    pf_row, pf_toks, pf_len, pf_base, pf_off, pf_key, *,
+    config, n_iter, pf_chunk, all_greedy=False, mesh=None,
+    allow_kernel=True, with_logprobs=False,
+):
+    """The fused prefill-decode program: ONE jitted dispatch that
+    advances up to ``pf_chunk`` prompt tokens of the single in-flight
+    admission AND runs the standard ``n_iter``-iteration decode scan —
+    so admissions never stall decode (Sarathi-style stall-free chunked
+    prefill, piggybacked on the device-resident decode chunk).
+
+    Prefill half: the admitted row's gathered view is cut from the pool
+    (``_gather_cache`` over its table row) with a SCALAR write index
+    ``pf_base + pf_off`` — scalar, not per-row, so ``forward``'s "auto"
+    resolution may run the Pallas flash kernel over the chunk
+    (pf_chunk > 8) with the gathered XLA path as the quarantine/debug
+    fallback; prefix-cache-hit rows start their chunk walk at
+    fill0 = ``pf_base`` and attend the reused KV through the same view.
+    The chunk's KV lands in the row's reserved blocks via the shared
+    ``_scatter_back`` write contract.  The last prompt token's hidden
+    state is gathered every chunk (O(D); the [1, V] head matmul is
+    noise), but only the dispatch where ``pf_off + pf_chunk >= pf_len``
+    CONSUMES it: the row's key chain splits exactly once (the
+    ``_paged_insert`` split the classic path performs), the first token
+    is sampled with the row's own policy (non-finite guard folds the -1
+    sentinel exactly as admission does), and the row folds INTO the
+    decode state mid-dispatch — active/fill/pos/tau/tau_lp/keys all
+    flip on device — so the decode scan below emits its first sampled
+    token from THIS dispatch, not a later one.  Non-final chunks
+    discard the sample and leave the key chain untouched (``pf_key`` is
+    the same device array every dispatch, so the chain starts exactly
+    where a classic ``_paged_insert`` of the request would).
+
+    Decode half: the unchanged ``_chunk_scan`` (shared with
+    ``_paged_decode_chunk``, so the fused program cannot drift from the
+    plain one).  The prefilling row rides the scan masked (position -1,
+    writes dropped) until its activation dispatch.
+
+    Host boundary: identical to ``_paged_decode_chunk`` — ONE packed
+    [1 or 2, B, K] fetch, zero steady-state uploads.  All prefill state
+    (``pf_toks`` uploaded once at admission; ``pf_off`` a donated
+    device carry advanced in-program) stays resident: a 32-chunk 16k
+    prefill costs zero per-chunk host->device transfers beyond the
+    dispatch itself.
+
+    Returns ``_chunk_scan``'s tuple + the advanced ``pf_off``.
+    """
+    with use_mesh(mesh):
+        B = tau.shape[0]
+        C = pf_chunk
+        NB, BLK = pool.pos.shape
+        # ---- one bounded prefill chunk for the in-flight admission ----
+        table_r = lax.dynamic_slice_in_dim(table, pf_row, 1, axis=0)
+        n_alloc_r = lax.dynamic_slice_in_dim(n_alloc, pf_row, 1, axis=0)
+        write_at = (pf_base + pf_off).astype(jnp.int32)
+        view = _gather_cache(pool, table_r, n_alloc_r, write_at[None])
+        # Scalar index (ONE prefilling row): keeps the view off the
+        # per-row-index must-xla path, so "auto" runs flash over the
+        # chunk; the host-side _pf_chunk clamp guarantees
+        # write_at + C <= MB * BLK (dynamic_update_slice would otherwise
+        # clamp its start and scribble over the reused prefix KV — the
+        # _suffix_pad hazard).
+        view = dataclasses.replace(view, index=write_at)
+        toks_c = lax.dynamic_slice_in_dim(pf_toks, pf_off, C)[None]
+        positions, real = window_positions(pf_base, pf_off, C, pf_len)
+        _, view, aux = forward(
+            params, toks_c, positions, config, cache=view,
+            attn_mask=real, compute_logits=False, output_last_hidden=True,
+        )
+        idx = pf_len - 1 - pf_off  # in [0, C) iff this is the last chunk
+        h_last = jnp.take_along_axis(
+            aux.last_hidden_state,
+            jnp.clip(idx, 0, C - 1)[None, None, None], axis=1,
+        )[:, 0]
+        logits_last = lm_head_logits(
+            params, h_last[:, None], config, normed=True
+        )[:, 0]
+        pool = _scatter_back(
+            pool, view, table_r, write_at[None], jnp.ones((1,), bool),
+            T=C,
+        )
+        # The admission sample — only persisted below when the prompt
+        # completes this dispatch (the split/sample topology is exactly
+        # _paged_insert's, so the row's stream is bit-identical to the
+        # classic admit-then-decode path).
+        kc, sub = _split_rows(pf_key[None])
+        t_r = lax.dynamic_slice_in_dim(temperature, pf_row, 1, axis=0)
+        p_r = lax.dynamic_slice_in_dim(top_p, pf_row, 1, axis=0)
+        k_r = lax.dynamic_slice_in_dim(top_k, pf_row, 1, axis=0)
+        first = sample_rows(sub, logits_last, t_r, p_r, k_r)
+        first_lp = (
+            _token_logprob(logits_last, first) if with_logprobs else None
+        )
+        # Non-finite guard (see _paged_insert): the -1 sentinel rides
+        # tau into the scan's emit, which fails just this request.
+        first = jnp.where(finite_rows(logits_last), first, -1)
+        done = pf_off + C >= pf_len
+        fold = (jnp.arange(B, dtype=jnp.int32) == pf_row) & done
+        active = active | fold
+        tau = jnp.where(fold, first[0], tau)
+        if with_logprobs:
+            tau_lp = jnp.where(fold, first_lp[0], tau_lp)
+        fill_done = pf_base + ((pf_len + BLK - 1) // BLK) * BLK
+        fill = jnp.where(fold, fill_done, fill)
+        pos = jnp.where(fold, pf_base + pf_len, pos)
+        keys = jnp.where(fold[:, None], kc, keys)
+        pf_off = pf_off + C
+        # ---- the standard K-iteration decode scan ----
+        use_kernel = allow_kernel and _kernel_eligible(
+            pool.block_size, mesh, config.kv_heads, B
+        )
+        out = _chunk_scan(
+            params, pool, table, n_alloc, fill, tau, tau_lp, pos,
+            active, remaining, stops, keys, temperature, top_p, top_k,
+            config=config, n_iter=n_iter, all_greedy=all_greedy,
+            use_kernel=use_kernel, with_logprobs=with_logprobs,
+        )
+        return out + (pf_off,)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -1285,6 +1467,46 @@ def _round_up(n: int, m: int) -> int:
 
 
 @dataclasses.dataclass
+class _Prefill:
+    """Host view of the single in-flight fused admission (queued ->
+    prefilling(off) -> decoding).  The device twins (``d_*``) are
+    uploaded ONCE when the prefill starts; ``d_off`` is a donated carry
+    the fused program advances on device, and ``off`` is the host's
+    deterministic replay of it (off advances by exactly ``chunk`` per
+    dispatch, so completion is host-computable without a fetch)."""
+
+    slot: int
+    req: "_Request"
+    chain: List[bytes]
+    n_share: int          # leading prefix-cache-hit blocks
+    base: int             # fill0 in tokens (block multiple)
+    suffix_len: int       # real suffix tokens still to prefill at start
+    chunk: int            # C: prompt tokens advanced per dispatch
+    off: int = 0          # suffix tokens already dispatched
+    d_toks: Any = None    # [buf] int32, uploaded once
+    d_off: Any = None     # int32 scalar, donated carry
+    d_row: Any = None     # int32 scalar
+    d_base: Any = None    # int32 scalar
+    d_len: Any = None     # int32 scalar
+    d_key: Any = None     # [2] uint32 request key (chain start)
+
+    @property
+    def remaining_tokens(self) -> int:
+        return max(0, self.suffix_len - self.off)
+
+    @property
+    def flash(self) -> bool:
+        """Host mirror of the prefill half's "auto" resolution: the
+        chunk runs the flash kernel iff it is wider than
+        ``FLASH_MIN_SEQ`` tokens and the config allows flash (the
+        view's index is scalar, so the per-row-index must-xla rule
+        never triggers here) — the shared constant keeps this mirror,
+        and therefore flash_kernel fault-site firing and quarantine
+        attribution, in lockstep with forward()'s actual resolution."""
+        return self.chunk > FLASH_MIN_SEQ
+
+
+@dataclasses.dataclass
 class _Request:
     rid: int
     tokens: List[int]
@@ -1339,6 +1561,16 @@ class ContinuousBatcher:
     1 (the default) preserves the classic one-dispatch-per-round
     behavior; serving entry points (run.py ``--spec-rounds``) default
     higher.
+
+    ``prefill_budget`` turns on fused prefill-decode scheduling (module
+    docstring, "Fused prefill-decode scheduling"): warm admissions
+    advance up to that many prompt tokens per chunk dispatch inside the
+    decode chunk itself instead of stalling every decoding row for a
+    whole-prompt prefill dispatch — token-identical to the classic
+    path, first sampled token emitted by the dispatch that finishes the
+    prefill.  0 (the default) keeps classic admission; serving entry
+    points (run.py ``--prefill-budget``) default it on.  Ignored by
+    speculative batchers.
     """
 
     def __init__(
@@ -1365,6 +1597,7 @@ class ContinuousBatcher:
         fault_injector: Optional[FaultInjector] = None,
         decode_chunk: int = 1,
         spec_rounds: int = 1,
+        prefill_budget: int = 0,
     ):
         # Raw construction arguments, captured before any derivation so
         # ``rebuild()`` (crash recovery) reproduces this batcher exactly
@@ -1380,6 +1613,7 @@ class ContinuousBatcher:
             use_pallas_kernel=use_pallas_kernel, logprobs=logprobs,
             prefix_cache=prefix_cache, fault_injector=fault_injector,
             decode_chunk=decode_chunk, spec_rounds=spec_rounds,
+            prefill_budget=prefill_budget,
         )
         self.fault_injector = fault_injector
         if config.attn_impl not in ("xla", "auto"):
@@ -1526,6 +1760,23 @@ class ContinuousBatcher:
         # R adapts through the same _pick_chunk policy).  1 = the
         # classic one-dispatch-per-round loop.
         self.spec_rounds = max(1, int(spec_rounds))
+        # prefill_budget: fused prefill-decode scheduling.  > 0 admits
+        # prompts that would stall mid-decode rows through _fused_chunk
+        # instead of a whole-prompt _paged_insert dispatch: each chunk
+        # dispatch also advances up to this many prompt tokens of at
+        # most ONE in-flight admission (queued -> prefilling(off) ->
+        # decoding), with the admitted row folding into the decode mask
+        # the dispatch its last chunk lands.  0 (the ctor default)
+        # keeps every admission on the classic whole-prompt path — the
+        # parity oracle; the serving entry points (run.py
+        # --prefill-budget) default it on.  A COLD pool (no row
+        # mid-decode, no prefill in flight) still admits through the
+        # classic batched insert even when fused: there is nobody to
+        # stall, and a k-request cold burst pays one dispatch, not k
+        # chunk walks.  Speculative batchers keep classic admission
+        # (the spec round program has no prefill lane).
+        self.prefill_budget = max(0, int(prefill_budget))
+        self._pf: Optional[_Prefill] = None
         # Device-resident twins (chunked path only).
         self.d_table = jnp.asarray(self.table)
         self.d_n_alloc = jnp.asarray(self.n_alloc)
@@ -1563,6 +1814,16 @@ class ContinuousBatcher:
         self.spec_host_syncs_total = 0
         self.spec_emitted_total = 0
         self._accept_window: deque = deque(maxlen=64)
+        # Fused prefill-decode observability: chunk dispatches that
+        # carried a prefill lane, admissions routed through the fused
+        # path, and the wall time classic whole-prompt admission
+        # dispatches spent while >= 1 row was mid-decode — the decode
+        # stall fused scheduling exists to eliminate (stays ~0 with
+        # prefill_budget > 0; approximate on the suffix path, whose
+        # dispatch is async).
+        self.prefill_chunks_total = 0
+        self.fused_admissions_total = 0
+        self.decode_stall_ms_total = 0.0
 
         self.slots: Dict[int, Optional[_Slot]] = {
             b: None for b in range(n_slots)
@@ -1786,6 +2047,19 @@ class ContinuousBatcher:
                 / max(1, self.spec_emitted_total)
             ),
             "spec_window_acceptance_rate": self._window_acceptance(),
+            # Fused prefill-decode scheduling (zero / empty with
+            # prefill_budget=0): prompt tokens of the in-flight
+            # admission still to prefill, chunk dispatches that carried
+            # a prefill lane, admissions routed through the fused path,
+            # and the cumulative decode stall classic whole-prompt
+            # admissions cost (≈0 once fused scheduling is on).
+            "prefill_budget": self.prefill_budget,
+            "prefill_tokens_inflight": (
+                self._pf.remaining_tokens if self._pf is not None else 0
+            ),
+            "prefill_chunks_total": self.prefill_chunks_total,
+            "fused_admissions_total": self.fused_admissions_total,
+            "decode_stall_ms_total": round(self.decode_stall_ms_total, 3),
         })
         return out
 
@@ -1820,8 +2094,18 @@ class ContinuousBatcher:
         remaining budget) once slots are steady.
         """
         self.last_step_features = set()
+        # Fused scheduling routes warm admissions through the chunk
+        # dispatch itself (no insert program), so the deferred-error
+        # barrier below — which exists to keep attribution on a CLASSIC
+        # insert dispatch — must not fire for them: it would re-add the
+        # per-dispatch host sync chunking removed.
+        classic_admission_possible = not (
+            self._fused_scheduling()
+            and (self._pf is not None or bool(np.any(self.active)))
+        )
         if (
-            self.queue
+            classic_admission_possible
+            and self.queue
             and any(s is not None for s in self.slots.values())
             and any(s is None for s in self.slots.values())
         ):
@@ -1866,7 +2150,14 @@ class ContinuousBatcher:
         chunk programs.  ``cap`` defaults to ``decode_chunk``; the
         speculative path passes ``spec_rounds`` (each round emits at
         least one token, so clamping R by the token budget bounds the
-        dead masked tail the same way it does for K)."""
+        dead masked tail the same way it does for K).
+
+        ``admitted`` only counts CLASSIC whole-prompt admissions: a
+        fused admission's first token is sampled inside the chunk
+        dispatch chain itself, so K no longer collapses to 1 while a
+        prefill rides along — exactly when a burst is hammering the
+        server (the queued clamp below still bounds the queue head's
+        wait on a finishing slot)."""
         cap = self.decode_chunk if cap is None else cap
         if cap <= 1 or admitted:
             return 1
@@ -1919,9 +2210,17 @@ class ContinuousBatcher:
     def _step_chunked(self) -> List[Tuple]:
         """Non-speculative step: one fused K-iteration chunk dispatch,
         one packed fetch, then the host replays the block to advance its
-        mirrors and emit events."""
-        # Admissions since the last chunk dispatch — including one this
-        # step() performed at the PREVIOUS call's trailing _admit().
+        mirrors and emit events.  While an admission is mid-prefill
+        (``self._pf``) the dispatch is ``_fused_chunk`` — the same K
+        decode iterations PLUS one bounded prefill chunk, same packed
+        fetch — so decoding rows keep emitting while the prompt lands,
+        and K does NOT collapse to 1 (fused admissions never set the
+        ``admitted`` reset; the first token rides this dispatch chain
+        regardless of K)."""
+        # CLASSIC admissions since the last chunk dispatch — including
+        # one this step() performed at the PREVIOUS call's trailing
+        # _admit().  Fused admissions perform no insert dispatch, so
+        # they neither owe the error barrier nor reset K.
         admitted = self._admit_dispatches > self._admits_at_last_chunk
         if admitted:
             # Surface any async admission-dispatch error NOW, while
@@ -1930,40 +2229,104 @@ class ContinuousBatcher:
             np.asarray(self.tau)
             self.host_syncs_total += 1
         self._admits_at_last_chunk = self._admit_dispatches
-        K = self._pick_chunk(admitted)
+        pf = self._pf
+        if pf is not None and not bool(np.any(self.active)):
+            # Nothing is decoding: the scan half would be all-masked
+            # forwards, so keep it minimal while the prefill advances.
+            K = 1
+        else:
+            K = self._pick_chunk(admitted)
         self._sync_device_rows()
         # Injection site "step": fires BEFORE the chunk dispatch; an
         # exception out of the dispatch (or its packed fetch below)
         # reaches the caller with nothing appended to slot.emitted or
         # delivered — recovery replays from the server's delivered-token
-        # record, exactly as in the K=1 contract.  The paged_kernel site
-        # fires once per CHUNK dispatch, not per token (same for the
-        # dispatch-attribution record).
+        # record, exactly as in the K=1 contract (a mid-prefill request
+        # replays from its prompt + delivered tokens like any other).
+        # The paged_kernel site fires once per CHUNK dispatch, not per
+        # token; when a prefill chunk rides along on the flash path the
+        # flash_kernel site fires too (same dispatch, finer
+        # attribution — a flash quarantine rebuilds onto attn_impl=xla
+        # and the replayed admission continues on the gathered path).
         feats: List[str] = []
         if self.use_pallas_kernel and _kernel_eligible(
             self.block_size, self.mesh, self.config.kv_heads,
             self.n_slots,
         ):
             feats.append("paged_kernel")
+        pf_flash = (
+            pf is not None and pf.flash
+            and self.config.attn_impl in ("auto", "flash")
+        )
+        if pf_flash:
+            feats.append("flash_attention")
         self._record_dispatch(feats)
         self._fault("step")
+        if pf is not None:
+            # Site "prefill_chunk": indexes prefill-CARRYING dispatches
+            # only, so drills can land a fault mid-prefill
+            # deterministically (plain decode chunks do not advance its
+            # counter).
+            self._fault("prefill_chunk")
+        if pf_flash:
+            self._fault("flash_kernel")
         if "paged_kernel" in feats:
             self._fault("paged_kernel")
         self.steps_total += K
         self.decode_dispatches_total += 1
         self.decode_chunk_last = K
         all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
-        (packed, self.tau, self.d_tau_lp, self.d_fill, self.d_pos,
-         self.d_active, self.d_remaining, self.keys,
-         self.pool) = _paged_decode_chunk(
-            self.params, self.pool, self.d_table, self.d_n_alloc,
-            self.d_fill, self.tau, self.d_tau_lp, self.d_pos,
-            self.d_active, self.d_remaining, self.d_stops, self.keys,
-            self.d_temps, self.d_top_ps, self.d_top_ks,
-            config=self.config, n_iter=K, all_greedy=all_greedy,
-            mesh=self.mesh, allow_kernel=self.use_pallas_kernel,
-            with_logprobs=self.logprobs,
-        )
+        if pf is None:
+            (packed, self.tau, self.d_tau_lp, self.d_fill, self.d_pos,
+             self.d_active, self.d_remaining, self.keys,
+             self.pool) = _paged_decode_chunk(
+                self.params, self.pool, self.d_table, self.d_n_alloc,
+                self.d_fill, self.tau, self.d_tau_lp, self.d_pos,
+                self.d_active, self.d_remaining, self.d_stops, self.keys,
+                self.d_temps, self.d_top_ps, self.d_top_ks,
+                config=self.config, n_iter=K, all_greedy=all_greedy,
+                mesh=self.mesh, allow_kernel=self.use_pallas_kernel,
+                with_logprobs=self.logprobs,
+            )
+        else:
+            # The prefilling request samples inside the program, so the
+            # greedy specialization must account for its policy too.
+            all_greedy = all_greedy and pf.req.temperature <= 0.0
+            (packed, self.tau, self.d_tau_lp, self.d_fill, self.d_pos,
+             self.d_active, self.d_remaining, self.keys, self.pool,
+             pf.d_off) = _fused_chunk(
+                self.params, self.pool, self.d_table, self.d_n_alloc,
+                self.d_fill, self.tau, self.d_tau_lp, self.d_pos,
+                self.d_active, self.d_remaining, self.d_stops, self.keys,
+                self.d_temps, self.d_top_ps, self.d_top_ks,
+                pf.d_row, pf.d_toks, pf.d_len, pf.d_base, pf.d_off,
+                pf.d_key,
+                config=self.config, n_iter=K, pf_chunk=pf.chunk,
+                all_greedy=all_greedy, mesh=self.mesh,
+                allow_kernel=self.use_pallas_kernel,
+                with_logprobs=self.logprobs,
+            )
+            self.prefill_chunks_total += 1
+            pf.off += pf.chunk
+            if pf.off >= pf.suffix_len:
+                # Prefill complete: the device already folded the row
+                # into the decode state mid-dispatch (and the scan below
+                # emitted its first token); catch the host mirrors up —
+                # device_done semantics, no dirty marking — and publish
+                # the request's freshly written full prompt blocks
+                # (only now do they hold the whole chain's KV).
+                b = pf.slot
+                self.fill[b] = _round_up(
+                    len(pf.req.tokens), self.block_size
+                )
+                self.pos[b] = len(pf.req.tokens)
+                self.active[b] = True
+                slot = self.slots[b]
+                self._register_chain(
+                    slot.blocks[pf.n_share: len(pf.chain)],
+                    pf.chain[pf.n_share:],
+                )
+                self._pf = None
         # THE one device->host sync of the chunk: tokens (+ bitcast
         # logprobs) in a single packed array.
         arr = np.asarray(packed)
@@ -2522,6 +2885,15 @@ class ContinuousBatcher:
         blocks the allocator may hand to someone else."""
         slot = self.slots[b]
         assert slot is not None
+        if self._pf is not None and self._pf.slot == b:
+            # Mid-prefill free (cancel / forced-nan drill): drop the
+            # in-flight admission — no further fused dispatches reference
+            # it, and device ordering makes the already-enqueued chunk
+            # writes land before any re-allocation of its blocks.  The
+            # chain was never published (publication happens at
+            # completion), so nothing to unpublish beyond _fail_slot's
+            # usual scan.
+            self._pf = None
         # Keyed blocks with no remaining users are RETAINED (prefix
         # cache) — their positions must stay valid for future reusers —
         # later chain blocks enter the LRU first so chains evict
@@ -2747,7 +3119,153 @@ class ContinuousBatcher:
             self.prefix_requests_hit += 1
             self.prefix_blocks_reused += n_share
 
+    def _fused_scheduling(self) -> bool:
+        """Fused prefill-decode scheduling is in force for this batcher
+        (spec batchers keep classic admission — the round program has no
+        prefill lane; quarantine off spec_decode lands on a plain
+        chunked batcher where it IS in force)."""
+        return self.prefill_budget > 0 and not self.spec
+
     def _admit(self) -> None:
+        """Admit queued requests.
+
+        Classic path (``prefill_budget=0``, speculative batchers, or a
+        COLD pool with nothing mid-decode): whole-prompt batched
+        prefill dispatches at the step boundary — see
+        ``_admit_classic``.  Fused path (``prefill_budget`` > 0 while
+        any row is mid-decode): the queue head is moved to
+        ``prefilling`` state (blocks reserved, prompt uploaded once,
+        row visible-but-inactive) and its prompt advances INSIDE the
+        subsequent ``_fused_chunk`` dispatches — at most one admission
+        is in flight at a time, FIFO; the rest of the queue waits
+        exactly as it would for capacity."""
+        if self._fused_scheduling():
+            if self._pf is not None:
+                return  # one in-flight admission at a time
+            if bool(np.any(self.active)):
+                if self.queue:
+                    self._begin_fused_prefill()
+                return
+            # Cold pool: nobody to stall — classic batched admission.
+        self._admit_classic()
+
+    def _pf_chunk(self, suffix_len: int, n_share: int) -> int:
+        """Prompt tokens per fused dispatch: ``prefill_budget`` rounded
+        DOWN to a pow2 block count (jit-cache discipline that still
+        honors the flag as an upper bound — rounding up would let a
+        640-token budget ride 1024 tokens of prefill per dispatch,
+        inflating exactly the per-dispatch ITL the flag caps; the floor
+        is one block), clamped to the suffix's own pow2 bucket, then
+        halved until the LAST chunk's write window fits the row's
+        remaining gathered-view columns — the ``_suffix_pad`` clamp
+        hazard: the in-forward cache write is a scalar-start
+        dynamic-update that would silently clamp and scribble over the
+        reused prefix KV.  Terminates at one block, where admissibility
+        guarantees the fit."""
+        bs = self.block_size
+        nbb = max(1, self.prefill_budget // bs)
+        nbb = 1 << (nbb.bit_length() - 1)
+        nbs = max(1, -(-suffix_len // bs))
+        nbs = 1 << (nbs - 1).bit_length()
+        c_blocks = min(nbb, nbs)
+        view_blocks = self.blocks_per_slot - n_share
+        while c_blocks > 1 and (
+            -(-suffix_len // (c_blocks * bs)) * c_blocks > view_blocks
+        ):
+            c_blocks //= 2
+        return c_blocks * bs
+
+    def _begin_fused_prefill(self) -> None:
+        """Move the queue head into ``prefilling`` state: reserve its
+        blocks (claiming prefix-cache hits — hit rows start their chunk
+        walk at fill0), set up the host mirrors with the row VISIBLE
+        BUT INACTIVE (the fused program activates it on device the
+        dispatch its last chunk lands), and upload the suffix tokens +
+        walk scalars ONCE — later chunks are pure dispatches, zero
+        per-chunk host->device state traffic.  No model dispatch
+        happens here; the prefill itself rides ``_fused_chunk``."""
+        free = [b for b, s in self.slots.items() if s is None]
+        if not free:
+            return
+        req = self.queue[0]
+        need = req.blocks_needed(self.block_size)
+        if need > self._capacity():
+            return  # head-of-line blocking (FIFO fairness): wait
+        chain = (
+            self._chain_keys(req.tokens, self.block_size)
+            if self.prefix_cache_enabled else []
+        )
+        hits = self._match_prefix(chain)
+        self._claim_blocks(hits)
+        del self.queue[0]
+        b = free[0]
+        n_share = len(hits)
+        base = n_share * self.block_size
+        fresh = self._alloc_blocks(need - n_share)
+        self._claim_blocks(fresh)
+        blocks = hits + fresh
+        suffix = req.tokens[base:]
+        C = self._pf_chunk(len(suffix), n_share)
+        # Token buffer in whole chunks, chunk count pow2-bucketed (the
+        # buffer length is a jit cache key of _fused_chunk); trailing
+        # zeros are masked and never dispatched.
+        n_chunks = max(1, -(-len(suffix) // C))
+        n_chunks = 1 << (n_chunks - 1).bit_length()
+        toks = np.zeros((n_chunks * C,), np.int32)
+        toks[: len(suffix)] = suffix
+        # Host mirrors: full reservation visible, row inactive; the
+        # admission-time dirty sync is the ONE state upload the whole
+        # prefill pays.
+        self.table[b] = self.n_blocks
+        self.table[b, : len(blocks)] = blocks
+        self.n_alloc[b] = len(blocks)
+        self.fill[b] = 0
+        self.pos[b] = 0
+        self.active[b] = False
+        self.temp_arr[b] = req.temperature
+        self.top_p_arr[b] = req.top_p
+        self.top_k_arr[b] = req.top_k
+        self.remaining[b] = req.max_new
+        self._set_stop_row(b, req.stops)
+        self._dirty_rows.add(b)
+        self.slots[b] = _Slot(
+            request_id=req.rid, emitted=[], max_new=req.max_new,
+            stop_tokens=req.stops, blocks=blocks, shared=n_share,
+        )
+        self._pf = _Prefill(
+            slot=b, req=req, chain=chain, n_share=n_share, base=base,
+            suffix_len=len(suffix), chunk=C,
+            d_toks=jnp.asarray(toks),
+            d_off=jnp.zeros((), jnp.int32),
+            d_row=jnp.asarray(np.int32(b)),
+            d_base=jnp.asarray(np.int32(base)),
+            d_len=jnp.asarray(np.int32(len(suffix))),
+            d_key=jnp.asarray(self._request_key(req)),
+        )
+        self.fused_admissions_total += 1
+        if n_share:
+            self.prefix_requests_hit += 1
+            self.prefix_blocks_reused += n_share
+
+    def _admit_classic(self) -> None:
+        """Classic admission with the decode-stall clock around it: the
+        wall time whole-prompt admission dispatches spend while >= 1
+        row is mid-decode accumulates into ``decode_stall_ms_total``
+        (the batched-prefill path's plens fetch blocks, so the timing is
+        real there; the suffix path's dispatch is async and
+        undercounts)."""
+        before = self._admit_dispatches
+        decoding = bool(np.any(self.active))
+        t0 = time.monotonic()
+        try:
+            self._admit_classic_impl()
+        finally:
+            if decoding and self._admit_dispatches > before:
+                self.decode_stall_ms_total += (
+                    (time.monotonic() - t0) * 1000.0
+                )
+
+    def _admit_classic_impl(self) -> None:
         """Admit queued requests into free slots.
 
         A burst of k admissible requests without prefix-cache hits
@@ -2848,7 +3366,10 @@ class ContinuousBatcher:
                 self.prefill_chunk
                 if self.prefill_chunk and self.prefill_chunk < P else P
             )
-            flash = self.config.attn_impl in ("auto", "flash") and chunk > 8
+            flash = (
+                self.config.attn_impl in ("auto", "flash")
+                and chunk > FLASH_MIN_SEQ
+            )
             self._record_dispatch(
                 ["flash_attention"] if flash else []
             )

@@ -295,6 +295,71 @@ def test_flash_attention_quarantine_rebuilds_onto_xla(model):
         assert srv.batcher.config.attn_impl == "xla"
 
 
+def test_flash_quarantine_during_fused_prefill_keeps_admission(model):
+    """flash_kernel faults during FUSED prefill chunks (attn auto, a
+    >8-token chunk riding the decode dispatch) quarantine
+    flash_attention: the batcher rebuilds onto attn_impl='xla', the
+    mid-prefill admission replays instead of dropping, and fused
+    scheduling keeps running on the gathered path afterwards."""
+    params, config = model
+    auto_cfg = config.replace(attn_impl="auto")
+    long_prompt = np.random.RandomState(3).randint(1, 128, 40).tolist()
+    cb0 = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, block_size=8,
+    )
+    ra = cb0.submit(list(PROMPTS[0]), max_new_tokens=24)
+    rb = cb0.submit(list(long_prompt), max_new_tokens=MAX_NEW)
+    rc = cb0.submit(list(PROMPTS[1]), max_new_tokens=MAX_NEW)
+    out0 = cb0.run_to_completion()
+    want_a, want_b, want_c = out0[ra], out0[rb], out0[rc]
+
+    # block_size=8 keeps the resident's COLD 8-token classic prefill on
+    # the xla path (flash needs a >8-token chunk), so the ONLY flash
+    # dispatches are the fused prefill chunks (budget 16 > 8).
+    inj = FaultInjector("flash_kernel~1.0:error")
+    cb = ContinuousBatcher(
+        params, auto_cfg, n_slots=2, max_len=64, block_size=8,
+        decode_chunk=4, prefill_budget=16, fault_injector=inj,
+    )
+    with LLMServer(
+        cb, quarantine_threshold=1, quarantine_cooldown_s=3600.0
+    ) as srv:
+        req = urllib.request.Request(
+            srv.address + "/generate",
+            data=json.dumps({
+                "prompt": PROMPTS[0], "max_new_tokens": 24,
+                "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            first = json.loads(resp.readline())
+            assert "token" in first
+            # Admits mid-decode -> fused prefill on flash -> fault ->
+            # flash_attention quarantined, admission replayed.
+            _, body = _post(
+                srv.address,
+                {"prompt": long_prompt, "max_new_tokens": MAX_NEW},
+            )
+            assert body["tokens"] == want_b  # admission NOT dropped
+            assert srv.degrade.quarantined() == ("flash_attention",)
+            assert srv.batcher.config.attn_impl == "xla"
+            # Fused scheduling survived the rebuild; a follow-up warm
+            # admission rides it on the gathered/xla path.
+            assert srv.batcher.prefill_budget == 16
+            _, body2 = _post(
+                srv.address,
+                {"prompt": PROMPTS[1], "max_new_tokens": MAX_NEW},
+            )
+            assert body2["tokens"] == want_c
+            lines = [first] + [
+                json.loads(ln) for ln in resp.read().splitlines()
+            ]
+        streamed = [ln["token"] for ln in lines[:-1]]
+        assert streamed == want_a  # resident: no dup, no gap
+        assert inj.injected_total >= 1
+
+
 def test_prefix_cache_quarantine_serves_cold(model):
     """Every prefix-cache-hit suffix dispatch faults: the feature
     quarantines and later sharers admit through cold full prefill —

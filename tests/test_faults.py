@@ -89,6 +89,64 @@ def _stream_lines(url, payload, timeout=300):
 # FaultInjector unit behavior (no jax involved)
 # ---------------------------------------------------------------------------
 
+def test_step_fault_mid_prefill_chunk_replays_exactly(model):
+    """A fault landing MID-PREFILL-CHUNK (the ``prefill_chunk`` site
+    indexes prefill-carrying dispatches, so ``@1`` deterministically
+    kills the second chunk of the fused admission) recovers: the
+    partially-prefilled request replays token-exact from its prompt,
+    the streaming resident sees every token exactly once, and the
+    rebuilt batcher keeps the fused-scheduling configuration."""
+    params, config = model
+    long_prompt = np.random.RandomState(3).randint(1, 128, 40).tolist()
+    cb0 = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, block_size=16,
+    )
+    ra = cb0.submit(list(PROMPTS[0]), max_new_tokens=24)
+    rb = cb0.submit(list(long_prompt), max_new_tokens=MAX_NEW)
+    out0 = cb0.run_to_completion()
+    want_a, want_b = out0[ra], out0[rb]
+
+    inj = FaultInjector("prefill_chunk@1:error")
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, block_size=16,
+        decode_chunk=4, prefill_budget=16, fault_injector=inj,
+    )
+    with LLMServer(cb) as srv:
+        # Resident streamer holds a decoding row; reading its first
+        # token guarantees the pool is warm before the long prompt
+        # posts — so the admission rides the FUSED path (40 suffix
+        # tokens at a 16-token budget = 3 prefill-carrying dispatches;
+        # the injected fault kills the second, mid-prefill).
+        req = urllib.request.Request(
+            srv.address + "/generate",
+            data=json.dumps({
+                "prompt": PROMPTS[0], "max_new_tokens": 24,
+                "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            first = json.loads(resp.readline())
+            assert "token" in first
+            _, body = _post(
+                srv.address,
+                {"prompt": long_prompt, "max_new_tokens": MAX_NEW},
+            )
+            lines = [first] + [
+                json.loads(ln) for ln in resp.read().splitlines()
+            ]
+        assert inj.injected_total == 1
+        assert srv.recoveries_total == 1
+        # The mid-prefill request replayed token-exact...
+        assert body["tokens"] == want_b
+        # ...and the streaming resident saw no duplicate or gap.
+        streamed = [ln["token"] for ln in lines[:-1]]
+        assert streamed == want_a
+        assert lines[-1]["done"] is True and lines[-1]["tokens"] == want_a
+        # Recovery rebuilt with fused scheduling intact.
+        assert srv.batcher.prefill_budget == 16
+
+
 def test_fault_spec_parse():
     specs = FaultSpec.parse(
         "step@5:error, alloc@0:oom,insert~0.25:error,step@3:delay=1.5"

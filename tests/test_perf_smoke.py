@@ -133,6 +133,107 @@ def test_metrics_surface(model):
 
 
 # ---------------------------------------------------------------------------
+# Fused prefill-decode scheduling owes the same discipline
+# ---------------------------------------------------------------------------
+
+def test_fused_admission_host_sync_discipline(model):
+    """A fused admission's whole prefill pays ONE state upload (its
+    admission-time dirty-row sync; the suffix/walk buffers upload once
+    and are not state syncs) and every chunk dispatch — prefill riding
+    or not — pays exactly 1 device->host fetch: no per-prefill-chunk
+    host sync, the satellite contract of stall-free admission."""
+    params, config = model
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=128, decode_chunk=4,
+        block_size=16, prefill_budget=16,
+    )
+    cb.submit(list(np.random.RandomState(0).randint(1, 128, 9)),
+              max_new_tokens=60)
+    cb.step()   # cold pool: classic admission (nobody to stall)
+    cb.step()   # chunk ramp
+    assert cb.fused_admissions_total == 0
+    s0, u0, d0 = (
+        cb.host_syncs_total, cb.state_uploads_total,
+        cb.decode_dispatches_total,
+    )
+    # 60-token prompt at a 16-token budget: 4 prefill-carrying chunks.
+    cb.submit(list(np.random.RandomState(1).randint(1, 128, 60)),
+              max_new_tokens=8)
+    steps = 0
+    while cb._pf is not None or cb.prefill_chunks_total == 0:
+        cb.step()
+        steps += 1
+        assert steps < 10
+    assert cb.fused_admissions_total == 1
+    assert cb.prefill_chunks_total == 4
+    dispatches = cb.decode_dispatches_total - d0
+    # Exactly 1 fetch per chunk dispatch (the packed token block) —
+    # fused admission added NO insert barrier and NO per-chunk sync...
+    assert cb.host_syncs_total - s0 == dispatches
+    # ...and exactly ONE state upload for the whole admission.
+    assert cb.state_uploads_total - u0 == 1
+    while cb.pending():
+        cb.step()
+
+
+def test_fused_prefill_does_not_collapse_chunk_size(model):
+    """_pick_chunk no longer resets K to 1 when an admission rides the
+    fused path (the first token comes out of the dispatch chain itself,
+    so there is no TTFT reason to shrink the chunk), and decode rows
+    keep emitting through every mid-prefill dispatch — zero
+    full-prefill stalls."""
+    params, config = model
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=128, decode_chunk=4,
+        block_size=16, prefill_budget=16,
+    )
+    r0 = cb.submit(list(np.random.RandomState(0).randint(1, 128, 9)),
+                   max_new_tokens=60)
+    cb.step(); cb.step(); cb.step()
+    assert cb.decode_chunk_last == 4  # steady before the admission
+    cb.submit(list(np.random.RandomState(1).randint(1, 128, 60)),
+              max_new_tokens=8)
+    steps = 0
+    while cb._pf is not None or cb.prefill_chunks_total == 0:
+        evs = cb.step()
+        steps += 1
+        assert steps < 10
+        # The fused dispatch kept a fused-K scan AND the resident row
+        # kept emitting (the classic path would have reset to K=1 and,
+        # worse, stalled the row for the whole-prompt insert).
+        assert cb.decode_chunk_last == 4
+        assert any(ev[0] == r0 for ev in evs)
+    while cb.pending():
+        cb.step()
+
+
+def test_fused_metrics_surface(model):
+    """The fused-scheduling observability gauges are in stats() (and
+    therefore in the HTTP /metrics exposition)."""
+    params, config = model
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=128, decode_chunk=4,
+        block_size=16, prefill_budget=16,
+    )
+    cb.submit([4, 5, 6], max_new_tokens=20)
+    cb.step(); cb.step()
+    cb.submit(list(np.random.RandomState(1).randint(1, 128, 40)),
+              max_new_tokens=4)
+    cb.run_to_completion()
+    stats = cb.stats()
+    for key in (
+        "prefill_budget", "prefill_tokens_inflight",
+        "prefill_chunks_total", "fused_admissions_total",
+        "decode_stall_ms_total",
+    ):
+        assert key in stats, key
+    assert stats["prefill_budget"] == 16
+    assert stats["fused_admissions_total"] == 1
+    assert stats["prefill_chunks_total"] >= 2
+    assert stats["prefill_tokens_inflight"] == 0  # drained
+
+
+# ---------------------------------------------------------------------------
 # The speculative path (spec_rounds > 1) owes the same discipline
 # ---------------------------------------------------------------------------
 

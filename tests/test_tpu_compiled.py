@@ -372,6 +372,72 @@ def test_spec_rounds_chunk_no_full_pool_copies_compiled():
 
 
 @requires_tpu
+def test_fused_chunk_no_full_pool_copies_compiled():
+    """The fused prefill-decode program (``_fused_chunk``, the serving
+    hot path while an admission is mid-prefill) must uphold the same
+    lowering invariants as the plain chunk program: the KV pool and the
+    per-slot batcher state ride as DONATED carries (the entry
+    computation carries input_output_alias entries for them) and no
+    pool-sized copy/dynamic-slice appears — the prefill half gathers
+    ONE row's view, never the pool, and the decode scan's carry must
+    not materialize a pool copy at the scan boundary.  Same HLO-text
+    assertion as its siblings, against the live mid-prefill args the
+    batcher actually dispatches."""
+    import re
+
+    from jax_llama_tpu import get_config, init_params
+    from jax_llama_tpu.serving import ContinuousBatcher
+
+    cfg = get_config(
+        "tiny", dim=256, n_layers=4, n_heads=4, n_kv_heads=2,
+        vocab_size=512, max_seq_len=256, param_dtype="bfloat16",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cb = ContinuousBatcher(params, cfg, n_slots=4, max_len=256,
+                           block_size=32, decode_chunk=4,
+                           prefill_budget=64)
+    rng = np.random.RandomState(5)
+    cb.submit(list(rng.randint(1, cfg.vocab_size, 100)),
+              max_new_tokens=16)
+    cb.step()  # cold classic admission
+    cb.step()
+    cb.submit(list(rng.randint(1, cfg.vocab_size, 100)),
+              max_new_tokens=16)
+    cb.step()  # fused prefill starts (128-token suffix > one 64 chunk)
+    assert cb._pf is not None  # the fused program has concrete args
+
+    from jax_llama_tpu import serving as srv
+
+    pf = cb._pf
+    L, KVH = cfg.n_layers, cfg.kv_heads
+    NB, BLK = cb.pool.pos.shape
+    d = cfg.head_dim
+    lowered = srv._fused_chunk.lower(
+        cb.params, cb.pool, cb.d_table, cb.d_n_alloc, cb.d_fill,
+        cb.tau, cb.d_tau_lp, cb.d_pos, cb.d_active, cb.d_remaining,
+        cb.d_stops, cb.keys, cb.d_temps, cb.d_top_ps, cb.d_top_ks,
+        pf.d_row, pf.d_toks, pf.d_len, pf.d_base, pf.d_off, pf.d_key,
+        config=cb.config, n_iter=4, pf_chunk=pf.chunk,
+        all_greedy=True, mesh=None, allow_kernel=True,
+        with_logprobs=False,
+    )
+    txt = lowered.compile().as_text()
+    # Donation pin: the pool and the decode-state carries alias inputs
+    # to outputs (a dropped donate_argnames entry would silently double
+    # KV HBM and re-upload state every dispatch).
+    assert "input_output_alias" in txt
+    pool_shape = rf"{L},{KVH},{NB},{BLK},{d}"
+    plane_shape = rf"{KVH},{NB},{BLK},{d}"
+    offenders = [
+        line.strip()[:140]
+        for line in txt.splitlines()
+        if re.search(rf"(copy|dynamic-slice)[^=]*=[^=]*\[({pool_shape}|{plane_shape})\]", line)
+        or (" copy(" in line and f"[{pool_shape}]" in line)
+    ]
+    assert not offenders, offenders
+
+
+@requires_tpu
 def test_device_op_times_compiled():
     """utils.profiling.device_op_times — the measurement primitive behind
     every bench/ROADMAP perf number — attributes device time to a known
