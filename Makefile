@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 faults chaos tpu perf-smoke
+.PHONY: tier1 faults chaos tpu perf-smoke kvcache
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -31,10 +31,18 @@ chaos:
 # rounds, or a prefill-carrying chunk), that decode rows keep emitting
 # while a long prompt is mid-prefill (zero full-prefill stalls) with K
 # un-collapsed — plus the K>1 vs K=1, spec_rounds>1 vs 1, and fused vs
-# classic-admission token-identity matrices.  These also run inside
-# tier1; this target is the fast pre-push slice.
+# classic-admission token-identity matrices.  The KV-capacity subsystem
+# owes the same discipline: ZERO decode-chunk stalls while a host-tier
+# swap-in is in flight (every mid-swap dispatch keeps emitting at an
+# un-collapsed K) and a radix/restored admission pays <= 1 state
+# upload — the same budget as a fused admission.  These also run
+# inside tier1; this target is the fast pre-push slice.
 perf-smoke:
-	$(PYTEST) tests/test_perf_smoke.py tests/test_serving_chunked.py tests/test_serving_spec.py tests/test_serving_fused.py -q -m 'not slow'
+	$(PYTEST) tests/test_perf_smoke.py tests/test_serving_chunked.py tests/test_serving_spec.py tests/test_serving_fused.py tests/test_kvcache.py -q -m 'not slow'
+
+# Just the KV-capacity subsystem (radix prefix index + host-DRAM tier).
+kvcache:
+	$(PYTEST) tests/ -q -m kvcache
 
 # On-chip kernel regressions (run on a TPU host; self-skip elsewhere).
 tpu:

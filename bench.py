@@ -412,6 +412,110 @@ def main() -> None:
             serving_itl_p99 = entry["itl_ms_p99"]
 
     # ------------------------------------------------------------------
+    # Multi-turn chat at KV-capacity scale (kvcache.py, r06): the radix
+    # prefix index + host-DRAM block tier on the chat pattern the north
+    # star cares about — thousands of sessions sharing system prompts
+    # and resuming after idling out of HBM.
+    #
+    # chat_prefix_hit_ttft_ms: TTFT p50/p99 of a turn whose cached
+    # prefix covers {0, 25, 75}% of the prompt (hit depth sweep; depth
+    # 0 is the cold-prefill baseline and the deeper hits' win is pure
+    # skipped prefill).  Prompt: 512 tokens against a warm pool with a
+    # decoding resident, admitted through the fused prefill lane — the
+    # run.py serving configuration.
+    #
+    # sessions_resident_max: how many 512-token sessions' KV one pool
+    # can keep addressable with vs without the host tier — the
+    # capacity multiplier (without: the HBM pool's idle LRU depth;
+    # with: HBM + host tier, revisits restoring through the
+    # ``restoring`` admission state).
+    # ------------------------------------------------------------------
+    def chat_bench():
+        P = 512                      # chat prompt (4 blocks of 128)
+        depths = {"d0": 0, "d25": 128, "d75": 384}  # block multiples
+        ttft = {}
+        for label, depth in depths.items():
+            cb = ContinuousBatcher(
+                params, config, n_slots=8, max_len=1024, block_size=128,
+                decode_chunk=16, prefill_budget=512, prefix_cache=True,
+            )
+            _salt[0] += 1
+            srng = np.random.RandomState(5000 + _salt[0])
+            shared = list(srng.randint(1, config.vocab_size, depth))
+            # Seed the shared prefix chain (one completed turn), then
+            # hold a decoding resident so probes admit FUSED.
+            if depth:
+                cb.submit(shared + [7], max_new_tokens=2)
+                while cb.pending():
+                    cb.step()
+            cb.submit(list(srng.randint(1, config.vocab_size, 64)),
+                      max_new_tokens=512)
+            cb.step(); cb.step(); cb.step()
+            samples = []
+            for _ in range(8):
+                probe = shared + list(
+                    srng.randint(1, config.vocab_size, P - depth)
+                )
+                t0 = time.time()
+                rid = cb.submit(probe, max_new_tokens=4)
+                first = None
+                while first is None:
+                    for ev in cb.step():
+                        if ev[0] == rid:
+                            first = time.time()
+                            break
+                samples.append((first - t0) * 1000.0)
+                while any(
+                    s is not None and s.request_id == rid
+                    for s in cb.slots.values()
+                ):
+                    cb.step()
+            ttft[label] = {
+                "p50": round(float(np.percentile(samples, 50)), 1),
+                "p99": round(float(np.percentile(samples, 99)), 1),
+            }
+
+        def resident_sessions(host_blocks):
+            # 16-block pool (4 sessions' chains max in HBM); sessions
+            # are revisited oldest-first, so WITHOUT the tier the LRU
+            # has always just dropped the one being asked for.
+            cb = ContinuousBatcher(
+                params, config, n_slots=2, max_len=1024, block_size=128,
+                n_blocks=16, decode_chunk=16, prefix_cache=True,
+                host_kv_blocks=host_blocks,
+            )
+            _salt[0] += 1
+            srng = np.random.RandomState(7000 + _salt[0])
+            sessions = [
+                list(srng.randint(1, config.vocab_size, P))
+                for _ in range(8)
+            ]
+            for s in sessions:
+                cb.submit(list(s), max_new_tokens=4)
+                while cb.pending():
+                    cb.step()
+            h0 = cb.stats()["prefix_requests_hit_total"]
+            for s in sessions:   # revisit every session, oldest first
+                cb.submit(list(s), max_new_tokens=4)
+                while cb.pending():
+                    cb.step()
+            hits = cb.stats()["prefix_requests_hit_total"] - h0
+            # Sessions still addressable = revisits that hit (HBM or
+            # restored from the tier) instead of cold re-prefilling.
+            return hits, cb.stats()["swap_ins_total"]
+
+        no_tier_hits, _ = resident_sessions(0)
+        tier_hits, tier_swap_ins = resident_sessions(64)
+        return ttft, {
+            "hbm_only": int(no_tier_hits),
+            "with_host_tier": int(tier_hits),
+            "tier_swap_ins": int(tier_swap_ins),
+        }
+
+    chat_bench()  # warmup (suffix-insert + fused-walk + restore programs)
+    chat_ttft, sessions_resident = chat_bench()
+
+    # ------------------------------------------------------------------
     # Speculative serving.  The draft is the target NUDGED by ~2%
     # deterministic relative noise (below): acceptance stays high — the
     # regime speculative decoding targets — but strictly < 1, so the
@@ -1118,6 +1222,15 @@ def main() -> None:
             "serving_ttft_ms": serving_ttft,
             "serving_itl_p99_ms": serving_itl_p99,
             "serving_prefill_budget_sweep": budget_sweep,
+            # KV capacity at chat scale (kvcache.py, r06): TTFT p50/p99
+            # of a 512-token turn at prefix hit depth {0, 25, 75}%
+            # (radix index, fused admission — the deeper the hit, the
+            # less prefill the turn pays), and how many sessions stay
+            # cache-addressable when revisited round-robin against a
+            # 4-session HBM pool, without vs with the host-DRAM tier
+            # (revisits swap back in through the restoring state).
+            "chat_prefix_hit_ttft_ms": chat_ttft,
+            "sessions_resident_max": sessions_resident,
             # Long-context paged serving (2 slots, 8k/16k contexts):
             # device-op ms per decode step, kernel vs gathered view at
             # identical pool geometry (xplane; wall would be tunnel-

@@ -102,6 +102,15 @@ def next_pow2(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length()
 
 
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= max(n, 1) — the jit-cache-key bucketing
+    discipline shared by serving's admission row counts / dirty-row
+    syncs and kvcache's swap-in batches (``next_pow2`` above is the
+    LENGTH variant with a floor of 2; this is the exact count bucket:
+    pow2_bucket(4) == 4, pow2_bucket(5) == 8, pow2_bucket(0) == 1)."""
+    return 1 << max(n - 1, 0).bit_length()
+
+
 def prompt_positions(prompt_mask: jnp.ndarray) -> jnp.ndarray:
     """Left-padded prompt mask [B, P] (bool) -> absolute positions [B, P],
     -1 on padding (parity: reference model.py:756-761 computes

@@ -30,6 +30,15 @@ Sites (fired by ``ContinuousBatcher`` just before the real operation):
                      of an admission regardless of how many plain
                      decode chunks ran before it
   ``alloc``          a block-pool allocation (``_alloc_blocks``)
+  ``kv_swap``        a host-tier swap-in begin (``_begin_restore``:
+                     radix prefix index + host-DRAM block tier,
+                     ``host_kv_blocks`` > 0).  UNLIKE the other error
+                     sites, an injected fault here is CONTAINED by the
+                     batcher: it fails only the restoring request
+                     (clean per-request error via ``pop_failed`` ->
+                     HTTP 500, claims released, host slabs unpinned) —
+                     the server stays healthy and never burns crash-
+                     recovery budget on it
   ``flash_kernel``   a dispatch whose prefill runs the Pallas flash
                      kernel (fired by the batcher per dispatch, AND by
                      ``ops.flash_attention`` at trace time when a hook
@@ -81,7 +90,7 @@ from typing import Dict, List, Optional, Sequence, Union
 
 SITES = (
     "step", "insert", "suffix_insert", "prefill_chunk", "alloc",
-    "flash_kernel", "paged_kernel", "spec_decode",
+    "kv_swap", "flash_kernel", "paged_kernel", "spec_decode",
 )
 KINDS = ("error", "oom", "delay", "nan")
 

@@ -1,0 +1,640 @@
+"""KV capacity subsystem: radix prefix index + host-DRAM block tier.
+
+Two cooperating parts that multiply how many concurrent chat sessions
+one chip's HBM pool can hold (ROADMAP item 3 — SGLang-style
+RadixAttention prefix sharing plus a vLLM-style swapped block tier,
+adapted to this repo's paged pool and fused-chunk scheduler):
+
+  * **Radix prefix index** (:class:`RadixPrefixStore`).  Replaces the
+    batcher's flat exact-chain ``Dict[bytes, block]`` with a
+    block-granular radix/trie over token chains: each node is ONE full
+    prompt block, keyed by the cumulative chain hash of its tokens
+    (``ContinuousBatcher._chain_keys``' invariant: key_j certifies the
+    whole prefix up to block j), children keyed by the next block's
+    hash.  An admission claims the longest shared block prefix across
+    *all* cached chains; divergent chains share their common prefix
+    nodes BY CONSTRUCTION instead of superseding each other's blocks
+    (the flat map's duplicate-chain churn), eviction is leaves-first
+    (a dropped interior node can never strand a resident suffix), and
+    per-node residency (HBM block / host slab / gone) is what the host
+    tier hangs off.  Refcounts stay block-granular in the batcher
+    (``_block_refs``) — the index tracks keyed-ness, LRU order and
+    residency, not ownership.
+  * **Host-DRAM block tier** (:class:`HostTier`).  Cold (refcount-0,
+    LRU-expired) blocks evict INTO a bounded host-memory pool instead
+    of being freed: eviction fetches the block's KV (plus scales on
+    int8 pools, plus the draft pool's twin under speculative serving)
+    to pinned host numpy, and the radix node flips HBM-resident ->
+    host-resident, staying matchable.  Admission of a session whose
+    prefix blocks were demoted schedules an async swap-in: the slabs
+    ``jax.device_put`` into STAGING buffers (pure H2D — deliberately
+    NOT on the pool's dependency chain, so decode chunks dispatched
+    meanwhile never wait on PCIe), the request parks in the batcher's
+    new ``restoring`` admission state, and once the transfer lands
+    (``jax.Array.is_ready`` polled at step boundaries, never blocking
+    while rows decode) ONE jitted scatter (:func:`adopt_into_pool`, the
+    block-migration generalization of the dirty-row ``_scatter_rows``
+    machinery) lands the blocks in the pool and the session admits as
+    a plain prefix hit — decode rows never stall (``make perf-smoke``
+    asserts 0 stall dispatches while a swap-in is in flight).
+
+Three index modes (``run.py --prefix-index``): ``radix`` (the default
+— partial-prefix sharing + host tier), ``exact`` (the legacy flat
+chain map, kept as the behavioral oracle; no host tier), ``off`` (no
+prefix matching or retention — the old ``prefix_cache=False``).
+
+This module owns only HOST-side bookkeeping plus the three
+device-boundary primitives (:func:`fetch_slab` demote D2H,
+:func:`stage_restore` async H2D staging, :func:`adopt_into_pool`
+scatter); the admission state machine lives in ``serving.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import pow2_bucket
+
+PREFIX_INDEX_MODES = ("radix", "exact", "off")
+
+
+# ---------------------------------------------------------------------------
+# Match result (shared by all stores)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MatchResult:
+    """Longest cached chain prefix for an admission.
+
+    blocks:  the HBM-RESIDENT hit blocks, contiguous from the root —
+             what a no-swap admission reuses (stops at the first
+             non-resident node).
+    path:    the full reachable node path (radix only; includes
+             host-resident nodes past ``blocks``' depth).
+    restore: the host-resident nodes on ``path`` needing swap-in before
+             the whole path is claimable (empty = plain hit)."""
+
+    blocks: List[int]
+    path: List["RadixNode"]
+    restore: List["RadixNode"]
+
+
+# ---------------------------------------------------------------------------
+# Host-DRAM tier
+# ---------------------------------------------------------------------------
+
+class HostTier:
+    """Bounded LRU store of demoted block slabs, keyed by chain hash.
+
+    A *slab* is the plain-numpy image of one pool block —
+    ``fetch_slab``'s dict of arrays (k/v/pos, + scales on int8 pools,
+    + ``d_``-prefixed draft-pool twins under speculative serving).
+    Capacity is counted in BLOCKS; inserting past it evicts the
+    least-recently-stored unpinned slab (pinned = mid-swap-in; its
+    node's restore must not lose the bytes under it)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._slabs: "OrderedDict[bytes, Dict[str, np.ndarray]]" = (
+            OrderedDict()
+        )
+        self.pinned: set = set()
+
+    def __len__(self) -> int:
+        return len(self._slabs)
+
+    def get(self, key: bytes) -> Optional[Dict[str, np.ndarray]]:
+        return self._slabs.get(key)
+
+    def drop(self, key: bytes) -> None:
+        self._slabs.pop(key, None)
+        self.pinned.discard(key)
+
+    def put(self, key: bytes, slab: Dict[str, np.ndarray]) -> List[bytes]:
+        """Store a slab; returns the keys evicted to make room (their
+        nodes lose host residency — the caller drops/strands them)."""
+        self._slabs[key] = slab
+        evicted: List[bytes] = []
+        while len(self._slabs) > self.capacity:
+            victim = next(
+                (k for k in self._slabs if k not in self.pinned and
+                 k != key),
+                None,
+            )
+            if victim is None:
+                break  # everything pinned: tolerate transient overflow
+            del self._slabs[victim]
+            evicted.append(victim)
+        return evicted
+
+
+# ---------------------------------------------------------------------------
+# Radix index
+# ---------------------------------------------------------------------------
+
+class RadixNode:
+    """One full prompt block in the radix tree.
+
+    ``key`` is the block's CUMULATIVE chain hash (position-invariant,
+    certifies the whole prefix — ``_chain_keys``), so node identity is
+    chain-prefix identity and divergent chains share nodes for free.
+    Residency: ``block`` (HBM) and ``host`` (demoted slab, held by the
+    tier) are mutually exclusive; both ``None`` only transiently during
+    teardown.  ``restoring`` marks an in-flight swap-in — unreachable
+    for NEW matches (a second admission racing the swap would double-
+    allocate), adopted into ``block`` when the transfer lands."""
+
+    __slots__ = (
+        "key", "parent", "children", "block", "host", "depth",
+        "restoring",
+    )
+
+    def __init__(self, key: bytes, parent: Optional["RadixNode"],
+                 depth: int):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[bytes, "RadixNode"] = {}
+        self.block: Optional[int] = None
+        self.host: Optional[Dict[str, np.ndarray]] = None
+        self.depth = depth
+        self.restoring = False
+
+    @property
+    def reachable(self) -> bool:
+        return self.block is not None or (
+            self.host is not None and not self.restoring
+        )
+
+
+class RadixPrefixStore:
+    """The radix/trie prefix index + host tier (mode ``radix``).
+
+    Interface contract with ``ContinuousBatcher`` (the batcher keeps
+    per-block refcounts; the store keeps keyed-ness, tree structure,
+    idle-LRU order and residency):
+
+      match(keys)            longest reachable path -> MatchResult
+      publish(keys, blocks)  register a freshly prefilled chain;
+                             returns idle blocks to free NOW
+      unpublish(blk)         non-finite-guard: drop the node AND its
+                             subtree (suspect KV must never be hit);
+                             returns stranded idle blocks to free
+      is_keyed(blk)          retain on last-ref free?
+      retain(blocks)         freed keyed blocks -> idle LRU (chain
+                             order in; reversed so leaves evict first)
+      on_claim(blocks)       admission claimed blocks -> leave LRU
+      evictable()            idle count (capacity accounting)
+      pop_evictable(demote)  reclaim one idle block, demoting its KV
+                             into the host tier when there is room
+      pin/unpin/complete_restore   the swap-in lifecycle
+    """
+
+    kind = "radix"
+    enabled = True
+
+    def __init__(self, host_blocks: int = 0):
+        self.root = RadixNode(b"", None, 0)
+        self._by_key: Dict[bytes, RadixNode] = {}
+        self._by_block: Dict[int, RadixNode] = {}
+        # refcount-0 HBM-resident keyed nodes; front = evict first.
+        self._idle: "OrderedDict[bytes, RadixNode]" = OrderedDict()
+        self.tier = HostTier(host_blocks) if host_blocks > 0 else None
+
+    # -- matching / publication --------------------------------------------
+
+    def match(self, keys: Sequence[bytes]) -> MatchResult:
+        path: List[RadixNode] = []
+        node = self.root
+        for key in keys:
+            child = node.children.get(key)
+            if child is None or not child.reachable:
+                break
+            path.append(child)
+            node = child
+        blocks: List[int] = []
+        for n in path:
+            if n.block is None:
+                break
+            blocks.append(n.block)
+        restore = [n for n in path if n.block is None]
+        return MatchResult(blocks=blocks, path=path, restore=restore)
+
+    def publish(self, keys: Sequence[bytes],
+                blocks: Sequence[int]) -> List[int]:
+        """Register a freshly prefilled full-prompt chain.  Existing
+        RESIDENT nodes keep their block — the publisher's duplicate
+        copy stays private/unkeyed and frees plainly with its slot
+        (shared-by-construction replaces the flat map's supersede
+        churn); a demoted node adopts the fresh HBM copy (newer bytes,
+        host slab dropped)."""
+        parent = self.root
+        for key, blk in zip(keys, blocks):
+            node = self._by_key.get(key)
+            if node is None:
+                node = RadixNode(key, parent, parent.depth + 1)
+                parent.children[key] = node
+                self._by_key[key] = node
+                node.block = blk
+                self._by_block[blk] = node
+            elif node.block is None and not node.restoring:
+                node.block = blk
+                self._by_block[blk] = node
+                if node.host is not None:
+                    node.host = None
+                    if self.tier is not None:
+                        self.tier.drop(key)
+            parent = node
+        return []
+
+    def unpublish(self, blk: int) -> List[int]:
+        node = self._by_block.get(blk)
+        if node is None or node.block != blk:
+            return []
+        return self._drop_subtree(node)
+
+    def _drop_subtree(self, node: RadixNode) -> List[int]:
+        """Remove ``node`` and every descendant from the index.  Idle
+        (refcount-0 retained) blocks in the subtree are returned for
+        the caller to free; blocks with live users merely lose their
+        keying and free plainly when their slots do."""
+        freed: List[int] = []
+        if node.parent is not None:
+            node.parent.children.pop(node.key, None)
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self._by_key.pop(n.key, None)
+            if n.block is not None:
+                if self._by_block.get(n.block) is n:
+                    del self._by_block[n.block]
+                if n.key in self._idle:
+                    del self._idle[n.key]
+                    freed.append(n.block)
+                n.block = None
+            if n.host is not None:
+                n.host = None
+                if self.tier is not None:
+                    self.tier.drop(n.key)
+            n.restoring = False
+        return freed
+
+    # -- refcount-boundary hooks -------------------------------------------
+
+    def is_keyed(self, blk: int) -> bool:
+        node = self._by_block.get(blk)
+        return node is not None and node.block == blk
+
+    def retain(self, blocks: Sequence[int]) -> None:
+        # Later chain blocks enter the LRU first (reversed) so chains
+        # evict back-to-front — the leaves-first discipline.
+        for blk in reversed(list(blocks)):
+            node = self._by_block.get(blk)
+            if node is not None and node.block == blk:
+                self._idle[node.key] = node
+
+    def on_claim(self, blocks: Sequence[int]) -> None:
+        for blk in blocks:
+            node = self._by_block.get(blk)
+            if node is not None:
+                self._idle.pop(node.key, None)
+
+    # -- eviction / demotion -----------------------------------------------
+
+    def evictable(self) -> int:
+        return len(self._idle)
+
+    def pop_evictable(
+        self,
+        demote: Optional[Callable[[int], Dict[str, np.ndarray]]] = None,
+    ) -> Tuple[Optional[int], List[int]]:
+        """Reclaim one idle keyed block for the allocator.
+
+        With a host tier and a ``demote`` callback the block's KV is
+        fetched to a host slab first and the node stays matchable
+        (host-resident); otherwise the node is DROPPED — choosing an
+        idle node with no reachable children when one exists, so an
+        interior drop never strands a resident suffix (the flat map
+        relied on insertion order for this; the tree checks).
+
+        Returns ``(block, extra_free)``: the reclaimed block plus any
+        additional idle blocks orphaned by a forced subtree drop (the
+        caller returns those to the free list)."""
+        if not self._idle:
+            return None, []
+        if self.tier is not None and demote is not None:
+            key, node = next(iter(self._idle.items()))
+            blk = node.block
+            slab = demote(blk)
+            del self._idle[key]
+            del self._by_block[blk]
+            node.block = None
+            node.host = slab
+            extra: List[int] = []
+            for ekey in self.tier.put(key, slab):
+                # Host-LRU victim: its node loses the slab; if that
+                # leaves it unreachable, drop its (now unreachable)
+                # subtree too.
+                enode = self._by_key.get(ekey)
+                if enode is None:
+                    continue
+                enode.host = None
+                if enode.block is None:
+                    extra.extend(self._drop_subtree(enode))
+            return blk, extra
+        # Drop path (no tier): leaves first.
+        chosen = None
+        for key, node in self._idle.items():
+            if not any(c.reachable or c.restoring
+                       for c in node.children.values()):
+                chosen = node
+                break
+        if chosen is None:
+            chosen = next(iter(self._idle.values()))
+        blk = chosen.block
+        extra = self._drop_subtree(chosen)
+        extra.remove(blk)
+        return blk, extra
+
+    # -- swap-in lifecycle --------------------------------------------------
+
+    def pin_restoring(self, nodes: Sequence[RadixNode]) -> None:
+        for n in nodes:
+            n.restoring = True
+            if self.tier is not None:
+                self.tier.pinned.add(n.key)
+
+    def unpin_restoring(self, nodes: Sequence[RadixNode]) -> None:
+        """Abort a swap-in (injected failure / cancel): the nodes stay
+        host-resident and matchable again."""
+        for n in nodes:
+            n.restoring = False
+            if self.tier is not None:
+                self.tier.pinned.discard(n.key)
+
+    def complete_restore(self, nodes: Sequence[RadixNode],
+                         blocks: Sequence[int]) -> None:
+        """The swap-in landed: nodes flip host-resident -> HBM-resident
+        under their freshly scattered blocks (claimed by the admission,
+        so NOT idle), slabs leave the tier."""
+        for n, blk in zip(nodes, blocks):
+            n.block = blk
+            self._by_block[blk] = n
+            n.host = None
+            n.restoring = False
+            if self.tier is not None:
+                self.tier.drop(n.key)
+
+    # -- observability -------------------------------------------------------
+
+    def cached_blocks(self) -> int:
+        return len(self._idle)
+
+    def nodes_total(self) -> int:
+        return len(self._by_key)
+
+    def host_blocks(self) -> int:
+        return len(self.tier) if self.tier is not None else 0
+
+
+# ---------------------------------------------------------------------------
+# Exact (legacy) and off modes
+# ---------------------------------------------------------------------------
+
+class ExactPrefixStore:
+    """The pre-radix flat chain map (mode ``exact``), kept as the
+    behavioral oracle: one ``Dict[bytes, block]`` keyed by cumulative
+    chain hash, duplicate publications SUPERSEDE (the old
+    ``_register_chain`` churn), eviction is pure insertion-order LRU,
+    and there is no host tier."""
+
+    kind = "exact"
+    enabled = True
+
+    def __init__(self):
+        self._prefix_index: Dict[bytes, int] = {}
+        self._block_chain: Dict[int, bytes] = {}
+        self._reusable: "OrderedDict[int, None]" = OrderedDict()
+
+    def match(self, keys: Sequence[bytes]) -> MatchResult:
+        hits: List[int] = []
+        for key in keys:
+            blk = self._prefix_index.get(key)
+            if blk is None:
+                break
+            hits.append(blk)
+        return MatchResult(blocks=hits, path=[], restore=[])
+
+    def publish(self, keys: Sequence[bytes],
+                blocks: Sequence[int]) -> List[int]:
+        superseded: List[int] = []
+        for blk, key in zip(blocks, keys):
+            old = self._prefix_index.get(key)
+            if old is not None and old != blk:
+                self._block_chain.pop(old, None)
+                if old in self._reusable:
+                    del self._reusable[old]
+                    superseded.append(old)
+            self._block_chain[blk] = key
+            self._prefix_index[key] = blk
+        return superseded
+
+    def unpublish(self, blk: int) -> List[int]:
+        key = self._block_chain.pop(blk, None)
+        if key is not None and self._prefix_index.get(key) == blk:
+            del self._prefix_index[key]
+        return []
+
+    def is_keyed(self, blk: int) -> bool:
+        return blk in self._block_chain
+
+    def retain(self, blocks: Sequence[int]) -> None:
+        for blk in reversed(list(blocks)):
+            self._reusable[blk] = None
+
+    def on_claim(self, blocks: Sequence[int]) -> None:
+        for blk in blocks:
+            self._reusable.pop(blk, None)
+
+    def evictable(self) -> int:
+        return len(self._reusable)
+
+    def pop_evictable(self, demote=None) -> Tuple[Optional[int], List[int]]:
+        if not self._reusable:
+            return None, []
+        blk, _ = self._reusable.popitem(last=False)
+        self.unpublish(blk)
+        return blk, []
+
+    def pin_restoring(self, nodes) -> None:  # pragma: no cover - no tier
+        raise AssertionError("exact store has no host tier")
+
+    unpin_restoring = complete_restore = pin_restoring
+
+    def cached_blocks(self) -> int:
+        return len(self._reusable)
+
+    def nodes_total(self) -> int:
+        return len(self._prefix_index)
+
+    def host_blocks(self) -> int:
+        return 0
+
+
+class NullPrefixStore:
+    """Mode ``off``: nothing matches, nothing is retained."""
+
+    kind = "off"
+    enabled = False
+
+    def match(self, keys) -> MatchResult:
+        return MatchResult(blocks=[], path=[], restore=[])
+
+    def publish(self, keys, blocks) -> List[int]:
+        return []
+
+    def unpublish(self, blk) -> List[int]:
+        return []
+
+    def is_keyed(self, blk) -> bool:
+        return False
+
+    def retain(self, blocks) -> None:
+        pass
+
+    def on_claim(self, blocks) -> None:
+        pass
+
+    def evictable(self) -> int:
+        return 0
+
+    def pop_evictable(self, demote=None) -> Tuple[Optional[int], List[int]]:
+        return None, []
+
+    def cached_blocks(self) -> int:
+        return 0
+
+    def nodes_total(self) -> int:
+        return 0
+
+    def host_blocks(self) -> int:
+        return 0
+
+
+def make_prefix_store(mode: str, host_blocks: int = 0):
+    """Store factory.  The host tier only attaches to the radix index
+    (``exact`` is the legacy oracle, ``off`` retains nothing — in both
+    a nonzero ``host_blocks`` is inert by design: the degradation
+    layer's prefix-cache quarantine rebuilds with the cache off and
+    must not trip a constructor error over the tier flag)."""
+    if mode not in PREFIX_INDEX_MODES:
+        raise ValueError(
+            f"unknown prefix_index mode {mode!r}; have {PREFIX_INDEX_MODES}"
+        )
+    if mode == "radix":
+        return RadixPrefixStore(host_blocks=host_blocks)
+    if mode == "exact":
+        return ExactPrefixStore()
+    return NullPrefixStore()
+
+
+# ---------------------------------------------------------------------------
+# Device-boundary primitives (demote fetch / staged swap-in / adoption)
+# ---------------------------------------------------------------------------
+
+# Slab array names in pool order; the draft pool's twins carry the
+# ``d_`` prefix.  ``pos`` is per-block [BLK]; k/v are [L, KVH, BLK, hd];
+# scales (int8 pools only) are [L, KVH, BLK].
+_POOL_FIELDS = ("k", "v", "pos", "k_scale", "v_scale")
+
+
+def _pool_names(pool) -> Tuple[str, ...]:
+    return _POOL_FIELDS if pool.k_scale is not None else _POOL_FIELDS[:3]
+
+
+def fetch_slab(pool, blk: int, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Demotion D2H: one block's KV image as plain numpy (synchronous —
+    demotion happens on the admission path, where the allocator already
+    owns the step boundary).  Must run BEFORE the caller invalidates
+    the block's pool positions (the slab keeps the live ``pos`` row the
+    future restore re-installs)."""
+    out: Dict[str, np.ndarray] = {}
+    for name in _pool_names(pool):
+        arr = getattr(pool, name)
+        sl = arr[blk] if name == "pos" else arr[:, :, blk]
+        out[prefix + name] = np.asarray(sl)
+    return out
+
+
+def stage_restore(
+    slabs: Sequence[Dict[str, np.ndarray]],
+    block_ids: Sequence[int],
+    sentinel: int,
+) -> Dict[str, jax.Array]:
+    """Swap-in H2D: stack the slabs along the block axis and
+    ``jax.device_put`` them into STAGING buffers.  The transfer is
+    async and independent of the pool arrays — decode chunks dispatched
+    while it is in flight have no data dependency on it, which is what
+    makes the overlap real (enqueueing the pool scatter immediately
+    would chain every subsequent chunk behind the PCIe copy).
+    Readiness = every staged array ``.is_ready()``.
+
+    ``block_ids`` are the fresh HBM blocks the adoption scatter will
+    land in, padded to a pow2 bucket with ``sentinel`` (out-of-range:
+    the scatter drops pad rows) so the jit cache of
+    :func:`adopt_into_pool` stays O(log max-restore-depth)."""
+    n = len(slabs)
+    nb = pow2_bucket(n)
+    ids = np.full((nb,), sentinel, np.int32)
+    ids[:n] = list(block_ids)
+    staged: Dict[str, jax.Array] = {"ids": jax.device_put(ids)}
+    for name in slabs[0]:
+        arrs = [s[name] for s in slabs]
+        axis = 0 if name.endswith("pos") else 2
+        stacked = np.stack(arrs, axis=axis)
+        if nb > n:
+            pad_shape = list(stacked.shape)
+            pad_shape[axis] = nb - n
+            stacked = np.concatenate(
+                [stacked, np.zeros(pad_shape, stacked.dtype)], axis=axis
+            )
+        staged[name] = jax.device_put(stacked)
+    return staged
+
+
+def restore_ready(staged: Dict[str, jax.Array]) -> bool:
+    """Non-blocking readiness poll of a staged swap-in."""
+    return all(a.is_ready() for a in staged.values())
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _adopt_jit(pool_arrays: Tuple[jnp.ndarray, ...], ids: jnp.ndarray,
+               staged: Tuple[jnp.ndarray, ...]):
+    out = []
+    for a, s in zip(pool_arrays, staged):
+        if a.ndim == 2:  # pos: [NB, BLK] <- [n, BLK]
+            out.append(a.at[ids].set(s.astype(a.dtype), mode="drop"))
+        else:            # k/v/scales: [L, KVH, NB, ...] <- [L, KVH, n, ...]
+            out.append(a.at[:, :, ids].set(s.astype(a.dtype), mode="drop"))
+    return tuple(out)
+
+
+def adopt_into_pool(pool, staged: Dict[str, jax.Array], prefix: str = ""):
+    """ONE jitted scatter landing a completed swap-in's staged blocks in
+    the pool — the block-migration generalization of serving's
+    dirty-row ``_scatter_rows`` sync (pool arrays donated; sentinel pad
+    rows drop).  Called only once the staging transfer is ready, so the
+    dispatch is device-to-device and cheap; returns the updated pool."""
+    names = _pool_names(pool)
+    arrays = tuple(getattr(pool, name) for name in names)
+    new = _adopt_jit(
+        arrays, staged["ids"], tuple(staged[prefix + n] for n in names)
+    )
+    return dataclasses.replace(pool, **dict(zip(names, new)))

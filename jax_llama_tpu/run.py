@@ -105,7 +105,31 @@ def main() -> None:
                     help="disable prompt prefix caching in the serving "
                          "pool (on by default; hits are token-identical "
                          "in tested configurations — this is a "
-                         "memory/debug knob)")
+                         "memory/debug knob; equivalent to "
+                         "--prefix-index off)")
+    ap.add_argument("--prefix-index", default="radix",
+                    choices=["radix", "exact", "off"],
+                    help="prefix-cache index for --serve / --http: "
+                         "'radix' (default) shares partial prompt "
+                         "prefixes across ALL cached chains through a "
+                         "block-granular radix tree (leaves-first "
+                         "eviction, host-tier residency); 'exact' keeps "
+                         "the legacy flat exact-chain map (the "
+                         "behavioral oracle, no host tier); 'off' "
+                         "disables matching and retention")
+    ap.add_argument("--host-kv-blocks", type=int, default=0,
+                    help="host-DRAM KV block tier capacity for --serve "
+                         "/ --http (requires --prefix-index radix): "
+                         "cold prefix-cache blocks evict into pinned "
+                         "host memory instead of being freed, and "
+                         "sessions whose cached prefix was demoted "
+                         "swap it back into HBM asynchronously, "
+                         "overlapped on the decode chunk (a restoring "
+                         "request waits; decode rows never stall).  "
+                         "0 (default) disables the tier; size it to "
+                         "taste — each block holds "
+                         "2*n_layers*kv_heads*block_size*head_dim KV "
+                         "entries per model")
     ap.add_argument("--logprobs", action="store_true",
                     help="compute per-token model logprobs so HTTP "
                          "requests may ask for them (\"logprobs\": true)")
@@ -116,6 +140,7 @@ def main() -> None:
                          "(--http only): comma-separated "
                          "site[@N|~P]:kind[=v] rules — sites step, "
                          "insert, suffix_insert, prefill_chunk, alloc, "
+                         "kv_swap, "
                          "flash_kernel, paged_kernel, spec_decode; "
                          "kinds error, "
                          "oom, delay=SECONDS, nan; e.g. 'step@5:error' "
@@ -148,6 +173,18 @@ def main() -> None:
                          "503 + Retry-After); stragglers past this "
                          "many seconds are failed with 503")
     args = ap.parse_args()
+    if args.host_kv_blocks > 0 and (
+        args.prefix_index != "radix" or args.no_prefix_cache
+    ):
+        # The tier hangs off radix-node residency; refusing loudly here
+        # beats a silently inert flag (the batcher ctor tolerates the
+        # combination only because the degradation layer's prefix-cache
+        # quarantine must be able to rebuild with the cache off).
+        raise SystemExit(
+            "--host-kv-blocks requires --prefix-index radix with the "
+            "prefix cache enabled (the host tier hangs off radix-node "
+            "residency)"
+        )
     if args.logprobs and args.http is None:
         raise SystemExit(
             "--logprobs only applies to the HTTP server (--http PORT); "
@@ -314,6 +351,8 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         n_draft=getattr(args, "n_draft", 4),
         spec_rounds=getattr(args, "spec_rounds", 8),
         prefill_budget=getattr(args, "prefill_budget", 512),
+        prefix_index=getattr(args, "prefix_index", "radix"),
+        host_kv_blocks=getattr(args, "host_kv_blocks", 0),
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).
@@ -421,6 +460,8 @@ def _serve(params, config, tokenizer, mesh, args) -> None:
         n_draft=getattr(args, "n_draft", 4),
         spec_rounds=getattr(args, "spec_rounds", 8),
         prefill_budget=getattr(args, "prefill_budget", 512),
+        prefix_index=getattr(args, "prefix_index", "radix"),
+        host_kv_blocks=getattr(args, "host_kv_blocks", 0),
     )
     rid_prompt: dict = {}
     emitted: dict = {}

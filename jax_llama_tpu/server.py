@@ -52,7 +52,24 @@ parity.  Design constraints, in order:
     mid-decode; ≈0 once fused scheduling is on), and
     ``llm_ttft_ms_ewma`` (gauge — exponentially-weighted
     time-to-first-token over delivered requests, alpha 0.2; the
-    stall win surfaces here first).
+    stall win surfaces here first).  The KV-capacity subsystem
+    (``kvcache.py``: radix prefix index + host-DRAM block tier,
+    run.py ``--prefix-index`` / ``--host-kv-blocks``) adds:
+    ``llm_radix_nodes_total`` (gauge — keyed blocks in the radix
+    tree), ``llm_prefix_hit_tokens_ratio`` (gauge — fraction of
+    admitted prompt tokens served from cached prefix blocks; the
+    partial-prefix sharing win reads directly off this),
+    ``llm_host_tier_blocks`` (gauge — blocks currently demoted to
+    host DRAM, vs the ``llm_host_kv_blocks`` capacity),
+    ``llm_swap_queue_depth`` (gauge — swap-ins in flight; a
+    restoring request waits here while decode rows keep emitting),
+    ``llm_swap_in_ms_total`` / ``llm_swap_ins_total`` /
+    ``llm_swap_in_blocks_total`` / ``llm_swap_out_blocks_total``
+    (counters — swap ledger), and ``llm_swap_failures_total``
+    (counter — swap-ins failed cleanly per-request, never the
+    server).  ``llm_prefix_cached_blocks`` predates the radix index
+    and is kept as an alias of the idle resident count so existing
+    dashboards don't break.
   * **Chunked decode is transparent here.**  The batcher's ``step()``
     may return up to K tokens per slot per call
     (``serving.ContinuousBatcher`` ``decode_chunk``, run.py
@@ -91,6 +108,13 @@ parity.  Design constraints, in order:
       "drain_remaining_s": float | null,
       "degraded": bool,        # any feature quarantined or probing
       "quarantined": [feature, ...],
+      "kv": {                  # KV-capacity subsystem (kvcache.py)
+        "prefix_index": "radix"|"exact"|"off",
+        "host_kv_blocks": int,     # tier capacity (0 = tier off)
+        "host_tier_blocks": int,   # blocks currently demoted
+        "swap_queue_depth": int,   # swap-ins in flight (restoring)
+        "restored_waiting": int    # swapped in, awaiting a slot
+      },
       "features": {            # per degradable feature
         "<name>": {"state": "healthy"|"quarantined"|"probing",
                     "failures_in_window": int, "failures_total": int,
@@ -937,6 +961,17 @@ class LLMServer:
             "drain_remaining_s": remaining,
             "degraded": self.degrade.degraded(),
             "quarantined": list(self.degrade.quarantined()),
+            "kv": {
+                "prefix_index": getattr(
+                    self.batcher, "prefix_index", "off"
+                ),
+                "host_kv_blocks": getattr(
+                    self.batcher, "host_kv_blocks", 0
+                ),
+                "host_tier_blocks": self.batcher._store.host_blocks(),
+                "swap_queue_depth": len(self.batcher._restoring),
+                "restored_waiting": len(self.batcher._restored_ready),
+            },
             "features": features,
         }
 
@@ -1103,7 +1138,15 @@ class LLMServer:
         lines = []
         for k, v in stats.items():
             name = f"llm_{k}"
-            kind = "gauge" if "total" not in k else "counter"
+            # "_total" names a counter by convention — except
+            # radix_nodes_total, a resident-node COUNT that shrinks on
+            # eviction/unpublish; typing it counter would make
+            # Prometheus read every shrink as a reset (rate() spikes).
+            kind = (
+                "gauge"
+                if "total" not in k or k == "radix_nodes_total"
+                else "counter"
+            )
             lines.append(f"# TYPE {name} {kind}")
             lines.append(f"{name} {v}")
         return "\n".join(lines) + "\n"

@@ -114,6 +114,35 @@ TPU-native mechanics:
     classic prefill holds only up to quantization noise there);
     ``prefill_budget=0`` (the ctor default) and speculative batchers
     keep classic admission everywhere.
+  * **KV capacity: radix prefix index + host-DRAM block tier**
+    (``kvcache.py``).  The prefix cache's index is a block-granular
+    radix/trie over token chains (``prefix_index="radix"``, the
+    default; ``"exact"`` keeps the legacy flat chain map as the
+    behavioral oracle, ``"off"`` disables matching): an admission
+    claims the longest shared block prefix across ALL cached chains,
+    divergent chains share their common prefix nodes by construction,
+    and eviction is leaves-first.  With ``host_kv_blocks`` > 0 cold
+    (refcount-0, LRU-expired) blocks demote INTO a bounded host-DRAM
+    tier instead of being freed, staying matchable; admitting a
+    session whose matched prefix includes demoted blocks parks it in
+    a new ``restoring`` state: the slabs ``jax.device_put`` into
+    staging buffers (async H2D, deliberately OFF the pool's
+    dependency chain so in-flight decode chunks never wait on PCIe),
+    readiness is polled non-blockingly at step boundaries, and one
+    jitted scatter (``kvcache.adopt_into_pool`` — the block-migration
+    generalization of the dirty-row ``_scatter_rows`` sync) lands the
+    blocks before the session admits as a plain prefix hit.  Host
+    boundary of the swap path: demotion pays one D2H slab fetch per
+    evicted block (admission-time, off the decode hot path; counted
+    in ``swap_out_blocks_total``, never in ``host_syncs_total``),
+    swap-in pays one async H2D staging transfer + one adoption
+    dispatch per restored session and ZERO per-chunk traffic — decode
+    rows never stall while a swap-in is in flight, and a restored
+    admission pays the same ≤ 1 dirty-row state upload as any fused
+    admission (asserted by ``make perf-smoke``).  A swap-in failure
+    (fault site ``kv_swap``) fails only the restoring request with
+    its blocks unpinned; the index/tier rebuild empty on crash
+    recovery and replayed requests re-prefill cold, token-identically.
 """
 
 from __future__ import annotations
@@ -131,8 +160,18 @@ import numpy as np
 from jax import lax
 
 from .config import LLaMAConfig
-from .engine import finite_rows, prompt_positions, window_positions
-from .faults import FaultInjector
+from .engine import (
+    finite_rows, pow2_bucket, prompt_positions, window_positions,
+)
+from .faults import FaultInjector, InjectedFault
+from .kvcache import (
+    MatchResult,
+    adopt_into_pool,
+    fetch_slab,
+    make_prefix_store,
+    restore_ready,
+    stage_restore,
+)
 from .models.llama import (
     FLASH_MIN_SEQ,
     KVCache,
@@ -1507,6 +1546,33 @@ class _Prefill:
 
 
 @dataclasses.dataclass
+class _Restore:
+    """Host view of one in-flight swap-in (the ``restoring`` admission
+    state): the request left the queue, its matched path's RESIDENT
+    blocks are claimed (refcounted — eviction cannot take them), its
+    demoted nodes are pinned in the host tier, fresh HBM blocks are
+    allocated, and the slabs are mid-flight in ``staged``
+    (``jax.device_put`` staging buffers — see ``kvcache.stage_restore``
+    for why staging, not a direct pool write, is what makes the decode
+    overlap real).  ``_poll_restores`` adopts the blocks into the pool
+    and hands the request to ``_restored_ready`` once the transfer
+    lands; decode chunks keep dispatching the whole time."""
+
+    req: "_Request"
+    chain: List[bytes]
+    path: List[Any]          # kvcache.RadixNode path (resident + demoted)
+    restore: List[Any]       # the demoted nodes being swapped in
+    resident: List[int]      # the path's HBM-resident blocks, CLAIMED at
+    #                          begin — recorded by id, not recomputed
+    #                          from the nodes (a concurrent non-finite
+    #                          subtree drop may null node.block)
+    fresh: List[int]         # their freshly allocated HBM blocks
+    staged: Dict[str, Any]   # kvcache.stage_restore buffers
+    t0: float
+    polls: int = 0
+
+
+@dataclasses.dataclass
 class _Request:
     rid: int
     tokens: List[int]
@@ -1571,6 +1637,17 @@ class ContinuousBatcher:
     prefill.  0 (the default) keeps classic admission; serving entry
     points (run.py ``--prefill-budget``) default it on.  Ignored by
     speculative batchers.
+
+    ``prefix_index`` picks the prefix cache's index implementation
+    (module docstring, "KV capacity"): ``"radix"`` (default) shares
+    partial prefixes across ALL cached chains through a block-granular
+    trie; ``"exact"`` keeps the legacy flat chain map as the
+    behavioral oracle; ``"off"`` ≡ ``prefix_cache=False``.
+    ``host_kv_blocks`` > 0 (radix only) attaches the host-DRAM block
+    tier: cold blocks demote into it instead of being freed, and
+    admissions whose matched prefix was demoted swap it back in
+    asynchronously through the ``restoring`` state — decode rows never
+    stall on a swap-in (run.py ``--host-kv-blocks``).
     """
 
     def __init__(
@@ -1598,6 +1675,8 @@ class ContinuousBatcher:
         decode_chunk: int = 1,
         spec_rounds: int = 1,
         prefill_budget: int = 0,
+        prefix_index: str = "radix",
+        host_kv_blocks: int = 0,
     ):
         # Raw construction arguments, captured before any derivation so
         # ``rebuild()`` (crash recovery) reproduces this batcher exactly
@@ -1613,7 +1692,8 @@ class ContinuousBatcher:
             use_pallas_kernel=use_pallas_kernel, logprobs=logprobs,
             prefix_cache=prefix_cache, fault_injector=fault_injector,
             decode_chunk=decode_chunk, spec_rounds=spec_rounds,
-            prefill_budget=prefill_budget,
+            prefill_budget=prefill_budget, prefix_index=prefix_index,
+            host_kv_blocks=host_kv_blocks,
         )
         self.fault_injector = fault_injector
         if config.attn_impl not in ("xla", "auto"):
@@ -1678,7 +1758,7 @@ class ContinuousBatcher:
         # a position-invariant chain hash of their tokens; admission
         # reuses a cached chain's blocks (refcounted) instead of
         # re-prefilling them, and completed requests RETAIN their keyed
-        # blocks in an LRU (``_reusable``) until allocation pressure
+        # blocks in the store's idle LRU until allocation pressure
         # evicts them — so the /chat pattern of identical system prompts
         # across sequential requests skips the shared prefill entirely.
         # Hits are token-identical to a cold batcher in the tested
@@ -1689,12 +1769,43 @@ class ContinuousBatcher:
         # matching
         # and retention (refcounts still maintained — the mechanism is
         # the same, it just never hits).
-        self.prefix_cache_enabled = bool(prefix_cache)
+        #
+        # The INDEX behind the cache lives in kvcache.py
+        # (``prefix_index``: "radix" — block-granular trie, partial-
+        # prefix hits shared across all chains, leaves-first eviction,
+        # host-tier residency; "exact" — the legacy flat chain map,
+        # kept as the behavioral oracle; "off").  ``host_kv_blocks``
+        # > 0 attaches the host-DRAM tier (radix only — inert
+        # elsewhere, see kvcache.make_prefix_store): cold blocks
+        # demote into it instead of being freed, and admissions whose
+        # matched prefix includes demoted blocks swap them back in
+        # asynchronously through the ``restoring`` admission state
+        # (module docstring, "KV capacity").
+        if prefix_index not in ("radix", "exact", "off"):
+            raise ValueError(
+                f"unknown prefix_index {prefix_index!r}; "
+                "have ('radix', 'exact', 'off')"
+            )
+        if not prefix_cache:
+            prefix_index = "off"
+        self.prefix_index = prefix_index
+        self.host_kv_blocks = max(0, int(host_kv_blocks))
+        self.prefix_cache_enabled = prefix_index != "off"
+        self._store = make_prefix_store(
+            prefix_index, host_blocks=self.host_kv_blocks
+        )
         self._block_refs: Dict[int, int] = {}    # block -> active users
-        self._block_chain: Dict[int, bytes] = {}  # block -> chain key
-        self._prefix_index: Dict[bytes, int] = {}  # chain key -> block
-        # refcount-0 keyed blocks, insertion order = eviction order
-        self._reusable: "OrderedDict[int, None]" = OrderedDict()
+        # In-flight swap-ins (the ``restoring`` admission state) and
+        # completed ones awaiting a free slot.  ``swap_poll_min`` is a
+        # determinism lever for drills/tests: it holds a READY swap-in
+        # for at least that many poll intervals so the restoring
+        # window is observable (0 = adopt as soon as the transfer
+        # lands).
+        self._restoring: List[_Restore] = []
+        self._restored_ready: List[
+            Tuple[_Request, List[bytes], List[int]]
+        ] = []
+        self.swap_poll_min = 0
         # Non-finite-guard channel: (request_id, message) pairs for
         # requests whose dispatch produced NaN/Inf logits — the slot is
         # freed immediately and the server fails just that request with
@@ -1715,6 +1826,18 @@ class ContinuousBatcher:
         self.drafts_accepted = 0
         self.prefix_requests_hit = 0
         self.prefix_blocks_reused = 0
+        # KV-capacity observability: prompt tokens admitted vs prompt
+        # tokens served from cached prefix blocks (the
+        # prefix_hit_tokens_ratio numerator/denominator), plus the
+        # host-tier swap counters (blocks demoted D2H, blocks restored
+        # H2D, cumulative swap-in latency, clean swap failures).
+        self.prompt_tokens_total = 0
+        self.prefix_hit_tokens_total = 0
+        self.swap_out_blocks_total = 0
+        self.swap_in_blocks_total = 0
+        self.swap_ins_total = 0
+        self.swap_in_ms_total = 0.0
+        self.swap_failures_total = 0
         # Host-side numpy mirrors of the per-slot decode state — the
         # AUTHORITATIVE copy for all host bookkeeping (admission
         # capacity, slot frees, replay).  The chunked decode path keeps
@@ -1745,10 +1868,7 @@ class ContinuousBatcher:
         # The stop table's width grows in pow2 buckets as requests with
         # larger stop sets arrive (bounded jit-cache growth).
         self.remaining = np.zeros((B,), np.int32)
-        w0 = (
-            1 << max(len(self.default_stop) - 1, 0).bit_length()
-            if self.default_stop else 1
-        )
+        w0 = pow2_bucket(len(self.default_stop))
         self.stop_tab = np.full((B, w0), -1, np.int32)
         # decode_chunk: max fused decode iterations per dispatch (the
         # effective K per dispatch adapts — see _pick_chunk — and is
@@ -1897,8 +2017,13 @@ class ContinuousBatcher:
         whole fleet.  ``device_done`` — see ``_free_slot``."""
         slot = self.slots[b]
         assert slot is not None
+        stranded: List[int] = []
         for blk in slot.blocks[slot.shared:]:
-            self._drop_chain_entry(blk)
+            stranded.extend(self._store.unpublish(blk))
+        # A radix unpublish drops the node's SUBTREE too (deeper shared
+        # chain blocks only reachable through the suspect node); idle
+        # retained blocks stranded by that go back to the free list.
+        self._invalidate_and_free(stranded)
         self.failed.append((slot.request_id, message))
         self.nonfinite_rows_total += 1
         self._free_slot(b, device_done=device_done)
@@ -1972,8 +2097,11 @@ class ContinuousBatcher:
         return rid
 
     def pending(self) -> bool:
-        return bool(self.queue) or any(
-            s is not None for s in self.slots.values()
+        return (
+            bool(self.queue)
+            or bool(self._restoring)
+            or bool(self._restored_ready)
+            or any(s is not None for s in self.slots.values())
         )
 
     def cancel(self, request_id: int) -> bool:
@@ -1989,6 +2117,22 @@ class ContinuousBatcher:
         for i, req in enumerate(self.queue):
             if req.rid == request_id:
                 del self.queue[i]
+                return True
+        for r in self._restoring:
+            if r.req.rid == request_id:
+                # Mid-swap cancel: the staged transfer may still be in
+                # flight, but nothing was scattered into the pool yet —
+                # release the claims, return the fresh blocks, and let
+                # the nodes fall back to host residency (slab intact).
+                self._restoring.remove(r)
+                self._abort_restore(r)
+                return True
+        for i, (req, chain, hits) in enumerate(self._restored_ready):
+            if req.rid == request_id:
+                # Restored but not yet admitted: blocks are adopted and
+                # claimed — unclaim them back into the idle LRU.
+                del self._restored_ready[i]
+                self._unclaim_blocks(hits)
                 return True
         for b, slot in self.slots.items():
             if slot is not None and slot.request_id == request_id:
@@ -2019,9 +2163,31 @@ class ContinuousBatcher:
             "drafts_proposed_total": self.drafts_proposed,
             "drafts_accepted_total": self.drafts_accepted,
             "draft_acceptance_rate": self.acceptance_rate(),
-            "prefix_cached_blocks": len(self._reusable),
+            # "prefix_cached_blocks" predates the radix index and is
+            # KEPT as an alias of the store's idle resident count so
+            # existing dashboards don't break.
+            "prefix_cached_blocks": self._store.cached_blocks(),
             "prefix_requests_hit_total": self.prefix_requests_hit,
             "prefix_blocks_reused_total": self.prefix_blocks_reused,
+            # KV-capacity subsystem (kvcache.py): radix index size,
+            # the fraction of admitted prompt tokens served from
+            # cached prefix blocks, and the host-tier swap ledger
+            # (blocks demoted D2H / restored H2D, in-flight swap-ins,
+            # cumulative swap-in wall time, clean per-request swap
+            # failures).
+            "radix_nodes_total": self._store.nodes_total(),
+            "prefix_hit_tokens_ratio": (
+                self.prefix_hit_tokens_total
+                / max(1, self.prompt_tokens_total)
+            ),
+            "host_kv_blocks": self.host_kv_blocks,
+            "host_tier_blocks": self._store.host_blocks(),
+            "swap_queue_depth": len(self._restoring),
+            "swap_ins_total": self.swap_ins_total,
+            "swap_in_blocks_total": self.swap_in_blocks_total,
+            "swap_out_blocks_total": self.swap_out_blocks_total,
+            "swap_in_ms_total": round(self.swap_in_ms_total, 3),
+            "swap_failures_total": self.swap_failures_total,
             "nonfinite_rows_total": self.nonfinite_rows_total,
             # Chunked-decode observability: the effective K of the most
             # recent chunk dispatch, dispatch count, and the host-
@@ -2105,7 +2271,7 @@ class ContinuousBatcher:
         )
         if (
             classic_admission_possible
-            and self.queue
+            and (self.queue or self._restoring or self._restored_ready)
             and any(s is not None for s in self.slots.values())
             and any(s is None for s in self.slots.values())
         ):
@@ -2116,7 +2282,10 @@ class ContinuousBatcher:
             # still names the dispatch that produced it, not after
             # admission overwrites the attribution record.  Admissions
             # are rare relative to steps, so the extra [B] fetch stays
-            # off the steady-state hot path.
+            # off the steady-state hot path.  A completed swap-in can
+            # admit through the same classic insert program even with
+            # the queue empty (``_restored_ready``), so in-flight and
+            # landed restores arm the barrier too.
             np.asarray(self.tau)
             self.host_syncs_total += 1
         self._admit()
@@ -2184,7 +2353,7 @@ class ContinuousBatcher:
         rows = sorted(self._dirty_rows)
         self._dirty_rows.clear()
         R = len(rows)
-        Rb = 1 << max(R - 1, 0).bit_length()  # pow2 jit-cache bucket
+        Rb = pow2_bucket(R)  # pow2 jit-cache bucket
         idx = np.full((Rb,), self.n_slots, np.int32)  # pads drop
         idx[:R] = rows
 
@@ -2742,15 +2911,31 @@ class ContinuousBatcher:
 
     def _capacity(self) -> int:
         """Allocatable blocks: truly free + evictable cached prefixes."""
-        return len(self.free_blocks) + len(self._reusable)
+        return len(self.free_blocks) + self._store.evictable()
+
+    def _demote_block(self, blk: int) -> Dict[str, Any]:
+        """Host-tier demotion D2H: fetch block ``blk``'s KV image (plus
+        the draft pool's twin under speculative serving) to host numpy
+        BEFORE the allocator invalidates its positions.  Admission-path
+        only — never on the decode hot path — and counted separately
+        from ``host_syncs_total`` (that counter is the chunked decode
+        loop's contract; demotion is capacity traffic)."""
+        slab = fetch_slab(self.pool, blk)
+        if self.spec:
+            slab.update(fetch_slab(self.draft_pool, blk, prefix="d_"))
+        self.swap_out_blocks_total += 1
+        return slab
 
     def _alloc_blocks(self, n: int) -> List[int]:
         """Pop n blocks, evicting LRU cached-prefix blocks when the free
-        list runs dry.  Evicted blocks' POSITIONS are invalidated here:
-        retained blocks keep valid pos (future reusers need them), but a
-        block re-purposed as part of a DECODE reservation is only
-        overwritten up to the prompt span — a stale pos >= 0 in the
-        beyond-the-prompt region would be attended as a live slot."""
+        list runs dry — into the host-DRAM tier when one is attached
+        (the block's KV demotes and its radix node stays matchable),
+        dropped outright otherwise.  Evicted blocks' POSITIONS are
+        invalidated here: retained blocks keep valid pos (future
+        reusers need them), but a block re-purposed as part of a DECODE
+        reservation is only overwritten up to the prompt span — a stale
+        pos >= 0 in the beyond-the-prompt region would be attended as a
+        live slot."""
         self._fault("alloc")
         out: List[int] = []
         evicted: List[int] = []
@@ -2758,46 +2943,29 @@ class ContinuousBatcher:
             if self.free_blocks:
                 out.append(self.free_blocks.pop(0))
             else:
-                blk, _ = self._reusable.popitem(last=False)
-                self._drop_chain_entry(blk)
+                blk, extra = self._store.pop_evictable(self._demote_block)
+                assert blk is not None, "allocation past capacity"
                 evicted.append(blk)
                 out.append(blk)
+                if extra:
+                    # A forced subtree drop (host-LRU victim / stranded
+                    # suffix) orphaned additional idle blocks: back to
+                    # the free list, positions invalidated.
+                    self._invalidate_and_free(extra)
         if evicted:
             # More evictions than one slot's span is impossible in one
             # call (n <= blocks_per_slot), but stay defensive.
-            for start in range(0, len(evicted), self.blocks_per_slot):
-                ids = np.full(
-                    (self.blocks_per_slot,), self.n_blocks, np.int32
-                )
-                chunk = evicted[start:start + self.blocks_per_slot]
-                ids[: len(chunk)] = chunk
-                self.pool = dataclasses.replace(
-                    self.pool,
-                    pos=_release_blocks(self.pool.pos, jnp.asarray(ids)),
-                )
-                if self.spec:
-                    self.draft_pool = dataclasses.replace(
-                        self.draft_pool,
-                        pos=_release_blocks(
-                            self.draft_pool.pos, jnp.asarray(ids)
-                        ),
-                    )
+            self._invalidate_evicted(evicted)
         return out
 
-    def _drop_chain_entry(self, blk: int) -> None:
-        key = self._block_chain.pop(blk, None)
-        if key is not None and self._prefix_index.get(key) == blk:
-            del self._prefix_index[key]
-
-    def _invalidate_and_free(self, blocks: List[int]) -> None:
-        """Return blocks to the free list with their pool positions
-        invalidated (a stale pos >= 0 in a re-purposed block's
-        beyond-the-prompt region would be attended as live KV)."""
-        if not blocks:
-            return
-        for start in range(0, len(blocks), self.blocks_per_slot):
-            chunk = blocks[start:start + self.blocks_per_slot]
-            ids = np.full((self.blocks_per_slot,), self.n_blocks, np.int32)
+    def _invalidate_evicted(self, evicted: List[int]) -> None:
+        """Invalidate repurposed blocks' pool positions (batched; pads
+        drop) — AFTER any demotion fetch, which needs them live."""
+        for start in range(0, len(evicted), self.blocks_per_slot):
+            ids = np.full(
+                (self.blocks_per_slot,), self.n_blocks, np.int32
+            )
+            chunk = evicted[start:start + self.blocks_per_slot]
             ids[: len(chunk)] = chunk
             self.pool = dataclasses.replace(
                 self.pool,
@@ -2810,6 +2978,40 @@ class ContinuousBatcher:
                         self.draft_pool.pos, jnp.asarray(ids)
                     ),
                 )
+
+    def demote_idle(self, n: int) -> int:
+        """Proactively demote up to ``n`` idle cached-prefix blocks into
+        the host tier, freeing HBM without dropping cache content (the
+        pressure path does the same thing lazily inside
+        ``_alloc_blocks``; this is the operational lever — and the
+        deterministic one for drills).  No-op without a tier; returns
+        the number of blocks demoted."""
+        if self.host_kv_blocks <= 0 or self._store.kind != "radix":
+            return 0
+        count = 0
+        drained: List[int] = []
+        for _ in range(n):
+            if not self._store.evictable():
+                break
+            blk, extra = self._store.pop_evictable(self._demote_block)
+            if blk is None:
+                break
+            drained.append(blk)
+            drained.extend(extra)
+            count += 1
+        # One batched invalidation for the whole sweep: per-block
+        # _release_blocks dispatches would pay the ~100ms tunnel
+        # latency once per demoted block.
+        self._invalidate_and_free(drained)
+        return count
+
+    def _invalidate_and_free(self, blocks: List[int]) -> None:
+        """Return blocks to the free list with their pool positions
+        invalidated (a stale pos >= 0 in a re-purposed block's
+        beyond-the-prompt region would be attended as live KV)."""
+        if not blocks:
+            return
+        self._invalidate_evicted(blocks)
         self.free_blocks.extend(blocks)
 
     @staticmethod
@@ -2831,49 +3033,52 @@ class ContinuousBatcher:
             keys.append(h.digest())  # digest() is non-destructive
         return keys
 
-    def _match_prefix(self, keys: List[bytes]) -> List[int]:
-        """Longest cached chain prefix -> its physical blocks."""
-        if not self.prefix_cache_enabled:
-            return []
-        hits: List[int] = []
-        for key in keys:
-            blk = self._prefix_index.get(key)
-            if blk is None:
-                break
-            hits.append(blk)
-        return hits
+    def _match_prefix(self, keys: List[bytes]) -> MatchResult:
+        """Longest cached chain prefix across ALL cached chains (the
+        radix walk; the exact store degenerates to the flat-map walk).
+        ``.blocks`` are the HBM-resident hits; a nonempty ``.restore``
+        names demoted nodes a host-tier swap-in could bring back."""
+        return self._store.match(keys)
 
     def _claim_blocks(self, blocks: List[int]) -> None:
+        self._store.on_claim(blocks)
         for blk in blocks:
             self._block_refs[blk] = self._block_refs.get(blk, 0) + 1
-            self._reusable.pop(blk, None)
+
+    def _unclaim_blocks(self, blocks: List[int]) -> None:
+        """Reverse of ``_claim_blocks`` for admissions that never landed
+        (aborted/cancelled swap-ins): drop the refs and push keyed
+        blocks whose last user this was back into the idle LRU."""
+        retained: List[int] = []
+        plain: List[int] = []
+        for blk in blocks:
+            refs = self._block_refs.get(blk, 1) - 1
+            if refs > 0:
+                self._block_refs[blk] = refs
+                continue
+            self._block_refs.pop(blk, None)
+            if self.prefix_cache_enabled and self._store.is_keyed(blk):
+                retained.append(blk)
+            else:
+                plain.append(blk)
+        self._store.retain(retained)
+        self._invalidate_and_free(plain)
 
     def _register_chain(self, blocks: List[int], keys: List[bytes]) -> None:
-        """Publish a request's freshly prefilled full prompt blocks.
+        """Publish a request's freshly prefilled full prompt blocks into
+        the prefix index.
 
-        A duplicate chain re-prefill (two identical prompts admitted in
-        one cold burst, or a stranded suffix re-keyed after a mid-chain
-        eviction) overwrites ``_prefix_index[key]`` — the superseded
-        block can never be hit again, so drop its chain entry; if nothing
-        is using it (it sat idle in ``_reusable``) free it outright.
-        Without this, ``_reusable`` accumulates unreachable blocks that
-        occupy pool capacity until LRU pressure happens to evict them."""
+        Radix: divergent chains share their common prefix NODES by
+        construction — a duplicate publication leaves the existing
+        node's block in place and the publisher's copy stays private
+        (plain-freed with its slot).  Exact (the legacy oracle): a
+        duplicate publication SUPERSEDES — the store returns the old
+        idle blocks, freed here in one batch (per-block frees would be
+        one jitted _release_blocks dispatch each, ~100 ms of tunnel
+        latency apiece in this environment)."""
         if not self.prefix_cache_enabled:
             return
-        superseded: List[int] = []
-        for blk, key in zip(blocks, keys):
-            old = self._prefix_index.get(key)
-            if old is not None and old != blk:
-                self._block_chain.pop(old, None)
-                if old in self._reusable:
-                    del self._reusable[old]
-                    superseded.append(old)
-            self._block_chain[blk] = key
-            self._prefix_index[key] = blk
-        # One batched free for the whole chain: per-block frees would be
-        # one jitted _release_blocks dispatch each (~100 ms of tunnel
-        # latency apiece in this environment).
-        self._invalidate_and_free(superseded)
+        self._invalidate_and_free(self._store.publish(keys, blocks))
 
     def _free_slot(self, b: int, device_done: bool = False) -> None:
         """Free slot ``b``.  ``device_done=True`` means the chunk program
@@ -2896,8 +3101,8 @@ class ContinuousBatcher:
             self._pf = None
         # Keyed blocks with no remaining users are RETAINED (prefix
         # cache) — their positions must stay valid for future reusers —
-        # later chain blocks enter the LRU first so chains evict
-        # back-to-front (an evicted middle block strands its suffix).
+        # handed to the store in chain order (it reverses, so chains
+        # enter the idle LRU leaves-first and evict back-to-front).
         plain: List[int] = []
         retained: List[int] = []
         for blk in slot.blocks:
@@ -2906,12 +3111,11 @@ class ContinuousBatcher:
                 self._block_refs[blk] = refs
                 continue
             self._block_refs.pop(blk, None)
-            if self.prefix_cache_enabled and blk in self._block_chain:
+            if self.prefix_cache_enabled and self._store.is_keyed(blk):
                 retained.append(blk)
             else:
                 plain.append(blk)
-        for blk in reversed(retained):
-            self._reusable[blk] = None
+        self._store.retain(retained)
         self._invalidate_and_free(plain)
         self.slots[b] = None
         self.table[b] = self.n_blocks
@@ -2941,7 +3145,7 @@ class ContinuousBatcher:
         columns the row actually has (admissibility guarantees the
         un-bucketed count fits, so the clamp never shrinks below it)."""
         nb = max(1, -(-n_suffix_tokens // self.block_size))
-        nb_b = 1 << (nb - 1).bit_length()
+        nb_b = pow2_bucket(nb)
         cap = self.blocks_per_slot - n_share
         return (min(nb_b, cap) if cap >= nb else nb) * self.block_size
 
@@ -2952,7 +3156,7 @@ class ContinuousBatcher:
         at the next ``_sync_device_rows``."""
         if n <= self.stop_tab.shape[1]:
             return
-        w = 1 << (n - 1).bit_length()
+        w = pow2_bucket(n)
         tab = np.full((self.n_slots, w), -1, np.int32)
         tab[:, : self.stop_tab.shape[1]] = self.stop_tab
         self.stop_tab = tab
@@ -2970,7 +3174,7 @@ class ContinuousBatcher:
         cache key discipline — both admission paths must bucket the same
         way) plus the per-row key/sampling-parameter arrays."""
         k = len(reqs)
-        kb = 1 << max(k - 1, 0).bit_length()
+        kb = pow2_bucket(k)
         keys = np.zeros((kb, 2), np.uint32)
         temps = np.zeros((kb,), np.float32)
         top_ps = np.ones((kb,), np.float32)
@@ -3118,6 +3322,8 @@ class ContinuousBatcher:
                                  chain[n_share:])
             self.prefix_requests_hit += 1
             self.prefix_blocks_reused += n_share
+            self.prompt_tokens_total += len(req.tokens)
+            self.prefix_hit_tokens_total += n_share * bs
 
     def _fused_scheduling(self) -> bool:
         """Fused prefill-decode scheduling is in force for this batcher
@@ -3129,16 +3335,24 @@ class ContinuousBatcher:
     def _admit(self) -> None:
         """Admit queued requests.
 
-        Classic path (``prefill_budget=0``, speculative batchers, or a
-        COLD pool with nothing mid-decode): whole-prompt batched
-        prefill dispatches at the step boundary — see
-        ``_admit_classic``.  Fused path (``prefill_budget`` > 0 while
-        any row is mid-decode): the queue head is moved to
+        Swap path first: in-flight swap-ins are POLLED (non-blocking
+        while anything is decoding — the overlap contract) and
+        completed ones admitted as plain prefix hits with FIFO
+        priority.  Then the classic path (``prefill_budget=0``,
+        speculative batchers, or a COLD pool with nothing mid-decode):
+        whole-prompt batched prefill dispatches at the step boundary —
+        see ``_admit_classic``.  Fused path (``prefill_budget`` > 0
+        while any row is mid-decode): the queue head is moved to
         ``prefilling`` state (blocks reserved, prompt uploaded once,
         row visible-but-inactive) and its prompt advances INSIDE the
         subsequent ``_fused_chunk`` dispatches — at most one admission
         is in flight at a time, FIFO; the rest of the queue waits
-        exactly as it would for capacity."""
+        exactly as it would for capacity.  A queue head whose matched
+        prefix includes host-tier blocks moves to ``restoring``
+        instead (either path) — later queue entries keep admitting
+        while its swap-in flies."""
+        self._poll_restores()
+        self._admit_restored_ready()
         if self._fused_scheduling():
             if self._pf is not None:
                 return  # one in-flight admission at a time
@@ -3148,6 +3362,143 @@ class ContinuousBatcher:
                 return
             # Cold pool: nobody to stall — classic batched admission.
         self._admit_classic()
+
+    # -- host-tier swap-ins (the ``restoring`` admission state) -------------
+
+    def _begin_restore(
+        self, req: "_Request", chain: List[bytes], match: MatchResult
+    ) -> bool:
+        """Start an async swap-in for a request whose matched prefix
+        includes demoted (host-tier) blocks: claim the path's resident
+        blocks, pin the demoted nodes, allocate their fresh HBM blocks,
+        and ``jax.device_put`` the slabs into staging buffers — then
+        park the request in ``restoring``.  No pool dependency is
+        created here, so decode chunks dispatched while the transfer
+        flies never wait on it.
+
+        Fault site ``kv_swap`` fires before the transfer; an injected
+        fault (or injected allocation OOM) fails ONLY this request —
+        claims released, fresh blocks returned, nodes unpinned and
+        host-resident again — and returns False (the server maps the
+        ``pop_failed`` entry to a clean HTTP 500)."""
+        resident = [n.block for n in match.path if n.block is not None]
+        self._claim_blocks(resident)
+        self._store.pin_restoring(match.restore)
+        fresh: List[int] = []
+        try:
+            self._fault("kv_swap")
+            fresh = self._alloc_blocks(len(match.restore))
+            staged = stage_restore(
+                [n.host for n in match.restore], fresh, self.n_blocks
+            )
+        except InjectedFault as e:
+            self._store.unpin_restoring(match.restore)
+            self._unclaim_blocks(resident)
+            if fresh:
+                self._invalidate_and_free(fresh)
+            self.failed.append((
+                req.rid,
+                f"kv swap-in failed: {e} (request aborted; host-tier "
+                f"blocks unpinned, server healthy)",
+            ))
+            self.swap_failures_total += 1
+            return False
+        self._claim_blocks(fresh)
+        self._restoring.append(_Restore(
+            req=req, chain=chain, path=match.path,
+            restore=match.restore, resident=resident, fresh=fresh,
+            staged=staged, t0=time.monotonic(),
+        ))
+        self.swap_ins_total += 1
+        return True
+
+    def _abort_restore(self, r: "_Restore") -> None:
+        """Unwind an in-flight swap-in (cancel / broken path): release
+        every claim — both the resident hits and the fresh blocks were
+        CLAIMED at begin, so both go through ``_unclaim_blocks`` (a
+        plain ``_invalidate_and_free`` of claimed blocks would strand
+        their refcounts and leak pool capacity) — and the nodes fall
+        back to host residency (the slabs were read, not moved; the
+        staging copy is simply dropped).  Nothing was scattered into
+        the pool, so no pool state needs undoing."""
+        self._store.unpin_restoring(r.restore)
+        self._unclaim_blocks(r.resident)
+        self._unclaim_blocks(r.fresh)
+
+    def _poll_restores(self) -> None:
+        """Advance in-flight swap-ins WITHOUT stalling decode: readiness
+        is ``jax.Array.is_ready`` on the staging buffers (non-blocking);
+        only when nothing at all is decoding (no active row, no
+        in-flight prefill — nobody to stall) does the poll block on the
+        transfer.  A ready swap-in pays ONE jitted adoption scatter
+        (``kvcache.adopt_into_pool``; both pools under speculative
+        serving) and moves the request to ``_restored_ready``."""
+        if not self._restoring:
+            return
+        idle = not bool(np.any(self.active)) and self._pf is None
+        for r in list(self._restoring):
+            r.polls += 1
+            # A concurrent non-finite subtree drop (``_fail_slot`` ->
+            # ``unpublish``) may have severed the matched path while
+            # the transfer flew — its KV is suspect, and the nulled
+            # node.block entries would otherwise crash admission.
+            # Unwind the claims and requeue the request at the head:
+            # it re-admits through a clean cold prefill,
+            # token-identically.
+            broken = any(
+                (not n.restoring) if n in r.restore else
+                (n.block is None)
+                for n in r.path
+            )
+            if broken:
+                self._restoring.remove(r)
+                self._abort_restore(r)
+                self.queue.insert(0, r.req)
+                continue
+            ready = restore_ready(r.staged)
+            if not ready and idle:
+                jax.block_until_ready(list(r.staged.values()))
+                ready = True
+            if not ready or r.polls <= self.swap_poll_min:
+                continue
+            self.pool = adopt_into_pool(self.pool, r.staged)
+            if self.spec:
+                self.draft_pool = adopt_into_pool(
+                    self.draft_pool, r.staged, prefix="d_"
+                )
+            self._store.complete_restore(r.restore, r.fresh)
+            self.swap_in_blocks_total += len(r.fresh)
+            self.swap_in_ms_total += (time.monotonic() - r.t0) * 1000.0
+            self._restoring.remove(r)
+            self._restored_ready.append(
+                (r.req, r.chain, [n.block for n in r.path])
+            )
+
+    def _admit_restored_ready(self) -> None:
+        """Admit completed swap-ins as plain prefix hits (their path
+        blocks are already claimed): through the fused prefill lane
+        when rows are decoding (the chunk walk starts at the matched
+        depth — no stall), through one grouped suffix-insert dispatch
+        otherwise.  FIFO among themselves; each still needs a free
+        slot and capacity for the rest of its reservation."""
+        while self._restored_ready:
+            req, chain, hits = self._restored_ready[0]
+            free = [b for b, s in self.slots.items() if s is None]
+            if not free:
+                return
+            if (req.blocks_needed(self.block_size) - len(hits)
+                    > self._capacity()):
+                return
+            if self._fused_scheduling() and bool(np.any(self.active)):
+                if self._pf is not None:
+                    return
+                self._restored_ready.pop(0)
+                self._setup_fused_prefill(req, chain, hits, claimed=True)
+            else:
+                self._restored_ready.pop(0)
+                self._admit_shared_group(
+                    [(req, chain, hits)], [free[0]]
+                )
 
     def _pf_chunk(self, suffix_len: int, n_share: int) -> int:
         """Prompt tokens per fused dispatch: ``prefill_budget`` rounded
@@ -3165,8 +3516,7 @@ class ContinuousBatcher:
         bs = self.block_size
         nbb = max(1, self.prefill_budget // bs)
         nbb = 1 << (nbb.bit_length() - 1)
-        nbs = max(1, -(-suffix_len // bs))
-        nbs = 1 << (nbs - 1).bit_length()
+        nbs = pow2_bucket(max(1, -(-suffix_len // bs)))
         c_blocks = min(nbb, nbs)
         view_blocks = self.blocks_per_slot - n_share
         while c_blocks > 1 and (
@@ -3178,30 +3528,54 @@ class ContinuousBatcher:
     def _begin_fused_prefill(self) -> None:
         """Move the queue head into ``prefilling`` state: reserve its
         blocks (claiming prefix-cache hits — hit rows start their chunk
-        walk at fill0), set up the host mirrors with the row VISIBLE
-        BUT INACTIVE (the fused program activates it on device the
-        dispatch its last chunk lands), and upload the suffix tokens +
-        walk scalars ONCE — later chunks are pure dispatches, zero
-        per-chunk host->device state traffic.  No model dispatch
-        happens here; the prefill itself rides ``_fused_chunk``."""
+        walk at fill0 = the matched depth), set up the host mirrors
+        with the row VISIBLE BUT INACTIVE (the fused program activates
+        it on device the dispatch its last chunk lands), and upload the
+        suffix tokens + walk scalars ONCE — later chunks are pure
+        dispatches, zero per-chunk host->device state traffic.  No
+        model dispatch happens here; the prefill itself rides
+        ``_fused_chunk``.  A head whose matched prefix includes
+        host-tier blocks moves to ``restoring`` instead, and the NEXT
+        head gets the prefill lane — swap-ins never block admission."""
         free = [b for b, s in self.slots.items() if s is None]
         if not free:
             return
-        req = self.queue[0]
-        need = req.blocks_needed(self.block_size)
-        if need > self._capacity():
-            return  # head-of-line blocking (FIFO fairness): wait
-        chain = (
-            self._chain_keys(req.tokens, self.block_size)
-            if self.prefix_cache_enabled else []
-        )
-        hits = self._match_prefix(chain)
-        self._claim_blocks(hits)
-        del self.queue[0]
-        b = free[0]
+        while self.queue:
+            req = self.queue[0]
+            need = req.blocks_needed(self.block_size)
+            if need > self._capacity():
+                return  # head-of-line blocking (FIFO fairness): wait
+            chain = (
+                self._chain_keys(req.tokens, self.block_size)
+                if self.prefix_cache_enabled else []
+            )
+            m = self._match_prefix(chain)
+            if m.restore:
+                del self.queue[0]
+                # Restoring (or cleanly failed on an injected swap
+                # fault) — either way the prefill lane is still open
+                # for the next head.
+                self._begin_restore(req, chain, m)
+                continue
+            del self.queue[0]
+            self._setup_fused_prefill(req, chain, m.blocks, claimed=False)
+            return
+
+    def _setup_fused_prefill(
+        self, req: "_Request", chain: List[bytes], hits: List[int],
+        claimed: bool = False,
+    ) -> None:
+        """The ``prefilling``-state setup shared by fresh admissions and
+        completed swap-ins (``claimed=True``: the hit blocks were
+        claimed at restore begin)."""
+        if not claimed:
+            self._claim_blocks(hits)
+        b = next(b for b, s in self.slots.items() if s is None)
         n_share = len(hits)
         base = n_share * self.block_size
-        fresh = self._alloc_blocks(need - n_share)
+        fresh = self._alloc_blocks(
+            req.blocks_needed(self.block_size) - n_share
+        )
         self._claim_blocks(fresh)
         blocks = hits + fresh
         suffix = req.tokens[base:]
@@ -3209,8 +3583,7 @@ class ContinuousBatcher:
         # Token buffer in whole chunks, chunk count pow2-bucketed (the
         # buffer length is a jit cache key of _fused_chunk); trailing
         # zeros are masked and never dispatched.
-        n_chunks = max(1, -(-len(suffix) // C))
-        n_chunks = 1 << (n_chunks - 1).bit_length()
+        n_chunks = pow2_bucket(max(1, -(-len(suffix) // C)))
         toks = np.zeros((n_chunks * C,), np.int32)
         toks[: len(suffix)] = suffix
         # Host mirrors: full reservation visible, row inactive; the
@@ -3243,9 +3616,11 @@ class ContinuousBatcher:
             d_key=jnp.asarray(self._request_key(req)),
         )
         self.fused_admissions_total += 1
+        self.prompt_tokens_total += len(req.tokens)
         if n_share:
             self.prefix_requests_hit += 1
             self.prefix_blocks_reused += n_share
+            self.prefix_hit_tokens_total += base
 
     def _admit_classic(self) -> None:
         """Classic admission with the decode-stall clock around it: the
@@ -3289,6 +3664,32 @@ class ContinuousBatcher:
             free_slots = [b for b, s in self.slots.items() if s is None]
             if not free_slots or not self.queue:
                 return
+            # Head-of-line swap-ins: a queue HEAD whose matched prefix
+            # includes host-tier blocks parks in ``restoring`` (async
+            # swap-in overlapped on decode) instead of cold-prefilling
+            # the demoted span; later entries keep admitting below.
+            # Non-head entries with demoted prefixes stay FIFO-honest:
+            # they admit now using only their HBM-resident hit depth.
+            # Only a radix store with a tier can ever report demoted
+            # hits, so the no-tier common case skips the scan entirely;
+            # the head's (chain, hits) carries into the pick loop so
+            # its prompt is hashed and matched once, not twice.
+            head_match: Optional[Tuple[int, List[bytes], List[int]]] = None
+            if self.host_kv_blocks > 0 and self._store.kind == "radix":
+                while self.queue:
+                    req = self.queue[0]
+                    chain0 = (
+                        self._chain_keys(req.tokens, self.block_size)
+                        if self.prefix_cache_enabled else []
+                    )
+                    m0 = self._match_prefix(chain0)
+                    if not m0.restore:
+                        head_match = (req.rid, chain0, m0.blocks)
+                        break
+                    if req.blocks_needed(self.block_size) > self._capacity():
+                        return  # FIFO: wait for capacity
+                    del self.queue[0]
+                    self._begin_restore(req, chain0, m0)
             picked: List[Tuple[_Request, List[bytes], List[int]]] = []
             budget = self._capacity()
             for req in self.queue:
@@ -3299,12 +3700,15 @@ class ContinuousBatcher:
                     # Head-of-line blocking (FIFO fairness): wait.
                     break
                 budget -= need
-                # Don't hash prompts for users who opted out.
-                chain = (
-                    self._chain_keys(req.tokens, self.block_size)
-                    if self.prefix_cache_enabled else []
-                )
-                hits = self._match_prefix(chain)
+                if head_match is not None and head_match[0] == req.rid:
+                    chain, hits = head_match[1], head_match[2]
+                else:
+                    # Don't hash prompts for users who opted out.
+                    chain = (
+                        self._chain_keys(req.tokens, self.block_size)
+                        if self.prefix_cache_enabled else []
+                    )
+                    hits = self._match_prefix(chain).blocks
                 # Claim hits at SELECTION time: a later allocation in
                 # this same admission round must not evict them.
                 self._claim_blocks(hits)
@@ -3347,6 +3751,7 @@ class ContinuousBatcher:
                 need = req.blocks_needed(self.block_size)
                 blocks = self._alloc_blocks(need)
                 row_blocks.append(blocks)
+                self.prompt_tokens_total += len(req.tokens)
                 # RIGHT padding (r5): token j at view column j, so block
                 # content is a pure function of the tokens (the prefix
                 # cache's keying invariant).  Trailing sentinels cover

@@ -67,6 +67,12 @@ def pytest_configure(config):
         "pytest -m 'chaos or faults'); the heavy ones are also marked "
         "slow so tier-1 keeps its time headroom",
     )
+    config.addinivalue_line(
+        "markers",
+        "kvcache: KV-capacity subsystem tests (radix prefix index + "
+        "host-DRAM block tier; CPU-safe and part of the default "
+        "tier-1 run — select just them with pytest -m kvcache)",
+    )
 
 
 # ---------------------------------------------------------------------------

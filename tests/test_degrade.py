@@ -295,6 +295,9 @@ def test_flash_attention_quarantine_rebuilds_onto_xla(model):
         assert srv.batcher.config.attn_impl == "xla"
 
 
+# slow (r06 budget rebalance, ~12 s): still in `make faults` / `make
+# chaos`; the cheap flash-quarantine cells above keep tier-1 coverage.
+@pytest.mark.slow
 def test_flash_quarantine_during_fused_prefill_keeps_admission(model):
     """flash_kernel faults during FUSED prefill chunks (attn auto, a
     >8-token chunk riding the decode dispatch) quarantine
@@ -514,7 +517,7 @@ def test_nonfinite_prompt_blocks_never_enter_prefix_cache(model):
     rid = cb.submit(prompt, max_new_tokens=4)
     cb.run_to_completion()
     assert cb.pop_failed()[0][0] == rid
-    assert cb._prefix_index == {}  # nothing published
+    assert cb.stats()["radix_nodes_total"] == 0  # nothing published
     assert len(cb.free_blocks) == cb.n_blocks  # everything returned
 
 

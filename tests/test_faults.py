@@ -89,6 +89,9 @@ def _stream_lines(url, payload, timeout=300):
 # FaultInjector unit behavior (no jax involved)
 # ---------------------------------------------------------------------------
 
+# slow (r06 budget rebalance, ~12 s): still in `make faults` / `make
+# chaos` (those targets select by marker, not by 'not slow').
+@pytest.mark.slow
 def test_step_fault_mid_prefill_chunk_replays_exactly(model):
     """A fault landing MID-PREFILL-CHUNK (the ``prefill_chunk`` site
     indexes prefill-carrying dispatches, so ``@1`` deterministically
@@ -386,6 +389,124 @@ def test_suffix_insert_fault_recovers(model):
         assert body2["tokens"] == want2
         assert inj.injected["suffix_insert"] == 1
         assert srv.recoveries_total == 1
+
+
+# ---------------------------------------------------------------------------
+# Host-tier swap-ins (site kv_swap) — radix index + host-DRAM tier
+# ---------------------------------------------------------------------------
+
+def _demoted_tier_batcher(model, injector=None, **kw):
+    """Radix + host-tier batcher whose ``session`` chain has been
+    demoted into the tier (seed the chain, then run a filler whose
+    reservation needs every free block plus the idle chain)."""
+    params, config = model
+    rng = np.random.RandomState(71)
+    session = rng.randint(1, 128, size=40).tolist()  # 2 keyed blocks
+    kwargs = dict(
+        n_slots=2, max_len=128, block_size=16, n_blocks=8,
+        prefix_cache=True, host_kv_blocks=4, fault_injector=injector,
+    )
+    kwargs.update(kw)
+    cb = ContinuousBatcher(params, config, **kwargs)
+    cb.submit(list(session), max_new_tokens=4)
+    cb.run_to_completion()
+    cb.submit(rng.randint(1, 128, size=112).tolist(), max_new_tokens=8)
+    cb.run_to_completion()
+    assert cb.stats()["host_tier_blocks"] >= 2
+    return cb, session
+
+
+@pytest.mark.kvcache
+def test_kv_swap_fault_fails_only_restoring_request(model):
+    """An injected ``kv_swap`` fault is CONTAINED: the restoring
+    request fails with a clean HTTP 500 (via ``pop_failed``, exactly
+    like the non-finite guard), its claims are released and the host
+    slabs unpinned, a concurrent request completes untouched, the
+    server never burns crash-recovery budget — and a RETRY of the same
+    session swaps in fine (the slabs survived the failed attempt)."""
+    params, config = model
+    inj = FaultInjector("kv_swap@0:error")
+    cb, session = _demoted_tier_batcher(model, injector=inj)
+    cold = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                             block_size=16)
+    cw = cold.submit(list(session), max_new_tokens=6)
+    want = cold.run_to_completion()[cw]
+    ow = cold.submit(list(PROMPTS[0]), max_new_tokens=MAX_NEW)
+    want_other = cold.run_to_completion()[ow]
+
+    with LLMServer(cb) as srv:
+        try:
+            _post(srv.address,
+                  {"prompt": session, "max_new_tokens": 6})
+            assert False, "expected a 500"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+            assert b"swap-in failed" in e.read()
+        # The failure was contained: other traffic unaffected, no
+        # recovery burned, loop healthy.
+        _, body = _post(
+            srv.address,
+            {"prompt": list(PROMPTS[0]), "max_new_tokens": MAX_NEW},
+        )
+        assert body["tokens"] == want_other
+        assert srv.recoveries_total == 0
+        code, _ = _get(srv.address, "/healthz")
+        assert code == 200
+        # Blocks unpinned, slabs intact: the retry restores and emits
+        # exactly the cold tokens.
+        _, body2 = _post(
+            srv.address, {"prompt": session, "max_new_tokens": 6}
+        )
+        assert body2["tokens"] == want
+        assert srv.batcher.stats()["swap_failures_total"] == 1
+        assert srv.batcher.stats()["swap_ins_total"] == 1
+        assert inj.injected["kv_swap"] == 1
+        # Nothing leaked: no dangling refcounts on the batcher.
+        assert not srv.batcher._block_refs or any(
+            s is not None for s in srv.batcher.slots.values()
+        )
+
+
+@pytest.mark.kvcache
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_crash_recovery_replay_with_radix_and_host_tier(model):
+    """A generic step fault mid-decode of a RESTORED session recovers
+    token-identically: the rebuilt batcher's index and tier start
+    empty, the replay re-prefills cold (prompt + delivered tokens),
+    and greedy output matches the fault-free run — the radix index and
+    host tier never change what is emitted, even across a crash."""
+    params, config = model
+    cold = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                             block_size=16)
+    cb0, session = _demoted_tier_batcher(model)
+    cw = cold.submit(list(session), max_new_tokens=8)
+    want = cold.run_to_completion()[cw]
+    # Fault-free tier run sanity: restored == cold.
+    rid = cb0.submit(list(session), max_new_tokens=8)
+    assert cb0.run_to_completion()[rid] == want
+
+    inj = FaultInjector("step@5:error")
+    cb, session = _demoted_tier_batcher(model)
+    # Arm AFTER the demotion choreography (its drains consume step
+    # indices); the server run starts at the injector's zero.
+    cb.fault_injector = inj
+    with LLMServer(cb) as srv:
+        _, body = _post(
+            srv.address, {"prompt": session, "max_new_tokens": 8}
+        )
+        assert body["tokens"] == want
+        assert inj.injected["step"] == 1
+        assert srv.recoveries_total == 1
+        # The rebuild preserved the KV-capacity configuration.
+        assert srv.batcher.prefix_index == "radix"
+        assert srv.batcher.host_kv_blocks == 4
+
+
+def test_kv_swap_spec_parse_roundtrip():
+    specs = FaultSpec.parse("kv_swap@2:error,kv_swap~0.5:oom")
+    assert specs[0] == FaultSpec(site="kv_swap", kind="error", at=2)
+    assert specs[1] == FaultSpec(site="kv_swap", kind="oom", p=0.5)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,678 @@
+"""KV-capacity subsystem (kvcache.py): radix prefix index + host-DRAM
+block tier (make kvcache; tier-1-safe, CPU).
+
+The invariants pinned here:
+  * radix-hit admissions are TOKEN- AND LOGPROB-IDENTICAL to cold
+    prefill across {greedy, seeded-sampled} x {hit depth 0 / partial /
+    full} x {fp32, int8-KV} x {fused, classic admission} — a hit (at
+    any depth, through either scheduler) changes what is computed,
+    never what is emitted.  int8 oracles are CHUNK-MATCHED (chunk
+    boundaries decide where prompt KV quantizes — the PR-5 rule);
+  * the radix tree shares divergent chains' common prefix by
+    construction and never mints duplicate nodes;
+  * eviction under allocation pressure only ever takes refcount-0
+    blocks — live (refcounted) shared blocks survive;
+  * demote -> restore through the host tier is BIT-EXACT at the pool
+    level (including int8 scales and the draft-pool twin) and
+    token-identical at the serving level;
+  * a swap-in in flight never stalls decode: every mid-swap chunk
+    dispatch keeps emitting at an un-collapsed K, and the restored
+    admission pays <= 1 state upload (the fused-admission budget) —
+    the ``make perf-smoke`` contract.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.kvcache import (
+    RadixPrefixStore,
+    adopt_into_pool,
+    fetch_slab,
+    make_prefix_store,
+    stage_restore,
+)
+from jax_llama_tpu.serving import ContinuousBatcher, init_pool
+
+pytestmark = pytest.mark.kvcache
+
+CFG = dict(
+    vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    multiple_of=32, max_seq_len=256, dtype="float32", param_dtype="float32",
+)
+BS = 16  # block size used throughout
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = get_config("tiny", **CFG)
+    params = init_params(jax.random.PRNGKey(0), config)
+    return params, config
+
+
+# ---------------------------------------------------------------------------
+# Radix store mechanics (no model)
+# ---------------------------------------------------------------------------
+
+def _fake_chain(n):
+    return [bytes([i]) * 8 for i in range(n)]
+
+
+def test_radix_publish_match_and_dedup():
+    store = RadixPrefixStore()
+    keys = _fake_chain(3)
+    store.publish(keys, [10, 11, 12])
+    m = store.match(keys)
+    assert m.blocks == [10, 11, 12] and not m.restore
+    assert store.match(keys[:2]).blocks == [10, 11]
+    assert store.match([b"zz" * 4] + keys).blocks == []
+    # Divergent chain sharing the first two nodes: one new node only.
+    keys2 = keys[:2] + [b"\xff" * 8]
+    store.publish(keys2, [10, 11, 13])
+    assert store.nodes_total() == 4
+    # Duplicate publication keeps the existing blocks; the fresh copies
+    # stay unkeyed.
+    store.publish(keys, [20, 21, 22])
+    assert store.match(keys).blocks == [10, 11, 12]
+    assert not store.is_keyed(20)
+
+
+def test_radix_eviction_is_leaves_first():
+    """Dropping (no tier) must never strand a resident suffix: an idle
+    interior node with resident children is skipped in favor of a
+    leaf, whatever the LRU order says."""
+    store = RadixPrefixStore()
+    keys = _fake_chain(3)
+    store.publish(keys, [10, 11, 12])
+    # Retain PARENT-first (the adversarial order; the batcher's
+    # _free_slot hands chains in order and the store reverses).
+    store.retain([10, 11, 12])
+    got = []
+    while store.evictable():
+        blk, extra = store.pop_evictable(None)
+        got.append(blk)
+        assert not extra  # leaves-first never strands anything
+    assert got == [12, 11, 10]  # back-to-front despite LRU front = 10
+
+
+def test_radix_unpublish_drops_subtree():
+    """The non-finite guard's contract: unpublishing a suspect block
+    removes its whole subtree (deeper chain blocks are only reachable
+    through it), returning stranded idle blocks for freeing."""
+    store = RadixPrefixStore()
+    keys = _fake_chain(3)
+    store.publish(keys, [10, 11, 12])
+    store.retain([12])  # leaf idle; 10/11 still "live" (no refs here)
+    freed = store.unpublish(11)
+    assert freed == [12]  # the stranded idle leaf
+    assert store.nodes_total() == 1  # only the root child survives
+    assert store.match(keys).blocks == [10]
+
+
+def test_host_tier_demote_keeps_node_matchable():
+    store = make_prefix_store("radix", host_blocks=4)
+    keys = _fake_chain(2)
+    store.publish(keys, [10, 11])
+    store.retain([10, 11])
+    blk, extra = store.pop_evictable(lambda b: {"fake": np.zeros(2)})
+    assert blk == 11 and not extra
+    assert store.host_blocks() == 1
+    m = store.match(keys)
+    assert m.blocks == [10]           # resident prefix
+    assert len(m.restore) == 1        # demoted node still on the path
+    assert m.restore[0].host is not None
+    # Completing a restore re-anchors the node on its fresh block.
+    store.pin_restoring(m.restore)
+    assert store.match(keys).blocks == [10]  # restoring = unreachable
+    store.complete_restore(m.restore, [42])
+    assert store.match(keys).blocks == [10, 42]
+    assert store.host_blocks() == 0
+
+
+def test_host_tier_lru_capacity():
+    """The tier holds at most ``host_blocks`` slabs; overflow evicts the
+    oldest unpinned slab and its node (plus any now-unreachable
+    subtree) drops."""
+    store = make_prefix_store("radix", host_blocks=2)
+    keys = _fake_chain(3)
+    store.publish(keys, [10, 11, 12])
+    store.retain([10, 11, 12])
+    extras = []
+    for _ in range(3):
+        _, extra = store.pop_evictable(lambda b: {"fake": np.zeros(2)})
+        extras.extend(extra)
+    assert store.host_blocks() == 2
+    assert not extras  # demotions themselves strand nothing
+
+
+# ---------------------------------------------------------------------------
+# Demote -> restore round trip (pool-level bit-exactness)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("int8", [False, True])
+def test_demote_restore_round_trip_bit_exact(model, int8):
+    _, config = model
+    if int8:
+        config = dataclasses.replace(config, kv_cache_dtype="int8")
+    pool = init_pool(config, n_blocks=4, block_size=8)
+    rng = np.random.RandomState(0)
+
+    def fill(pool):
+        reps = {}
+        for name in ("k", "v", "pos", "k_scale", "v_scale"):
+            a = getattr(pool, name)
+            if a is None:
+                continue
+            if a.dtype == jnp.int8:
+                v = rng.randint(-127, 127, size=a.shape).astype(np.int8)
+            elif a.dtype == jnp.int32:
+                v = rng.randint(0, 50, size=a.shape).astype(np.int32)
+            else:
+                v = rng.randn(*a.shape).astype(np.asarray(a).dtype)
+            reps[name] = jnp.asarray(v)
+        return dataclasses.replace(pool, **reps)
+
+    pool = fill(pool)
+    want = {n: np.asarray(getattr(pool, n)[:, :, 2])
+            for n in ("k", "v", "k_scale", "v_scale")
+            if getattr(pool, n) is not None}
+    want["pos"] = np.asarray(pool.pos[2])
+
+    slab = fetch_slab(pool, 2)
+    # Clobber the block (what reallocation does), then restore it into
+    # a DIFFERENT physical block — content must round-trip bit-exact.
+    staged = stage_restore([slab], [1], sentinel=4)
+    jax.block_until_ready(list(staged.values()))
+    pool = adopt_into_pool(pool, staged)
+    for name, w in want.items():
+        arr = getattr(pool, name)
+        got = np.asarray(arr[1] if name == "pos" else arr[:, :, 1])
+        np.testing.assert_array_equal(got, w, err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# Serving-level parity matrix
+# ---------------------------------------------------------------------------
+
+def _drain(cb, rid):
+    """Step until ``rid`` finishes (other rows may stay live — a
+    resident decode row must survive, or a later probe would land on a
+    cold pool and admit classically); returns (tokens, logprobs) for
+    ``rid`` (logprobs empty without logprobs mode)."""
+    toks, lps = [], []
+    guard = 0
+    done = False
+    while not done:
+        guard += 1
+        assert guard < 400
+        if not cb.pending():
+            break
+        for tup in cb.step():
+            if tup[0] == rid:
+                toks.append(tup[1])
+                if len(tup) > 3:
+                    lps.append(float(tup[3]))
+                done = done or bool(tup[2])
+    return toks, lps
+
+
+def _assert_parity(got, want, ctx):
+    """Tokens exact; logprobs to fp32-noise tolerance (the oracle runs
+    a differently-SHAPED dispatch — XLA may fuse differently, the
+    PR-5 comparison discipline)."""
+    assert got[0] == want[0], ctx
+    np.testing.assert_allclose(
+        got[1], want[1], rtol=1e-5, atol=1e-6, err_msg=str(ctx)
+    )
+
+
+def _submit(cb, tokens, sampling):
+    kw = dict(max_new_tokens=6)
+    if sampling == "sampled":
+        kw.update(temperature=0.8, seed=7)
+    return cb.submit(list(tokens), **kw)
+
+
+# The full matrix rides the slow tier (make kvcache / pytest -m
+# kvcache runs it all; tier-1 keeps the smoke slice below) — the PR-2
+# slow-marker rebalance discipline that keeps tier-1 inside its 870 s
+# budget.
+@pytest.mark.slow
+@pytest.mark.parametrize("int8", [
+    pytest.param(False, id="fp32"),
+    pytest.param(True, id="int8"),
+])
+@pytest.mark.parametrize("sampling", ["greedy", "sampled"])
+def test_radix_hit_parity_matrix(model, sampling, int8):
+    """radix-hit ≡ cold-prefill, tokens AND logprobs, across hit depth
+    {0, partial, full} x {fused, classic} admission.  The seed request
+    establishes a chain whose first 2 blocks (32 tokens) the partial
+    probe shares and the full probe matches entirely; the cold oracle
+    runs prefix_cache=False at MATCHED prefill chunking (int8-KV
+    quantizes prompt KV at chunk boundaries, so the oracle must cut
+    the prompt where the warm path does — depth-0 classic admission is
+    the one case whose warm dispatch is itself a single-shot insert)."""
+    params, config = model
+    if int8:
+        config = dataclasses.replace(config, kv_cache_dtype="int8")
+    rng = np.random.RandomState(21)
+    prefix = rng.randint(1, 128, size=32).tolist()     # 2 full blocks
+    seed_prompt = prefix + rng.randint(1, 128, size=8).tolist()
+    probes = {
+        "zero": rng.randint(1, 128, size=64).tolist(),  # shares nothing
+        "partial": prefix + rng.randint(1, 128, size=32).tolist(),
+        "full": list(seed_prompt),                      # all keyed blocks
+    }
+    expected_hit_blocks = {"zero": 0, "partial": 2, "full": 2}
+
+    for admission in ("classic", "fused"):
+        for depth, probe in probes.items():
+            oracle_chunk = (
+                None if (admission, depth) == ("classic", "zero") else 32
+            )
+            cold = ContinuousBatcher(
+                params, config, n_slots=2, max_len=256, block_size=BS,
+                prefix_cache=False, logprobs=True,
+                prefill_chunk=oracle_chunk,
+            )
+            want = _drain(cold, _submit(cold, probe, sampling))
+
+            warm = ContinuousBatcher(
+                params, config, n_slots=2, max_len=256, block_size=BS,
+                prefix_cache=True, logprobs=True,
+                decode_chunk=4 if admission == "fused" else 1,
+                prefill_budget=32 if admission == "fused" else 0,
+            )
+            if admission == "fused":
+                # A resident decoding row (long-lived: it must still be
+                # decoding when the PROBE admits, or the fused lane
+                # never engages) makes the probe ride the fused
+                # prefill lane — cold pools admit classically.
+                warm.submit([3, 5, 9], max_new_tokens=120)
+                warm.step()
+                warm.step()
+            r0 = _submit(warm, seed_prompt, sampling)
+            _drain(warm, r0)  # publish the chain
+            h0 = warm.stats()["prefix_blocks_reused_total"]
+            f0 = warm.fused_admissions_total
+            got = _drain(warm, _submit(warm, probe, sampling))
+            reused = warm.stats()["prefix_blocks_reused_total"] - h0
+            if admission == "fused":
+                # The probe really rode the fused prefill lane.
+                assert warm.fused_admissions_total > f0, (depth, int8)
+            _assert_parity(got, want, (admission, depth, sampling, int8))
+            # Partial-prefix admission reuses >= the matched blocks.
+            assert reused >= expected_hit_blocks[depth], (
+                admission, depth
+            )
+
+
+def test_radix_hit_parity_smoke(model):
+    """Tier-1 slice of the matrix above: the strictest cheap cell —
+    seeded-sampled fp32, PARTIAL hit depth, classic admission
+    (seeded-sampled consumes the key chains greedy never touches;
+    partial depth exercises the mid-chain radix walk; the fused ×
+    restored lane runs in tier-1 via
+    test_swap_in_flight_never_stalls_decode)."""
+    params, config = model
+    rng = np.random.RandomState(21)
+    prefix = rng.randint(1, 128, size=32).tolist()
+    seed_prompt = prefix + rng.randint(1, 128, size=8).tolist()
+    probe = prefix + rng.randint(1, 128, size=32).tolist()
+
+    cold = ContinuousBatcher(params, config, n_slots=2, max_len=256,
+                             block_size=BS, prefix_cache=False,
+                             logprobs=True, prefill_chunk=32)
+    want = _drain(cold, _submit(cold, probe, "sampled"))
+    warm = ContinuousBatcher(params, config, n_slots=2, max_len=256,
+                             block_size=BS, prefix_cache=True,
+                             logprobs=True)
+    _drain(warm, _submit(warm, seed_prompt, "sampled"))
+    got = _drain(warm, _submit(warm, probe, "sampled"))
+    assert warm.stats()["prefix_blocks_reused_total"] >= 2
+    _assert_parity(got, want, "classic")
+
+
+def test_eviction_under_pressure_keeps_live_refcounted_blocks(model):
+    """Allocation pressure while SHARERS are live: only refcount-0
+    (idle) blocks may be evicted — the live shared prefix survives and
+    both sharers finish token-identically to a cold run."""
+    params, config = model
+    rng = np.random.RandomState(31)
+    # Pool of 16 blocks, max_len 128 (8 blocks/slot).
+    idle_chain = rng.randint(1, 128, size=40).tolist()  # keys 2 blocks
+    shared = rng.randint(1, 128, size=40).tolist()
+    a, b = shared + [3], shared + [9, 4]
+
+    cb = ContinuousBatcher(params, config, n_slots=3, max_len=128,
+                           block_size=BS, n_blocks=12, prefix_cache=True)
+    cb.submit(list(idle_chain), max_new_tokens=4)
+    cb.run_to_completion()           # 2 idle keyed blocks
+    cb.submit(list(shared) + [7], max_new_tokens=4)
+    cb.run_to_completion()           # 2 more idle keyed blocks
+    ra = cb.submit(list(a), max_new_tokens=8)
+    rb = cb.submit(list(b), max_new_tokens=8)
+    got = {ra: [], rb: []}
+    for tup in cb.step():            # both sharers admitted, live
+        got[tup[0]].append(tup[1])
+    live_blocks = set()
+    for s in cb.slots.values():
+        if s is not None:
+            live_blocks.update(s.blocks)
+    # The filler's 6-block reservation exceeds the 4 free blocks while
+    # the sharers hold theirs, so eviction must reclaim idle blocks —
+    # and the only refcount-0 candidates are the IDLE chain's; the
+    # sharers' live (claimed) shared blocks are untouchable.
+    idle_keys = cb._chain_keys(idle_chain, BS)
+    assert len(cb._store.match(idle_keys).blocks) == 2  # resident now
+    assert len(cb.free_blocks) == 4
+    filler = rng.randint(1, 128, size=80).tolist()
+    cb.submit(filler, max_new_tokens=8)
+    while cb.pending():
+        for tup in cb.step():
+            if tup[0] in got:
+                got[tup[0]].append(tup[1])
+    # Eviction took the idle chain (no tier: dropped), not the live one.
+    assert len(cb._store.match(idle_keys).blocks) < 2
+    assert live_blocks  # the sharers really held blocks mid-pressure
+    cold = ContinuousBatcher(params, config, n_slots=2, max_len=128,
+                             block_size=BS, prefix_cache=False)
+    ca = cold.submit(list(a), max_new_tokens=8)
+    cbq = cold.submit(list(b), max_new_tokens=8)
+    cres = cold.run_to_completion()
+    assert got[ra] == cres[ca]
+    assert got[rb] == cres[cbq]
+
+
+# ---------------------------------------------------------------------------
+# Host tier at the serving level
+# ---------------------------------------------------------------------------
+
+def _tier_batcher(params, config, **kw):
+    """Small pool + host tier: geometry chosen so one big filler
+    reservation forces the idle session chain to demote."""
+    kwargs = dict(
+        n_slots=2, max_len=128, block_size=BS, n_blocks=8,
+        prefix_cache=True, host_kv_blocks=4,
+    )
+    kwargs.update(kw)
+    return ContinuousBatcher(params, config, **kwargs)
+
+
+def _seed_and_demote(cb, session, rng):
+    """Complete ``session`` (2 keyed blocks retained), then run a
+    filler whose reservation needs every free block PLUS the idle
+    chain — the chain demotes into the host tier."""
+    rid = cb.submit(list(session), max_new_tokens=4)
+    cb.run_to_completion()
+    filler = rng.randint(1, 128, size=112).tolist()  # 7 blocks + 1
+    cb.submit(filler, max_new_tokens=8)
+    cb.run_to_completion()
+    assert cb.stats()["host_tier_blocks"] >= 1
+    return rid
+
+
+@pytest.mark.parametrize("sampling", [
+    pytest.param("greedy", marks=pytest.mark.slow),
+    "sampled",
+])
+def test_demote_restore_token_identical(model, sampling):
+    """A session whose cached prefix was demoted to the host tier
+    admits through the ``restoring`` state (async swap-in + adoption)
+    and emits exactly the cold batcher's tokens and logprobs."""
+    params, config = model
+    rng = np.random.RandomState(41)
+    session = rng.randint(1, 128, size=40).tolist()
+
+    cold = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                             block_size=BS, prefix_cache=False,
+                             logprobs=True)
+    want = _drain(cold, _submit(cold, session, sampling))
+
+    cb = _tier_batcher(params, config, logprobs=True)
+    _seed_and_demote(cb, session, rng)
+    # The filler evicted the session chain into the tier; now the
+    # session comes back — its admission must swap the blocks in.
+    got = _drain(cb, _submit(cb, session, sampling))
+    st = cb.stats()
+    _assert_parity(got, want, sampling)
+    assert st["swap_ins_total"] == 1
+    assert st["swap_in_blocks_total"] == 2
+    assert st["swap_out_blocks_total"] >= 2
+    assert st["swap_in_ms_total"] > 0
+    assert st["prefix_requests_hit_total"] == 1
+    assert st["prefix_blocks_reused_total"] == 2  # the restored depth
+
+
+@pytest.mark.slow
+def test_more_live_sessions_than_hbm_pool_completes_via_tier(model):
+    """The capacity headline: a workload of sessions whose combined KV
+    exceeds the HBM pool completes with every revisit hitting the
+    cache (restored from the tier), no live block ever evicted, and
+    cold re-prefills only on the first visit."""
+    params, config = model
+    rng = np.random.RandomState(43)
+    sessions = [rng.randint(1, 128, size=40).tolist() for _ in range(3)]
+    # Pool: 6 blocks = 1.5 sessions' reservations (each needs 4);
+    # tier: 8 more — the three sessions' retained chains cannot all be
+    # HBM-resident, so revisits must come back through the tier.
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64,
+                           block_size=BS, n_blocks=6, prefix_cache=True,
+                           host_kv_blocks=8)
+    cold = ContinuousBatcher(params, config, n_slots=1, max_len=64,
+                             block_size=BS, prefix_cache=False)
+    # Visit each session twice, round-robin: second visits must hit
+    # (HBM or tier) and match cold outputs.
+    for round_i in range(2):
+        for s in sessions:
+            rid = cb.submit(list(s), max_new_tokens=8)
+            got = cb.run_to_completion()[rid]
+            crid = cold.submit(list(s), max_new_tokens=8)
+            assert got == cold.run_to_completion()[crid]
+    st = cb.stats()
+    assert st["prefix_requests_hit_total"] == 3   # every revisit hit
+    assert st["swap_ins_total"] >= 1              # at least one from tier
+    assert st["swap_failures_total"] == 0
+
+
+def test_swap_in_flight_never_stalls_decode(model):
+    """The perf-smoke contract: while a swap-in is in flight
+    (``swap_poll_min`` holds the restoring window open), every chunk
+    dispatch keeps emitting from the resident decode row at an
+    UN-COLLAPSED K, and the restored admission pays <= 1 state upload
+    — the same budget as a fused admission."""
+    params, config = model
+    rng = np.random.RandomState(47)
+    session = rng.randint(1, 128, size=40).tolist()
+    cb = _tier_batcher(
+        params, config, n_slots=2, n_blocks=12,
+        decode_chunk=4, prefill_budget=16,
+    )
+    cb.submit(list(session), max_new_tokens=4)
+    cb.run_to_completion()
+    # Deterministic demotion (the operational lever; the pressure path
+    # is covered by test_demote_restore_token_identical).
+    assert cb.demote_idle(2) == 2
+    assert cb.stats()["host_tier_blocks"] == 2
+    # Resident decoding row, chunk size ramped to 4.
+    r0 = cb.submit([3, 5, 9], max_new_tokens=60)
+    cb.step()
+    cb.step()
+    cb.step()
+    assert cb.decode_chunk_last == 4
+    # Hold the swap-in open for 3 polls so the overlap is observable.
+    cb.swap_poll_min = 3
+    u0 = cb.state_uploads_total
+    rid = cb.submit(list(session), max_new_tokens=4)
+    saw_restoring = 0
+    first = {rid: None}
+    guard = 0
+    while first[rid] is None:
+        guard += 1
+        assert guard < 30
+        evs = cb.step()
+        if cb._restoring:
+            saw_restoring += 1
+            # Mid-swap: the resident row kept emitting a full chunk —
+            # zero stall dispatches, K un-collapsed.
+            assert cb.decode_chunk_last == 4
+            assert any(ev[0] == r0 for ev in evs)
+        for ev in evs:
+            if ev[0] == rid and first[rid] is None:
+                first[rid] = ev[1]
+    assert saw_restoring >= 2          # the window really was open
+    assert cb.stats()["swap_queue_depth"] == 0
+    # The whole restored admission cost <= 1 dirty-row state upload.
+    assert cb.state_uploads_total - u0 <= 1
+    while cb.pending():
+        cb.step()
+    assert cb.stats()["decode_stall_ms_total"] == 0.0
+
+
+def test_cancel_mid_restore_unpins_everything(model):
+    """Cancelling a restoring request releases its claims: the nodes
+    fall back to host residency, the fresh blocks return to the free
+    list, and a later resubmit restores cleanly."""
+    params, config = model
+    rng = np.random.RandomState(53)
+    session = rng.randint(1, 128, size=40).tolist()
+    cb = _tier_batcher(params, config, n_slots=2, n_blocks=12,
+                       decode_chunk=4, prefill_budget=16)
+    cb.submit(list(session), max_new_tokens=4)
+    cb.run_to_completion()
+    assert cb.demote_idle(2) == 2
+    r0 = cb.submit([3, 5, 9], max_new_tokens=40)
+    cb.step()
+    cb.step()
+    cb.swap_poll_min = 100  # keep the restore in flight
+    cap0 = cb._capacity()
+    refs0 = dict(cb._block_refs)
+    rid = cb.submit(list(session), max_new_tokens=4)
+    cb.step()
+    assert cb.stats()["swap_queue_depth"] == 1
+    assert cb.cancel(rid)
+    assert cb.stats()["swap_queue_depth"] == 0
+    assert cb.stats()["host_tier_blocks"] >= 2  # slabs intact
+    # Leak regression: the restore CLAIMED both its resident hits and
+    # its fresh blocks — cancel must unclaim (not just free) them, or
+    # pool capacity and the refcount table drift permanently.
+    assert cb._capacity() == cap0
+    assert cb._block_refs == refs0
+    cb.swap_poll_min = 0
+    # Resubmit: restores and completes fine.
+    rid2 = cb.submit(list(session), max_new_tokens=4)
+    got = []
+    while cb.pending():
+        for tup in cb.step():
+            if tup[0] == rid2:
+                got.append(tup[1])
+    cold = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                             block_size=BS, prefix_cache=False)
+    cr = cold.submit(list(session), max_new_tokens=4)
+    assert got == cold.run_to_completion()[cr]
+
+
+def test_broken_restore_path_requeues_cold(model):
+    """A non-finite unpublish that severs a restore's matched path
+    mid-swap (another request on the shared chain poisons) must not
+    crash admission with nulled node.block entries: the poll detects
+    the broken path, unwinds the claims, and requeues the request at
+    the head for a clean cold prefill — token-identical."""
+    params, config = model
+    rng = np.random.RandomState(61)
+    session = rng.randint(1, 128, size=40).tolist()
+    cb = _tier_batcher(params, config, n_slots=2, n_blocks=12,
+                       decode_chunk=4, prefill_budget=16)
+    cb.submit(list(session), max_new_tokens=4)
+    cb.run_to_completion()
+    # Demote only the LEAF: the restore's path mixes one resident hit
+    # (the parent) with one host-tier node — the mixed shape finding 2
+    # needs.
+    assert cb.demote_idle(1) == 1
+    r0 = cb.submit([3, 5, 9], max_new_tokens=40)
+    cb.step()
+    cb.swap_poll_min = 100  # hold the swap-in open
+    rid = cb.submit(list(session), max_new_tokens=4)
+    cb.step()
+    assert cb.stats()["swap_queue_depth"] == 1
+    r = cb._restoring[0]
+    assert r.resident and r.restore
+    # Sever the path the way _fail_slot's guard does: drop the
+    # resident parent's subtree (takes the restoring leaf with it).
+    cb._invalidate_and_free(cb._store.unpublish(r.resident[0]))
+    cb.swap_poll_min = 0
+    cb.step()
+    assert cb.stats()["swap_queue_depth"] == 0  # aborted, requeued
+    got = []
+    while cb.pending():
+        for tup in cb.step():
+            if tup[0] == rid:
+                got.append(tup[1])
+    cold = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                             block_size=BS, prefix_cache=False)
+    cr = cold.submit(list(session), max_new_tokens=4)
+    assert got == cold.run_to_completion()[cr]
+
+
+@pytest.mark.slow
+def test_spec_batcher_tier_round_trip(model):
+    """The draft pool's KV demotes and restores alongside the target's
+    (``d_``-prefixed slab twins): a speculative batcher with the tier
+    emits identically to a cold speculative batcher after a
+    demote -> restore cycle."""
+    params, config = model
+    draft_config = get_config(
+        "tiny", **{**CFG, "dim": 32, "n_layers": 1, "n_heads": 2,
+                   "n_kv_heads": 1}
+    )
+    draft_params = init_params(jax.random.PRNGKey(1), draft_config)
+    rng = np.random.RandomState(59)
+    session = rng.randint(1, 128, size=40).tolist()
+
+    def build(**kw):
+        return ContinuousBatcher(
+            params, config, n_slots=1, max_len=128, block_size=BS,
+            draft_params=draft_params, draft_config=draft_config,
+            n_draft=2, **kw,
+        )
+
+    cold = build(prefix_cache=False)
+    cr = cold.submit(list(session), max_new_tokens=8)
+    want = cold.run_to_completion()[cr]
+
+    cb = build(n_blocks=8, prefix_cache=True, host_kv_blocks=4)
+    _seed_and_demote(cb, session, rng)
+    rid = cb.submit(list(session), max_new_tokens=8)
+    got = cb.run_to_completion()[rid]
+    assert got == want
+    assert cb.stats()["swap_ins_total"] == 1
+
+
+def test_metrics_surface(model):
+    """The KV-capacity gauges are in stats() (and therefore in the
+    HTTP /metrics exposition), with prefix_cached_blocks preserved as
+    the pre-radix alias."""
+    params, config = model
+    cb = _tier_batcher(params, config)
+    rng = np.random.RandomState(61)
+    session = rng.randint(1, 128, size=40).tolist()
+    _seed_and_demote(cb, session, rng)
+    rid = cb.submit(list(session), max_new_tokens=4)
+    cb.run_to_completion()
+    stats = cb.stats()
+    for key in (
+        "radix_nodes_total", "prefix_hit_tokens_ratio",
+        "host_kv_blocks", "host_tier_blocks", "swap_queue_depth",
+        "swap_ins_total", "swap_in_blocks_total",
+        "swap_out_blocks_total", "swap_in_ms_total",
+        "swap_failures_total", "prefix_cached_blocks",
+    ):
+        assert key in stats, key
+    assert stats["radix_nodes_total"] > 0
+    assert 0 < stats["prefix_hit_tokens_ratio"] < 1
+    assert stats["host_kv_blocks"] == 4
+    assert stats["swap_queue_depth"] == 0

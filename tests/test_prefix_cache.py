@@ -163,6 +163,10 @@ def test_cancel_sharer_keeps_other_alive(model):
     assert cb.run_to_completion()[rb2] == want
 
 
+# slow (r06 budget rebalance, ~19 s): hit + logprobs parity is also
+# pinned by test_kvcache.py's parity matrix and the multi-chunk
+# suffix shape by test_suffix_admission_buckets below.
+@pytest.mark.slow
 def test_chunked_suffix_and_logprobs(model):
     """A hit whose remaining suffix spans multiple prefill chunks (the
     chunked gathered-view path), with logprobs on: outputs AND per-token
@@ -261,13 +265,15 @@ def test_repeat_same_prompt_exact_with_spec(model):
     assert outs[0][0] == outs[0][1]
 
 
-def test_duplicate_chain_overwrite_leaves_no_unreachable_blocks(model):
+def test_duplicate_chain_leaves_no_unreachable_blocks(model):
     """Two identical prompts in ONE cold admission burst both prefill
-    fully and both register the same chain keys; the second registration
-    supersedes the first.  The superseded blocks must not linger keyed
-    (unreachable for hits yet occupying capacity): everything retained
-    in ``_reusable`` must be the current index target for its key, and
-    free + retained must account for the whole pool."""
+    fully and both publish the same chain keys.  Radix semantics
+    (migrated from the pre-r06 exact-chain supersede pin): the shared
+    prefix is ONE set of nodes by construction — the second
+    publication leaves the existing nodes' blocks in place and its own
+    duplicate copies stay unkeyed, freeing plainly with their slots.
+    Nothing retained may be unreachable, refcounts must not dangle,
+    and free + retained must account for the whole pool."""
     params, config = model
     rng = np.random.RandomState(11)
     prompt = rng.randint(1, 128, size=40).tolist()  # 2 full keyed blocks
@@ -280,22 +286,26 @@ def test_duplicate_chain_overwrite_leaves_no_unreachable_blocks(model):
         res = cb.run_to_completion()
         assert set(res) >= {r1, r2}
         assert res[r1] == res[r2]
-        # No unreachable retained blocks, no dangling refcounts, exact
-        # capacity accounting.
-        assert set(cb._reusable) <= set(cb._prefix_index.values())
-        assert len(cb.free_blocks) + len(cb._reusable) == cb.n_blocks
+        # No dangling refcounts, exact capacity accounting, and the
+        # tree holds exactly the chain's 2 nodes — the duplicate burst
+        # did NOT mint a second copy of the shared prefix.
         assert not cb._block_refs
+        assert (len(cb.free_blocks) + cb._store.cached_blocks()
+                == cb.n_blocks)
+        assert cb.stats()["radix_nodes_total"] == 2
 
-    # Directly exercise the idle-superseded branch: re-keying a chain
-    # whose old block sits refcount-0 in ``_reusable`` frees it outright.
-    key = next(iter(cb._prefix_index))
-    old_blk = cb._prefix_index[key]
-    assert old_blk in cb._reusable
+    # Directly exercise the duplicate-publication branch: publishing a
+    # fresh block for a chain whose node is already resident keeps the
+    # EXISTING node's block; the fresh copy stays unkeyed (it frees
+    # with its slot instead of lingering unreachable).
+    store = cb._store
+    key = next(iter(store._by_key))
+    old_blk = store._by_key[key].block
     new_blk = cb.free_blocks[0]
     cb._register_chain([new_blk], [key])
-    assert old_blk not in cb._reusable
-    assert old_blk in cb.free_blocks
-    assert cb._prefix_index[key] == new_blk
+    assert store._by_key[key].block == old_blk
+    assert not store.is_keyed(new_blk)
+    assert store.is_keyed(old_blk)
 
 
 def test_suffix_admission_buckets_jit_executables(model):
@@ -332,3 +342,73 @@ def test_suffix_admission_buckets_jit_executables(model):
     for extra, want in zip(extras, got):
         rid = cold.submit(base + extra, max_new_tokens=4)
         assert cold.run_to_completion()[rid] == want
+
+
+# NOTE: these run LAST: their admissions compile suffix-insert shapes
+# that would otherwise perturb test_suffix_admission_buckets' compile
+# count (the jit cache is cleared per MODULE, not per test).
+
+def test_exact_mode_supersede_frees_idle_duplicates(model):
+    """The legacy flat-map semantics survive behind
+    ``prefix_index="exact"`` (the behavioral oracle): a duplicate
+    publication SUPERSEDES, and re-keying a chain whose old block sits
+    refcount-0 in the idle LRU frees it outright — the pre-radix pin,
+    verbatim, one flag away."""
+    params, config = model
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(1, 128, size=40).tolist()
+
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=128,
+                           block_size=16, prefix_index="exact")
+    r1 = cb.submit(list(prompt), max_new_tokens=4)
+    r2 = cb.submit(list(prompt), max_new_tokens=4)
+    res = cb.run_to_completion()
+    assert res[r1] == res[r2]
+    store = cb._store
+    assert set(store._reusable) <= set(store._prefix_index.values())
+    assert len(cb.free_blocks) + len(store._reusable) == cb.n_blocks
+    assert not cb._block_refs
+
+    key = next(iter(store._prefix_index))
+    old_blk = store._prefix_index[key]
+    assert old_blk in store._reusable
+    new_blk = cb.free_blocks[0]
+    cb._register_chain([new_blk], [key])
+    assert old_blk not in store._reusable
+    assert old_blk in cb.free_blocks
+    assert store._prefix_index[key] == new_blk
+
+
+def test_radix_partial_prefix_shared_across_divergent_chains(model):
+    """The radix win the flat map could not express as sharing: three
+    chains diverging AFTER a common 2-block prefix share those two
+    NODES (5 nodes total, not 6+), and a fourth request extending the
+    common prefix hits it at full depth — token-identically to cold."""
+    params, config = model
+    rng = np.random.RandomState(13)
+    common = rng.randint(1, 128, size=32).tolist()   # 2 full blocks
+    tails = [rng.randint(1, 128, size=18).tolist() for _ in range(3)]
+
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                           block_size=16, prefix_cache=True)
+    for tail in tails:  # sequential: each publishes its whole chain
+        rid = cb.submit(common + tail, max_new_tokens=4)
+        cb.run_to_completion()
+    st = cb.stats()
+    # chains are keyed on blocks strictly before the last token:
+    # 50 tokens -> 3 keyed blocks each; 2 shared + 3 x 1 divergent.
+    assert st["radix_nodes_total"] == 5
+    # Chains 2 and 3 hit the shared 2-block prefix.
+    assert st["prefix_requests_hit_total"] == 2
+    assert st["prefix_blocks_reused_total"] == 4
+
+    probe = common + [3, 5, 7]
+    rid = cb.submit(list(probe), max_new_tokens=6)
+    got = cb.run_to_completion()[rid]
+    cold = ContinuousBatcher(params, config, n_slots=1, max_len=128,
+                             block_size=16, prefix_cache=False)
+    cr = cold.submit(list(probe), max_new_tokens=6)
+    assert got == cold.run_to_completion()[cr]
+    assert cb.stats()["prefix_hit_tokens_ratio"] > 0
+
+

@@ -660,7 +660,8 @@ def test_http_mixed_concurrent_load(model):
     # free blocks plus prefix-cache-retained ones (r5: completed
     # requests RETAIN their keyed prompt blocks for reuse; retention is
     # capacity, not leakage) — and no occupied slots.
-    assert len(cb.free_blocks) + len(cb._reusable) == total_blocks
+    assert (len(cb.free_blocks) + cb._store.cached_blocks()
+            == total_blocks)
     assert all(s is None for s in cb.slots.values())
     assert not cb._block_refs  # no dangling refcounts
 
