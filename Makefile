@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 faults chaos tpu perf-smoke kvcache
+.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -35,14 +35,26 @@ chaos:
 # owes the same discipline: ZERO decode-chunk stalls while a host-tier
 # swap-in is in flight (every mid-swap dispatch keeps emitting at an
 # un-collapsed K) and a radix/restored admission pays <= 1 state
-# upload — the same budget as a fused admission.  These also run
-# inside tier1; this target is the fast pre-push slice.
+# upload — the same budget as a fused admission.  Observability owes
+# the strictest version: tracing is ALWAYS ON, so the same counters
+# prove it adds zero device dispatches and zero extra host syncs per
+# chunk (every dispatch span in the obs ring maps 1:1 onto a counted
+# dispatch; the 1-fetch/0-upload steady state is unchanged).  These
+# also run inside tier1; this target is the fast pre-push slice.
 perf-smoke:
 	$(PYTEST) tests/test_perf_smoke.py tests/test_serving_chunked.py tests/test_serving_spec.py tests/test_serving_fused.py tests/test_kvcache.py -q -m 'not slow'
 
 # Just the KV-capacity subsystem (radix prefix index + host-DRAM tier).
 kvcache:
 	$(PYTEST) tests/ -q -m kvcache
+
+# Observability layer (obs.py): request span timelines, dispatch
+# spans, latency histograms, SLO accounting, Perfetto trace export,
+# the /metrics registry exposition, and the /debug endpoints — the
+# obs-marked suite plus the whole HTTP server suite (request-id
+# plumbing and exposition live there).
+obs:
+	$(PYTEST) tests/test_obs.py tests/test_server.py -q -m 'not slow'
 
 # On-chip kernel regressions (run on a TPU host; self-skip elsewhere).
 tpu:

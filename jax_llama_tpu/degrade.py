@@ -97,6 +97,9 @@ class DegradeManager:
         window_s: float = 60.0,
         cooldown_s: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
+        on_transition: Optional[
+            Callable[..., None]
+        ] = None,
     ):
         if threshold < 1:
             raise ValueError("quarantine threshold must be >= 1")
@@ -105,9 +108,25 @@ class DegradeManager:
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
         self._lock = threading.Lock()
+        # Observability sink for state EDGES (healthy->quarantined,
+        # quarantined->probing, probing->healthy/quarantined), called
+        # as ``on_transition("quarantine_transition", feature=...,
+        # state=...)`` — the server wires obs.Observability.annotate so
+        # quarantine flips are visible in the serving trace next to
+        # the dispatches that caused them.  Settable after construction
+        # (``mgr.on_transition = ...``); fired OUTSIDE the lock is not
+        # needed — annotate only appends to a bounded deque.
+        self.on_transition = on_transition
+
         self._features: Dict[str, _Feature] = {
             name: _Feature() for name in FEATURES
         }
+
+    def _emit(self, feature: str, state: str) -> None:
+        if self.on_transition is not None:
+            self.on_transition(
+                "quarantine_transition", feature=feature, state=state
+            )
 
     def _get(self, name: str) -> _Feature:
         if name not in self._features:
@@ -134,11 +153,13 @@ class DegradeManager:
                 f.state = QUARANTINED
                 f.quarantined_at = now
                 f.quarantines_total += 1
+                self._emit(name, QUARANTINED)
                 return True
             if f.state == HEALTHY and len(f.failures) >= self.threshold:
                 f.state = QUARANTINED
                 f.quarantined_at = now
                 f.quarantines_total += 1
+                self._emit(name, QUARANTINED)
                 return True
             return False
 
@@ -153,6 +174,7 @@ class DegradeManager:
             f.state = HEALTHY
             f.quarantined_at = None
             f.failures.clear()
+            self._emit(name, HEALTHY)
             return True
 
     def enabled(self, name: str) -> bool:
@@ -178,6 +200,7 @@ class DegradeManager:
             if f.state == QUARANTINED:
                 f.state = PROBING
                 f.probes_total += 1
+                self._emit(name, PROBING)
 
     def degraded(self) -> bool:
         """Any feature currently QUARANTINED (a fallback is serving).

@@ -237,6 +237,18 @@ class FaultInjector:
         self.delays_total = 0
         self.nans_armed_total = 0
         self._nan_armed = False
+        # Observability sink (obs.Observability.annotate — the batcher
+        # wires it when it adopts the injector): every injection /
+        # armed poison / delay lands as an instant event in the serving
+        # trace, so a chaos drill's fault is explainable next to the
+        # dispatch spans it killed.
+        self.trace_sink = None
+
+    def _trace(self, site: str, kind: str, call: int) -> None:
+        if self.trace_sink is not None:
+            self.trace_sink(
+                "fault_injected", site=site, kind=kind, call=call
+            )
 
     def fire(self, site: str) -> None:
         """Hook point: called by the batcher just before the real op."""
@@ -253,6 +265,7 @@ class FaultInjector:
                 continue
             if spec.kind == "delay":
                 self.delays_total += 1
+                self._trace(site, "delay", n)
                 time.sleep(spec.delay_s)
                 continue
             if spec.kind == "nan":
@@ -263,9 +276,11 @@ class FaultInjector:
                 # end-to-end without needing the model to emit NaN.
                 self.nans_armed_total += 1
                 self._nan_armed = True
+                self._trace(site, "nan", n)
                 continue
             self.injected[site] = self.injected.get(site, 0) + 1
             self.injected_total += 1
+            self._trace(site, spec.kind, n)
             if spec.kind == "oom":
                 raise InjectedOOM(
                     f"RESOURCE_EXHAUSTED: injected allocation failure "

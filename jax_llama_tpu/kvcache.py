@@ -199,13 +199,25 @@ class RadixPrefixStore:
     kind = "radix"
     enabled = True
 
-    def __init__(self, host_blocks: int = 0):
+    def __init__(self, host_blocks: int = 0, on_event=None):
         self.root = RadixNode(b"", None, 0)
         self._by_key: Dict[bytes, RadixNode] = {}
         self._by_block: Dict[int, RadixNode] = {}
         # refcount-0 HBM-resident keyed nodes; front = evict first.
         self._idle: "OrderedDict[bytes, RadixNode]" = OrderedDict()
         self.tier = HostTier(host_blocks) if host_blocks > 0 else None
+        # Optional observability sink (obs.Observability.annotate):
+        # tier transitions — demotions, host-LRU drops, completed
+        # restores — land as instant events in the serving trace, so a
+        # /debug/trace window explains WHY a session re-prefilled cold
+        # (its slab was the host tier's LRU victim) without log
+        # archaeology.  Pure host bookkeeping, never on the decode hot
+        # path.
+        self._on_event = on_event
+
+    def _event(self, name: str, **fields) -> None:
+        if self._on_event is not None:
+            self._on_event(name, **fields)
 
     # -- matching / publication --------------------------------------------
 
@@ -337,6 +349,7 @@ class RadixPrefixStore:
             del self._by_block[blk]
             node.block = None
             node.host = slab
+            self._event("kv_demote", block=blk, depth=node.depth)
             extra: List[int] = []
             for ekey in self.tier.put(key, slab):
                 # Host-LRU victim: its node loses the slab; if that
@@ -346,6 +359,7 @@ class RadixPrefixStore:
                 if enode is None:
                     continue
                 enode.host = None
+                self._event("kv_host_evict", depth=enode.depth)
                 if enode.block is None:
                     extra.extend(self._drop_subtree(enode))
             return blk, extra
@@ -391,6 +405,8 @@ class RadixPrefixStore:
             n.restoring = False
             if self.tier is not None:
                 self.tier.drop(n.key)
+        if nodes:
+            self._event("kv_restore_complete", blocks=len(nodes))
 
     # -- observability -------------------------------------------------------
 
@@ -528,18 +544,22 @@ class NullPrefixStore:
         return 0
 
 
-def make_prefix_store(mode: str, host_blocks: int = 0):
+def make_prefix_store(mode: str, host_blocks: int = 0, on_event=None):
     """Store factory.  The host tier only attaches to the radix index
     (``exact`` is the legacy oracle, ``off`` retains nothing — in both
     a nonzero ``host_blocks`` is inert by design: the degradation
     layer's prefix-cache quarantine rebuilds with the cache off and
-    must not trip a constructor error over the tier flag)."""
+    must not trip a constructor error over the tier flag).
+    ``on_event`` (radix only) is an observability sink for tier
+    transitions — the batcher wires ``obs.Observability.annotate`` so
+    demote/host-evict/restore events land in the serving trace."""
     if mode not in PREFIX_INDEX_MODES:
         raise ValueError(
             f"unknown prefix_index mode {mode!r}; have {PREFIX_INDEX_MODES}"
         )
     if mode == "radix":
-        return RadixPrefixStore(host_blocks=host_blocks)
+        return RadixPrefixStore(host_blocks=host_blocks,
+                                on_event=on_event)
     if mode == "exact":
         return ExactPrefixStore()
     return NullPrefixStore()

@@ -1,0 +1,889 @@
+"""Request-timeline tracing, latency histograms, and SLO accounting.
+
+The serving stack's earlier observability was flat counters plus one
+``ttft_ms_ewma`` gauge — enough to graph throughput, useless for
+answering "where did THIS request's 900 ms go?" or "what goodput do we
+hold under a 200 ms TTFT SLO?".  This module is the sensor layer those
+questions (and ROADMAP item 5's online chunk controller) need:
+
+  * **Event timeline** (:class:`Observability`).  Every request owns a
+    bounded span timeline through the admission state machine —
+    ``queued -> prefilling -> restoring -> decoding ->
+    finished/failed/cancelled`` (the PR 5/6 states) — and every jitted
+    serving dispatch gets a span in a bounded ring recording its kind
+    (``decode`` / ``fused`` / ``spec`` / ``insert`` / ``suffix_insert``
+    / ``adopt``), effective K/R, slot occupancy, prompt tokens advanced
+    by a riding prefill lane, packed-fetch wall time, and how many
+    host-tier swap-ins were in flight (the decode/swap overlap, made
+    visible).  Request spans are causally linked to the dispatch spans
+    they rode in (span.dispatches lists dispatch seq numbers), so a
+    timeline answers "which chunk dispatches carried my prefill" and a
+    dispatch answers "whose tokens did I emit".
+  * **Latency histograms** (:class:`Histogram`).  Prometheus cumulative-
+    bucket histograms for TTFT, inter-token latency, queue wait,
+    prefill-chunk latency, swap-in latency, and dispatch wall time —
+    the distributions the flat EWMA hid.  Rendered straight into the
+    ``/metrics`` text exposition (``_bucket``/``_sum``/``_count``).
+  * **SLO accounting**.  With ``slo_ttft_ms`` / ``slo_itl_ms``
+    configured (run.py ``--slo-ttft-ms`` / ``--slo-itl-ms``), every
+    finished request is scored against both deadlines:
+    ``slo_attainment`` gauges (windowed, last 256 requests) and a
+    ``goodput_tokens_total`` counter (tokens from requests that met
+    every configured deadline — the objective an online
+    ``decode_chunk``/``prefill_budget`` controller will maximize).
+    An unconfigured dimension always passes, so with no SLO flags the
+    gauges read 1.0 and goodput equals delivered tokens.
+  * **Metric registry** (:data:`METRICS` / :func:`metric_meta`).  The
+    explicit ``# TYPE`` + ``# HELP`` source for every scalar the
+    ``/metrics`` endpoint exposes — replacing the old ``"total" in k``
+    type heuristic (which already needed a hand-carved
+    ``radix_nodes_total`` exception).
+  * **Trace export**.  :meth:`Observability.trace_json` emits
+    Chrome/Perfetto ``trace_event`` JSON for a recent serving window —
+    dispatch spans on one track, request lifecycles on per-request
+    tracks, fault/quarantine/kv-tier annotations as instant events —
+    loadable in ``chrome://tracing`` or https://ui.perfetto.dev (the
+    server serves it at ``GET /debug/trace``).
+
+Overhead contract: everything here is HOST-side bookkeeping recorded at
+boundaries the serving loop already crosses (admission, the one packed
+fetch per chunk, slot frees).  Recording performs **zero device
+dispatches and zero host syncs** — ``make perf-smoke`` asserts the
+1-fetch / 0-upload steady state is bit-identical with tracing on (it is
+always on; the rings are bounded deques, a few hundred bytes per
+entry).  All methods are thread-safe (one lock; the serving loop
+writes, HTTP handler threads snapshot).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import sys
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .degrade import FEATURES
+from .faults import SITES
+
+# ---------------------------------------------------------------------------
+# Histograms (Prometheus cumulative buckets)
+# ---------------------------------------------------------------------------
+
+# Default latency buckets in MILLISECONDS: sub-ms dispatches through
+# multi-second prefills/swaps.  +Inf is implicit.
+DEFAULT_BUCKETS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+
+class Histogram:
+    """A Prometheus-style cumulative histogram (fixed upper bounds).
+
+    ``observe(v)`` is a bisect + two adds; NOT itself synchronized —
+    every caller inside :class:`Observability` holds the owner's lock,
+    so a concurrent ``/metrics`` render can never see a bucket updated
+    ahead of ``_count``.  ``expose(prefix)`` renders the standard
+    ``_bucket{le=...}`` / ``_sum`` / ``_count`` family with its
+    ``# HELP`` / ``# TYPE`` header.  Bucket counts are stored
+    NON-cumulative and summed at exposition (observe stays O(log B))."""
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS_MS):
+        self.name = name
+        self.help = help_text
+        self.buckets: Tuple[float, ...] = tuple(float(b) for b in buckets)
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(f"histogram buckets must ascend: {buckets}")
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def cumulative(self) -> List[Tuple[str, int]]:
+        """[(le_label, cumulative_count)] including +Inf."""
+        out: List[Tuple[str, int]] = []
+        acc = 0
+        for b, c in zip(self.buckets, self.counts):
+            acc += c
+            out.append((format(b, "g"), acc))
+        out.append(("+Inf", acc + self.counts[-1]))
+        return out
+
+    def expose(self, prefix: str = "") -> List[str]:
+        n = prefix + self.name
+        lines = [f"# HELP {n} {self.help}", f"# TYPE {n} histogram"]
+        for le, c in self.cumulative():
+            lines.append(f'{n}_bucket{{le="{le}"}} {c}')
+        lines.append(f"{n}_sum {round(self.sum, 3)}")
+        lines.append(f"{n}_count {self.count}")
+        return lines
+
+
+# The serving stack's histogram families (name -> help); every
+# Observability owns one of each.  All values are milliseconds.
+HISTOGRAMS = {
+    "ttft_ms": (
+        "Time to first token per delivered request (ms; client-observed, "
+        "crash-recovery replays included)"),
+    "itl_ms": (
+        "Inter-token latency per delivered token after the first (ms; "
+        "tokens inside one fused chunk arrive together, so chunked decode "
+        "shows a mass near 0 plus one chunk-period mode)"),
+    "queue_wait_ms": (
+        "Submit-to-admission wait per request (ms; the queued span)"),
+    "prefill_chunk_ms": (
+        "Wall time of prefill-carrying dispatches (ms: fused prefill "
+        "chunks and classic whole-prompt inserts)"),
+    "swap_in_ms": (
+        "Host-tier swap-in latency per restored admission (ms: staging "
+        "H2D start to pool adoption)"),
+    "dispatch_ms": (
+        "Wall time per jitted serving dispatch incl. its packed fetch "
+        "(ms; one K-iteration or R-round chunk each)"),
+}
+
+
+# ---------------------------------------------------------------------------
+# Metric registry: explicit # TYPE + # HELP for every /metrics scalar
+# ---------------------------------------------------------------------------
+
+def _reg(kind: str, help_text: str) -> Tuple[str, str]:
+    if kind not in ("counter", "gauge"):
+        raise ValueError(kind)
+    return (kind, help_text)
+
+
+METRICS: Dict[str, Tuple[str, str]] = {
+    # -- batcher core -------------------------------------------------------
+    "emitted_tokens_total": _reg("counter", "Tokens emitted to callers"),
+    "decode_steps_total": _reg(
+        "counter", "Decode iterations run (K per chunked dispatch)"),
+    "active_slots": _reg("gauge", "Slots holding a live request"),
+    "queued_requests": _reg("gauge", "Requests waiting for admission"),
+    "free_blocks": _reg("gauge", "Unallocated KV pool blocks"),
+    "total_blocks": _reg("gauge", "KV pool capacity in blocks"),
+    "drafts_proposed_total": _reg(
+        "counter", "Draft tokens proposed (speculative serving)"),
+    "drafts_accepted_total": _reg(
+        "counter", "Draft tokens accepted (speculative serving)"),
+    "draft_acceptance_rate": _reg(
+        "gauge", "Lifetime draft acceptance fraction"),
+    "nonfinite_rows_total": _reg(
+        "counter", "Requests failed by the non-finite logits guard"),
+    # -- prefix cache / KV capacity ----------------------------------------
+    "prefix_cached_blocks": _reg(
+        "gauge", "Idle HBM-resident prefix-cache blocks (pre-radix "
+                 "alias of the store's idle count)"),
+    "prefix_requests_hit_total": _reg(
+        "counter", "Admissions that reused cached prefix blocks"),
+    "prefix_blocks_reused_total": _reg(
+        "counter", "Cached prefix blocks reused by admissions"),
+    "radix_nodes_total": _reg(
+        "gauge", "Keyed blocks in the radix prefix tree (a resident "
+                 "COUNT that shrinks on eviction, not a counter)"),
+    "prefix_hit_tokens_ratio": _reg(
+        "gauge", "Fraction of admitted prompt tokens served from cached "
+                 "prefix blocks"),
+    "host_kv_blocks": _reg("gauge", "Host-DRAM KV tier capacity (blocks)"),
+    "host_tier_blocks": _reg(
+        "gauge", "Blocks currently demoted to the host-DRAM tier"),
+    "swap_queue_depth": _reg("gauge", "Host-tier swap-ins in flight"),
+    "swap_ins_total": _reg("counter", "Host-tier swap-ins started"),
+    "swap_in_blocks_total": _reg(
+        "counter", "Blocks restored from the host tier (H2D)"),
+    "swap_out_blocks_total": _reg(
+        "counter", "Blocks demoted to the host tier (D2H)"),
+    "swap_in_ms_total": _reg(
+        "counter", "Cumulative swap-in wall time (ms)"),
+    "swap_failures_total": _reg(
+        "counter", "Swap-ins failed cleanly (request-scoped)"),
+    # -- chunked decode host boundary --------------------------------------
+    "decode_chunk_size": _reg(
+        "gauge", "Effective K of the most recent chunk dispatch"),
+    "decode_dispatches_total": _reg(
+        "counter", "Jitted decode chunk dispatches"),
+    "host_syncs_total": _reg(
+        "counter", "Device-to-host fetches the serving loop performed"),
+    "state_uploads_total": _reg(
+        "counter", "Host-to-device state-sync dispatches"),
+    "host_syncs_per_token": _reg(
+        "gauge", "Fetches per emitted token (trends to 1/K steady-state)"),
+    # -- speculative serving ------------------------------------------------
+    "spec_rounds_per_dispatch": _reg(
+        "gauge", "Effective R of the most recent speculative dispatch"),
+    "spec_dispatches_total": _reg(
+        "counter", "Jitted speculative dispatches (R rounds each)"),
+    "spec_host_syncs_per_token": _reg(
+        "gauge", "Speculative-path fetches per emitted token"),
+    "spec_window_acceptance_rate": _reg(
+        "gauge", "Draft acceptance over the last 64 spec dispatches"),
+    # -- fused prefill-decode scheduling ------------------------------------
+    "prefill_budget": _reg(
+        "gauge", "Prompt tokens a fused admission advances per dispatch"),
+    "prefill_tokens_inflight": _reg(
+        "gauge", "Prompt tokens of the in-flight admission still to "
+                 "prefill"),
+    "prefill_chunks_total": _reg(
+        "counter", "Chunk dispatches that carried a prefill lane"),
+    "fused_admissions_total": _reg(
+        "counter", "Admissions routed through the fused prefill lane"),
+    "decode_stall_ms_total": _reg(
+        "counter", "Wall time classic whole-prompt admissions stalled "
+                   "decoding rows (ms)"),
+    # -- fault injection -----------------------------------------------------
+    "faults_injected_total": _reg("counter", "Injected faults raised"),
+    "fault_delays_total": _reg("counter", "Injected delays served"),
+    "fault_nans_armed_total": _reg(
+        "counter", "Non-finite poisons armed by the injector"),
+    # -- server layer --------------------------------------------------------
+    "server_recoveries_total": _reg(
+        "counter", "Batcher rebuild+replay crash recoveries"),
+    "watchdog_stalls_total": _reg(
+        "counter", "Serving-loop heartbeat stalls detected"),
+    "watchdog_stalled": _reg("gauge", "Watchdog currently tripped (0/1)"),
+    "watchdog_last_step_age_seconds": _reg(
+        "gauge", "Seconds since the serving loop's last heartbeat"),
+    "quarantine_rebuilds_total": _reg(
+        "counter", "Batcher rebuilds onto a feature fallback"),
+    "probe_rebuilds_total": _reg(
+        "counter", "Batcher rebuilds re-enabling a probed feature"),
+    "nonfinite_requests_failed_total": _reg(
+        "counter", "Requests failed with HTTP 500 by the non-finite "
+                   "guard"),
+    "draining": _reg("gauge", "Server in drain mode (0/1)"),
+    "ttft_ms_ewma": _reg(
+        "gauge", "EWMA time-to-first-token (ms, alpha 0.2; see the "
+                 "ttft_ms histogram for the distribution)"),
+    # -- request outcomes / SLO ---------------------------------------------
+    "requests_finished_total": _reg(
+        "counter", "Requests that delivered a complete generation"),
+    "requests_failed_total": _reg(
+        "counter", "Requests that ended in failure or timeout"),
+    "requests_cancelled_total": _reg(
+        "counter", "Requests cancelled (client disconnect or cancel)"),
+    "slo_ttft_ms": _reg(
+        "gauge", "Configured TTFT SLO deadline (ms; 0 = unset, "
+                 "dimension always passes)"),
+    "slo_itl_ms": _reg(
+        "gauge", "Configured inter-token-latency SLO deadline (ms; "
+                 "0 = unset)"),
+    "requests_slo_ok_total": _reg(
+        "counter", "Finished requests that met every configured SLO"),
+    "goodput_tokens_total": _reg(
+        "counter", "Tokens from requests that met every configured SLO "
+                   "(the controller objective)"),
+    "slo_ttft_attainment": _reg(
+        "gauge", "Fraction of recent requests meeting the TTFT SLO "
+                 "(window 256)"),
+    "slo_itl_attainment": _reg(
+        "gauge", "Fraction of recent requests meeting the ITL SLO "
+                 "(window 256)"),
+    "slo_attainment": _reg(
+        "gauge", "Fraction of recent requests meeting every configured "
+                 "SLO (window 256)"),
+}
+
+# Generated families: per-site injection counters, per-feature
+# degradation state.
+for _site in SITES:
+    METRICS[f"faults_injected_{_site}_total"] = _reg(
+        "counter", f"Injected faults raised at site {_site}")
+for _f in FEATURES:
+    METRICS[f"feature_quarantined_{_f}"] = _reg(
+        "gauge", f"{_f} currently quarantined onto its fallback (0/1)")
+    METRICS[f"feature_failures_{_f}_total"] = _reg(
+        "counter", f"Failures attributed to {_f}")
+    METRICS[f"feature_quarantines_{_f}_total"] = _reg(
+        "counter", f"Times {_f} entered quarantine")
+
+
+def metric_meta(name: str) -> Optional[Tuple[str, str]]:
+    """(type, help) for a scalar metric name (without the ``llm_``
+    prefix), or None for an unregistered name — the exposition then
+    falls back to the legacy heuristic and SAYS SO in the HELP line,
+    which the /metrics parse test treats as a failure."""
+    return METRICS.get(name)
+
+
+# ---------------------------------------------------------------------------
+# Timeline / dispatch records
+# ---------------------------------------------------------------------------
+
+# Request lifecycle states (the PR 5/6 admission state machine) plus
+# terminal outcomes.
+STATES = ("queued", "prefilling", "restoring", "decoding")
+OUTCOMES = ("finished", "failed", "cancelled")
+
+_MAX_SPANS = 64            # per timeline (replays append; bound them)
+_MAX_SPAN_DISPATCHES = 512  # dispatch links per span
+_MAX_RIDS = 8              # batcher incarnations indexed per timeline
+
+
+class _Span:
+    __slots__ = ("state", "t0", "t1", "dispatches", "dropped", "note")
+
+    def __init__(self, state: str, t0: float, note: Optional[str] = None):
+        self.state = state
+        self.t0 = t0
+        self.t1: Optional[float] = None
+        self.dispatches: List[int] = []
+        self.dropped = 0  # dispatch links past _MAX_SPAN_DISPATCHES
+        self.note = note
+
+
+class _Timeline:
+    __slots__ = (
+        "request_id", "rids", "prompt_tokens", "created", "spans",
+        "outcome", "error",
+    )
+
+    def __init__(self, request_id: str, rid: int, prompt_tokens: int,
+                 t: float):
+        self.request_id = request_id
+        self.rids: List[int] = [rid]
+        self.prompt_tokens = prompt_tokens
+        self.created = t
+        self.spans: List[_Span] = []
+        self.outcome: Optional[str] = None
+        self.error: Optional[str] = None
+
+
+class Observability:
+    """The serving stack's shared observability sink (module docstring).
+
+    One instance is shared by a ``ContinuousBatcher`` and its
+    ``LLMServer`` — and survives crash-recovery/quarantine rebuilds the
+    same way the fault injector does (it rides the captured ctor
+    kwargs), so timelines and histograms span batcher incarnations.
+
+    ``ring`` bounds the dispatch ring, ``max_timelines`` the request-
+    timeline LRU, ``max_events`` the annotation ring.  ``clock`` is
+    injectable for tests."""
+
+    def __init__(
+        self,
+        slo_ttft_ms: Optional[float] = None,
+        slo_itl_ms: Optional[float] = None,
+        ring: int = 512,
+        max_timelines: int = 1024,
+        max_events: int = 256,
+        slo_window: int = 256,
+        clock=time.monotonic,
+    ):
+        self.slo_ttft_ms = (
+            float(slo_ttft_ms) if slo_ttft_ms else None
+        )
+        self.slo_itl_ms = float(slo_itl_ms) if slo_itl_ms else None
+        self._clock = clock
+        self.t0 = clock()
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.dispatches: "deque[Dict[str, Any]]" = deque(maxlen=ring)
+        self.events: "deque[Dict[str, Any]]" = deque(maxlen=max_events)
+        self._max_timelines = int(max_timelines)
+        self._timelines: "OrderedDict[str, _Timeline]" = OrderedDict()
+        self._by_rid: Dict[int, _Timeline] = {}
+        self.hist: Dict[str, Histogram] = {
+            name: Histogram(name, help_text)
+            for name, help_text in HISTOGRAMS.items()
+        }
+        # Outcome / SLO accounting.
+        self.requests_finished_total = 0
+        self.requests_failed_total = 0
+        self.requests_cancelled_total = 0
+        self.requests_slo_ok_total = 0
+        self.goodput_tokens_total = 0
+        self._slo_window: "deque[Tuple[bool, bool, bool]]" = deque(
+            maxlen=slo_window
+        )
+
+    # -- internal helpers ---------------------------------------------------
+
+    def _now_ms(self) -> float:
+        return (self._clock() - self.t0) * 1000.0
+
+    def _evict_locked(self) -> None:
+        while len(self._timelines) > self._max_timelines:
+            # Prefer the oldest TERMINAL timeline: evicting a live one
+            # mid-flight would make its later request_end a no-op (the
+            # finished counter undercounts and /debug 404s for a
+            # request still being served) — and the longest-lived
+            # requests are exactly the ones worth debugging.  Only
+            # when every entry is live (a pathological burst) does the
+            # oldest go regardless, keeping the bound hard.
+            key = next(
+                (k for k, tl in self._timelines.items()
+                 if tl.outcome is not None),
+                next(iter(self._timelines)),
+            )
+            tl = self._timelines.pop(key)
+            for rid in tl.rids:
+                if self._by_rid.get(rid) is tl:
+                    del self._by_rid[rid]
+
+    def _current_span(self, tl: _Timeline) -> Optional[_Span]:
+        return tl.spans[-1] if tl.spans else None
+
+    def _begin_span_locked(self, tl: _Timeline, state: str,
+                           note: Optional[str] = None) -> None:
+        t = self._now_ms()
+        cur = self._current_span(tl)
+        if cur is not None and cur.t1 is None:
+            cur.t1 = t
+            if cur.state == "queued" and state in (
+                "prefilling", "restoring"
+            ):
+                self.hist["queue_wait_ms"].observe(t - cur.t0)
+        if len(tl.spans) >= _MAX_SPANS:
+            return
+        tl.spans.append(_Span(state, t, note))
+
+    # -- request lifecycle (called by the batcher / server) -----------------
+
+    def request_queued(self, rid: int, prompt_tokens: int) -> None:
+        """A request entered the batcher queue (``submit``); creates a
+        timeline under the provisional id ``r<rid>`` until the server
+        binds the external one."""
+        with self._lock:
+            tl = _Timeline(f"r{rid}", rid, prompt_tokens, self._clock())
+            self._timelines[tl.request_id] = tl
+            self._by_rid[rid] = tl
+            self._begin_span_locked(tl, "queued")
+            self._evict_locked()
+
+    def bind(self, rid: int, request_id: str,
+             replay: bool = False) -> None:
+        """Attach the server's external request id to ``rid``'s
+        timeline.  On a crash-recovery replay (``replay=True``, passed
+        by the server's rebuild-and-replay path) the external id
+        already owns a timeline: the fresh rid (and its new ``queued``
+        span) folds into it, so ``/debug/requests/<id>`` shows the
+        whole story across batcher incarnations.
+
+        A NON-replay bind that collides with an existing timeline is a
+        client reusing an ``X-Request-Id`` (proxies and retry layers
+        do): the new request keeps its provisional ``r<rid>`` timeline
+        instead of folding — merging two unrelated requests would
+        clobber the live timeline's outcome and grow the merged record
+        without bound on every reuse."""
+        with self._lock:
+            tl_rid = self._by_rid.get(rid)
+            existing = self._timelines.get(request_id)
+            if existing is None:
+                if tl_rid is None:
+                    return
+                self._timelines.pop(tl_rid.request_id, None)
+                tl_rid.request_id = request_id
+                self._timelines[request_id] = tl_rid
+            elif existing is not tl_rid and replay:
+                if tl_rid is not None:
+                    self._timelines.pop(tl_rid.request_id, None)
+                    room = max(0, _MAX_SPANS - len(existing.spans))
+                    for sp in tl_rid.spans[:room]:
+                        sp.note = sp.note or "replay"
+                        existing.spans.append(sp)
+                existing.rids.append(rid)
+                # Bound the per-timeline rid list (and the _by_rid
+                # index entries it keeps alive): only the most recent
+                # incarnations stay addressable by bare rid.
+                while len(existing.rids) > _MAX_RIDS:
+                    old = existing.rids.pop(0)
+                    if self._by_rid.get(old) is existing:
+                        del self._by_rid[old]
+                existing.outcome = None
+                existing.error = None
+                self._by_rid[rid] = existing
+                self._timelines.move_to_end(request_id)
+
+    def begin_span(self, rid: int, state: str,
+                   note: Optional[str] = None) -> None:
+        """Transition ``rid`` into a lifecycle state (ends the current
+        span; queued->prefilling/restoring edges feed the queue-wait
+        histogram)."""
+        with self._lock:
+            tl = self._by_rid.get(rid)
+            if tl is not None:
+                self._begin_span_locked(tl, state, note)
+
+    def request_end(self, rid: int, outcome: str,
+                    error: Optional[str] = None) -> None:
+        """Terminal transition (finished / failed / cancelled)."""
+        with self._lock:
+            tl = self._by_rid.get(rid)
+            if tl is None:
+                return
+            t = self._now_ms()
+            cur = self._current_span(tl)
+            if cur is not None and cur.t1 is None:
+                cur.t1 = t
+            tl.outcome = outcome
+            tl.error = error
+            if outcome == "finished":
+                self.requests_finished_total += 1
+            elif outcome == "cancelled":
+                self.requests_cancelled_total += 1
+            else:
+                self.requests_failed_total += 1
+
+    def request_rejected(self, request_id: str, error: str) -> None:
+        """A request the server answered (504/503) without it ever
+        reaching the batcher — the overload signature: it expired in
+        the server inbox, so no rid exists and ``request_queued`` never
+        fired.  Record a minimal terminal timeline under the external
+        id and count the failure, so ``/debug/requests/<id>`` and
+        ``requests_failed_total`` agree with the error the client saw
+        (without this, attainment drops while the failure counter
+        stays flat — the two overload signals would contradict)."""
+        with self._lock:
+            # The failure COUNTS regardless of id reuse — every 504 the
+            # client saw is a failure, or attainment drops while the
+            # counter stays flat (the divergence this method removes).
+            self.requests_failed_total += 1
+            if request_id in self._timelines:
+                return  # id reuse: keep the existing richer record
+            tl = _Timeline(request_id, rid=-1, prompt_tokens=0,
+                           t=self._clock())
+            tl.rids = []  # no batcher incarnation ever existed
+            t = self._now_ms()
+            sp = _Span("queued", t)
+            sp.t1 = t
+            tl.spans.append(sp)
+            tl.outcome = "failed"
+            tl.error = error
+            self._timelines[request_id] = tl
+            self._evict_locked()
+
+    # -- dispatch spans ------------------------------------------------------
+
+    def record_dispatch(
+        self,
+        kind: str,
+        k: int = 1,
+        occupancy: int = 0,
+        prefill_tokens: int = 0,
+        wall_ms: float = 0.0,
+        fetch_ms: float = 0.0,
+        swap_inflight: int = 0,
+        rids: Sequence[int] = (),
+    ) -> int:
+        """Record one jitted serving dispatch and link it into the
+        CURRENT span of every request that rode it.  Returns the
+        dispatch's ring-global seq number.  ``wall_ms`` covers dispatch
+        submit through the packed fetch (what the host actually waited);
+        ``fetch_ms`` isolates the ``np.asarray`` device sync."""
+        t = self._now_ms()
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            self.dispatches.append({
+                "seq": seq, "kind": kind, "k": int(k),
+                "occupancy": int(occupancy),
+                "prefill_tokens": int(prefill_tokens),
+                "start_ms": round(t - wall_ms, 3),
+                "wall_ms": round(wall_ms, 3),
+                "fetch_ms": round(fetch_ms, 3),
+                "swap_inflight": int(swap_inflight),
+                "rids": list(rids),
+            })
+            self.hist["dispatch_ms"].observe(wall_ms)
+            if prefill_tokens > 0 or kind in ("insert", "suffix_insert"):
+                self.hist["prefill_chunk_ms"].observe(wall_ms)
+            for rid in rids:
+                tl = self._by_rid.get(rid)
+                if tl is None:
+                    continue
+                sp = self._current_span(tl)
+                if sp is None:
+                    continue
+                if len(sp.dispatches) < _MAX_SPAN_DISPATCHES:
+                    sp.dispatches.append(seq)
+                else:
+                    sp.dropped += 1
+            return seq
+
+    def record_swap_in(self, ms: float, blocks: int) -> None:
+        """A host-tier swap-in landed (staging start -> adoption)."""
+        with self._lock:
+            self.hist["swap_in_ms"].observe(ms)
+        self.annotate("kv_swap_in", ms=round(ms, 3), blocks=blocks)
+
+    def annotate(self, name: str, **fields) -> None:
+        """Instant event into the bounded annotation ring (fault
+        injections, quarantine transitions, kv-tier demotions...) —
+        rendered as instant events in the Perfetto export."""
+        with self._lock:
+            self.events.append({
+                "t_ms": round(self._now_ms(), 3), "name": name,
+                "fields": fields,
+            })
+
+    # -- server-side latency / SLO ------------------------------------------
+
+    def observe_ttft(self, ms: float) -> None:
+        # Locked: a concurrent /metrics scrape renders under the lock
+        # and must never see a bucket updated ahead of _count (the
+        # +Inf == _count invariant the parse test asserts).
+        with self._lock:
+            self.hist["ttft_ms"].observe(ms)
+
+    def observe_itl(self, ms: float) -> None:
+        with self._lock:
+            self.hist["itl_ms"].observe(ms)
+
+    def slo_account(
+        self,
+        ttft_ms: Optional[float],
+        max_itl_ms: Optional[float],
+        tokens: int,
+        completed: bool = True,
+    ) -> bool:
+        """Score one finished request against the configured SLOs.
+        ``ttft_ms`` None means no token was ever delivered (fails a
+        configured TTFT SLO); an unconfigured dimension always passes;
+        ``completed=False`` (failure/timeout) can never be goodput.
+        Returns whether the request met every configured deadline."""
+        ttft_ok = self.slo_ttft_ms is None or (
+            ttft_ms is not None and ttft_ms <= self.slo_ttft_ms
+        )
+        itl_ok = self.slo_itl_ms is None or (
+            max_itl_ms is None or max_itl_ms <= self.slo_itl_ms
+        )
+        ok = bool(completed and ttft_ok and itl_ok)
+        with self._lock:
+            self._slo_window.append((ttft_ok and completed,
+                                     itl_ok and completed, ok))
+            if ok:
+                self.requests_slo_ok_total += 1
+                self.goodput_tokens_total += int(tokens)
+        return ok
+
+    # -- exposition -----------------------------------------------------------
+
+    def metrics(self) -> Dict[str, float]:
+        """Scalar gauges/counters for the /metrics exposition (the
+        histograms render separately via ``expose_histograms``)."""
+        with self._lock:
+            n = len(self._slo_window) or 1
+            ttft_ok = sum(1 for a, _, _ in self._slo_window if a)
+            itl_ok = sum(1 for _, b, _ in self._slo_window if b)
+            both = sum(1 for _, _, c in self._slo_window if c)
+            return {
+                "requests_finished_total": self.requests_finished_total,
+                "requests_failed_total": self.requests_failed_total,
+                "requests_cancelled_total": self.requests_cancelled_total,
+                "slo_ttft_ms": self.slo_ttft_ms or 0.0,
+                "slo_itl_ms": self.slo_itl_ms or 0.0,
+                "requests_slo_ok_total": self.requests_slo_ok_total,
+                "goodput_tokens_total": self.goodput_tokens_total,
+                "slo_ttft_attainment": round(ttft_ok / n, 4),
+                "slo_itl_attainment": round(itl_ok / n, 4),
+                "slo_attainment": round(both / n, 4),
+            }
+
+    def expose_histograms(self, prefix: str = "llm_") -> List[str]:
+        with self._lock:
+            lines: List[str] = []
+            for h in self.hist.values():
+                lines.extend(h.expose(prefix))
+            return lines
+
+    # -- debug JSON ------------------------------------------------------------
+
+    def _span_json(self, sp: _Span) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "state": sp.state,
+            "start_ms": round(sp.t0, 3),
+            "end_ms": round(sp.t1, 3) if sp.t1 is not None else None,
+            "duration_ms": (
+                round(sp.t1 - sp.t0, 3) if sp.t1 is not None else None
+            ),
+            "dispatches": list(sp.dispatches),
+        }
+        if sp.dropped:
+            out["dispatches_dropped"] = sp.dropped
+        if sp.note:
+            out["note"] = sp.note
+        return out
+
+    def timeline_json(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """The ``/debug/requests/<id>`` payload: the request's span
+        timeline (accepts the external id, the provisional ``r<rid>``
+        id, or a bare batcher rid)."""
+        with self._lock:
+            tl = self._timelines.get(request_id)
+            if tl is None:
+                tl = self._timelines.get(f"r{request_id}")
+            if tl is None:
+                try:
+                    tl = self._by_rid.get(int(request_id))
+                except ValueError:
+                    tl = None
+            if tl is None:
+                return None
+            seqs = {
+                s for sp in tl.spans for s in sp.dispatches
+            }
+            return {
+                "request_id": tl.request_id,
+                "rids": list(tl.rids),
+                "prompt_tokens": tl.prompt_tokens,
+                "outcome": tl.outcome,
+                "error": tl.error,
+                "spans": [self._span_json(sp) for sp in tl.spans],
+                "dispatch_spans": [
+                    dict(d) for d in self.dispatches if d["seq"] in seqs
+                ],
+            }
+
+    def requests_json(self, n: int = 64) -> Dict[str, Any]:
+        """Index of recent request timelines (most recent last).
+        ``n <= 0`` returns nothing (``[-0:]`` would return the whole
+        store)."""
+        with self._lock:
+            items = list(self._timelines.values())[-n:] if n > 0 else []
+            return {"requests": [
+                {
+                    "request_id": tl.request_id,
+                    "rids": list(tl.rids),
+                    "outcome": tl.outcome,
+                    "states": [sp.state for sp in tl.spans],
+                }
+                for tl in items
+            ]}
+
+    def dispatches_json(self, n: int = 128) -> Dict[str, Any]:
+        with self._lock:
+            items = list(self.dispatches)[-n:] if n > 0 else []
+            return {"dispatches": [dict(d) for d in items]}
+
+    def trace_json(self, window_ms: Optional[float] = None) -> Dict[str, Any]:
+        """Chrome/Perfetto ``trace_event`` JSON for the recent serving
+        window (default: everything the rings still hold).  Dispatches
+        render on pid 1 / tid 1, request lifecycles on one tid per
+        request, annotations as instant events — load the payload in
+        chrome://tracing or https://ui.perfetto.dev."""
+        horizon = None
+        if window_ms is not None:
+            horizon = self._now_ms() - float(window_ms)
+        # Snapshot under the lock, BUILD outside it: constructing tens
+        # of thousands of event dicts while holding the one lock the
+        # serving loop needs per dispatch would inject exactly the
+        # decode-chunk stall this layer exists to measure.  Dispatch
+        # and annotation dicts are created once and never mutated, so
+        # the list copies are reference-shallow; only the mutable
+        # _Span fields are copied out.
+        with self._lock:
+            dispatches = list(self.dispatches)
+            events = list(self.events)
+            now_ms = self._now_ms()
+            timelines = [
+                (tl.request_id, tl.outcome, [
+                    (sp.state, sp.t0, sp.t1, sp.dispatches[:64])
+                    for sp in tl.spans
+                ])
+                for tl in self._timelines.values()
+            ]
+        ev: List[Dict[str, Any]] = [
+            {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+             "args": {"name": "dispatches"}},
+        ]
+        for d in dispatches:
+            if horizon is not None and d["start_ms"] < horizon:
+                continue
+            ev.append({
+                "name": f"{d['kind']} k={d['k']}",
+                "cat": "dispatch", "ph": "X", "pid": 1, "tid": 1,
+                "ts": round(d["start_ms"] * 1000.0, 1),
+                "dur": max(1, round(d["wall_ms"] * 1000.0)),
+                "args": {
+                    k: d[k] for k in (
+                        "seq", "occupancy", "prefill_tokens",
+                        "fetch_ms", "swap_inflight", "rids",
+                    )
+                },
+            })
+        tid = 2
+        for request_id, outcome, spans in timelines:
+            spans = [
+                sp for sp in spans
+                if horizon is None or sp[2] is None or sp[2] >= horizon
+            ]
+            if not spans:
+                continue
+            ev.append({
+                "ph": "M", "pid": 1, "tid": tid,
+                "name": "thread_name",
+                "args": {"name": f"req {request_id}"},
+            })
+            for state, t0, t1, links in spans:
+                if t1 is None:
+                    t1 = now_ms
+                ev.append({
+                    "name": state, "cat": "request", "ph": "X",
+                    "pid": 1, "tid": tid,
+                    "ts": round(t0 * 1000.0, 1),
+                    "dur": max(1, round((t1 - t0) * 1000.0)),
+                    "args": {
+                        "request_id": request_id,
+                        "dispatches": links,
+                        "outcome": outcome,
+                    },
+                })
+            tid += 1
+        for e in events:
+            if horizon is not None and e["t_ms"] < horizon:
+                continue
+            ev.append({
+                "name": e["name"], "cat": "annotation", "ph": "i",
+                "pid": 1, "tid": 1, "s": "g",
+                "ts": round(e["t_ms"] * 1000.0, 1),
+                "args": dict(e["fields"]),
+            })
+        return {"traceEvents": ev, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# Structured logging
+# ---------------------------------------------------------------------------
+
+class StructuredLogger:
+    """One formatter for every server/batcher log line.
+
+    ``json_mode=False`` (default) renders ``ts event k=v ...`` text;
+    ``json_mode=True`` (run.py ``--log-json``) renders one JSON object
+    per line with stable ``event`` / ``request_id`` / ``dispatch_seq``
+    fields, so a fleet's log pipeline can join server lines to
+    ``/debug`` timelines without regexes.  Writes are single ``print``
+    calls (atomic enough under the GIL for line-oriented collectors)."""
+
+    def __init__(self, json_mode: bool = False, stream=None):
+        self.json_mode = bool(json_mode)
+        self.stream = stream if stream is not None else sys.stdout
+
+    def log(self, event: str, message: str = "", **fields) -> None:
+        if self.json_mode:
+            rec: Dict[str, Any] = {
+                "ts": round(time.time(), 3), "event": event,
+            }
+            if message:
+                rec["message"] = message
+            rec.update({k: v for k, v in fields.items() if v is not None})
+            line = json.dumps(rec, default=str)
+        else:
+            parts = [event]
+            if message:
+                parts.append(message)
+            parts.extend(
+                f"{k}={v}" for k, v in fields.items() if v is not None
+            )
+            line = " ".join(parts)
+        print(line, file=self.stream, flush=True)

@@ -172,7 +172,34 @@ def main() -> None:
                          "requests run to completion (new POSTs get "
                          "503 + Retry-After); stragglers past this "
                          "many seconds are failed with 503")
+    ap.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                    help="time-to-first-token SLO deadline in ms for "
+                         "--http: finished requests are scored against "
+                         "it and /metrics exposes attainment gauges "
+                         "(llm_slo_ttft_attainment, window 256) plus "
+                         "llm_goodput_tokens_total — tokens from "
+                         "requests that met EVERY configured deadline.  "
+                         "0 (default) leaves the dimension unset "
+                         "(always passes)")
+    ap.add_argument("--slo-itl-ms", type=float, default=0.0,
+                    help="inter-token-latency SLO deadline in ms for "
+                         "--http: a request passes when its WORST "
+                         "token gap stays under it.  0 (default) "
+                         "leaves the dimension unset")
+    ap.add_argument("--log-json", action="store_true",
+                    help="structured JSON logging: one JSON object per "
+                         "operational log line (event / request_id / "
+                         "feature fields) instead of 'event k=v' text, "
+                         "so a log pipeline joins server lines to the "
+                         "/debug request timelines without regexes")
     args = ap.parse_args()
+    # One formatter for every operational log line this process emits
+    # (obs.StructuredLogger; --log-json flips it to JSON objects).
+    # Generation OUTPUT (the completions themselves) stays on plain
+    # stdout prints — it is the program's product, not its log.
+    from .obs import StructuredLogger
+
+    log = StructuredLogger(json_mode=args.log_json)
     if args.host_kv_blocks > 0 and (
         args.prefix_index != "radix" or args.no_prefix_cache
     ):
@@ -255,10 +282,13 @@ def main() -> None:
 
         if not is_quantized(params):
             params = quantize_params(params, donate=True)
-    print(f"restored {args.ckpt_dir} onto {mesh.shape} in {load_t.elapsed_s:.1f}s")
+    log.log(
+        "checkpoint_restored", ckpt_dir=args.ckpt_dir,
+        mesh=str(dict(mesh.shape)), seconds=round(load_t.elapsed_s, 1),
+    )
 
     if args.http is not None:
-        _serve_http(params, config, tokenizer, mesh, args)
+        _serve_http(params, config, tokenizer, mesh, args, logger=log)
         return
     if args.serve:
         _serve(params, config, tokenizer, mesh, args)
@@ -303,7 +333,8 @@ def _load_draft(args, mesh):
     return draft_params, draft_config
 
 
-def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
+def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None,
+                logger=None):
     """HTTP front-end: LLMServer over the batcher until interrupted.
 
     ``_test_hook(srv)``, when given, runs once the server is up and then
@@ -313,8 +344,14 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
     import os
     import time
 
+    from .obs import Observability, StructuredLogger
     from .server import LLMServer
     from .serving import ContinuousBatcher
+
+    if logger is None:
+        logger = StructuredLogger(
+            json_mode=getattr(args, "log_json", False)
+        )
 
     stops = tuple(
         int(s) for s in getattr(tokenizer, "stop_tokens", [tokenizer.eos_id])
@@ -336,8 +373,17 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         # so a drill can also exercise the first-compile (Mosaic-style)
         # failure mode — the batcher fires the same sites per dispatch.
         install_trace_hook(injector.fire)
-        print(f"fault injection armed: {fault_spec}", flush=True)
+        logger.log("faults_armed", spec=fault_spec)
     draft_params, draft_config = _load_draft(args, mesh)
+    # The observability sink (request timelines, dispatch spans, latency
+    # histograms, SLO scoring) is constructed HERE so the CLI's SLO
+    # deadlines reach it; the batcher adopts it into its captured ctor
+    # kwargs, so crash-recovery/quarantine rebuilds keep one continuous
+    # trace.  0/unset deadlines leave that SLO dimension always-passing.
+    obs = Observability(
+        slo_ttft_ms=getattr(args, "slo_ttft_ms", 0.0) or None,
+        slo_itl_ms=getattr(args, "slo_itl_ms", 0.0) or None,
+    )
     cb = ContinuousBatcher(
         params, config, n_slots=args.slots,
         max_len=config.max_seq_len, stop_tokens=stops,
@@ -353,6 +399,7 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
         prefill_budget=getattr(args, "prefill_budget", 512),
         prefix_index=getattr(args, "prefix_index", "radix"),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
+        obs=obs,
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).
@@ -376,12 +423,17 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
                 args, "quarantine_cooldown_s", 30.0
             ),
             drain_timeout_s=drain_timeout_s,
+            logger=logger,
         ) as srv:
             endpoints = "POST /generate" + (
                 ", /chat" if chat_format is not None else ""
             )
-            print(f"serving on {srv.address} "
-                  f"({endpoints}, GET /metrics, /healthz)", flush=True)
+            logger.log(
+                "serving", address=srv.address,
+                endpoints=(
+                    f"{endpoints}, GET /metrics, /healthz, /debug/*"
+                ),
+            )
             if _test_hook is not None:
                 _test_hook(srv)
                 return
@@ -413,18 +465,18 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None):
                 while not state["signaled"]:
                     time.sleep(0.2)
                 srv.begin_drain()
-                print(
-                    f"\nsignal received: draining (in-flight requests "
-                    f"finish, new requests 503; timeout "
-                    f"{drain_timeout_s:.0f}s)", flush=True,
+                logger.log(
+                    "drain_begin",
+                    "in-flight requests finish, new requests 503",
+                    timeout_s=drain_timeout_s,
                 )
                 if srv.wait_drained(drain_timeout_s + 10):
-                    print("drained; shutting down", flush=True)
+                    logger.log("drained", "shutting down")
                 else:
-                    print("drain timed out; shutting down", flush=True)
+                    logger.log("drain_timeout", "shutting down")
             except KeyboardInterrupt:
                 srv.begin_drain(timeout_s=0.0)
-                print("\nsecond interrupt: hard shutdown", flush=True)
+                logger.log("hard_shutdown", "second interrupt")
             finally:
                 for sig, old in previous:
                     try:

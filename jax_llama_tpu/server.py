@@ -124,6 +124,75 @@ parity.  Design constraints, in order:
       }
     }
 
+Observability (obs.py) schemas
+------------------------------
+
+``/metrics`` histogram families (Prometheus text exposition; every
+scalar metric also carries explicit ``# HELP`` + ``# TYPE`` lines from
+the ``obs.METRICS`` registry — the old ``"total" in name`` type
+heuristic is gone)::
+
+    llm_<family>_bucket{le="<bound>"} N   # cumulative, +Inf last
+    llm_<family>_sum S                    # sum of observed ms
+    llm_<family>_count C                  # == the +Inf bucket
+
+    families: ttft_ms, itl_ms, queue_wait_ms, prefill_chunk_ms,
+              swap_in_ms, dispatch_ms  (all milliseconds)
+
+SLO accounting (run.py ``--slo-ttft-ms`` / ``--slo-itl-ms``; a 0/unset
+dimension always passes): ``llm_slo_ttft_attainment`` /
+``llm_slo_itl_attainment`` / ``llm_slo_attainment`` gauges (fraction of
+the last 256 scored requests meeting each deadline), plus
+``llm_requests_slo_ok_total`` and ``llm_goodput_tokens_total`` (tokens
+from requests that met EVERY configured deadline — the objective the
+ROADMAP-item-5 chunk controller will maximize).
+
+``GET /debug/requests/<id>`` (id = client X-Request-Id / generated hex
+id, the provisional ``r<rid>``, or a bare batcher rid; 404 when
+evicted)::
+
+    {
+      "request_id": str, "rids": [int, ...],   # rid per incarnation
+      "prompt_tokens": int,
+      "outcome": "finished"|"failed"|"cancelled"|null,
+      "error": str|null,
+      "spans": [{"state": "queued"|"prefilling"|"restoring"|"decoding",
+                 "start_ms": float, "end_ms": float|null,
+                 "duration_ms": float|null,
+                 "dispatches": [seq, ...],     # causal links
+                 "note": str}, ...],
+      "dispatch_spans": [<dispatch records the spans link to>]
+    }
+
+``GET /debug/requests?n=64`` lists recent timelines (id, rids, states,
+outcome).  ``GET /debug/dispatches?n=128`` returns the dispatch ring::
+
+    {"dispatches": [{"seq": int,
+                     "kind": "decode"|"fused"|"spec"|"insert"|
+                             "suffix_insert"|"adopt",
+                     "k": int,                 # K iterations / R rounds
+                     "occupancy": int,         # live slots
+                     "prefill_tokens": int,    # prompt tokens advanced
+                     "start_ms": float, "wall_ms": float,
+                     "fetch_ms": float,        # the packed np.asarray
+                     "swap_inflight": int,     # decode/swap overlap
+                     "rids": [int, ...]}, ...]}
+
+``GET /debug/trace[?window_s=S]`` emits Chrome ``trace_event`` JSON
+(``{"traceEvents": [...]}``) — load in chrome://tracing or
+https://ui.perfetto.dev: dispatches on one track, request lifecycles on
+per-request tracks, fault/quarantine/kv-tier annotations as instant
+events.  ``POST /debug/profiler`` ``{"action": "start", "log_dir": D}``
+/ ``{"action": "stop"}`` brackets a ``jax.profiler`` xplane session
+around live traffic (the device-side complement).
+
+Every reply carries the end-to-end request id: blocking bodies and
+error bodies (400/413/500/503/504) as ``"request_id"``, plus an
+``X-Request-Id`` header; each NDJSON stream line carries
+``"request_id"`` too.  Clients may supply their own ``X-Request-Id``
+header (<= 128 chars) — it is honored verbatim, so a failure is
+traceable from the client's logs without a join.
+
 Drain semantics: ``begin_drain()`` (run.py wires it to SIGTERM/SIGINT)
 finishes every in-flight request, answers new POSTs ``503`` with a
 ``Retry-After`` header, and exits the serving loop once idle — bounded
@@ -158,8 +227,14 @@ Endpoints:
                    request is cancelled server-side and (non-stream)
                    answered 504 / (stream) finished with
                    {"done": true, "timeout": true, ...}.
-  GET  /metrics    Prometheus text exposition of ``ContinuousBatcher.stats()``.
+  GET  /metrics    Prometheus text exposition: ``ContinuousBatcher.stats()``
+                   + degradation/server/SLO scalars (# HELP/# TYPE from
+                   the obs.METRICS registry) + the latency histograms.
   GET  /healthz    {"ok": true}
+  GET  /debug/requests[/<id>]   request-timeline JSON (schema above).
+  GET  /debug/dispatches        recent dispatch-span ring.
+  GET  /debug/trace             Chrome/Perfetto trace_event JSON.
+  POST /debug/profiler          jax.profiler session start/stop.
 """
 
 from __future__ import annotations
@@ -172,11 +247,14 @@ import select
 import socket
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, unquote, urlsplit
 
 from .degrade import DegradeManager
+from .obs import Observability, StructuredLogger, metric_meta
 from .serving import ContinuousBatcher, _round_up
 
 # Injection-site -> degradable-feature attribution for dispatch
@@ -251,6 +329,20 @@ class _Pending:
     # this (survives crash-recovery resubmits, so the gauge reflects
     # what the CLIENT waited, recovery included).
     submitted_at: Optional[float] = None
+    # End-to-end request id: the client's X-Request-Id header when
+    # supplied, a generated hex id otherwise.  Echoed in every reply
+    # (blocking body, each stream line, error bodies) and the key of
+    # the request's /debug/requests/<id> timeline — stable across
+    # crash-recovery replays, unlike the batcher rid.
+    ext_id: str = ""
+    # Client-observed latency record for the SLO accounting: TTFT, the
+    # worst inter-token gap, and whether this request was already
+    # scored (each request is scored exactly once, at its terminal
+    # transition).
+    ttft_ms: Optional[float] = None
+    last_tok_t: Optional[float] = None
+    itl_max_ms: Optional[float] = None
+    slo_accounted: bool = False
 
     def fail(self, message: str, code: int) -> None:
         self.error = message
@@ -285,8 +377,15 @@ class LLMServer:
         quarantine_cooldown_s: float = 30.0,
         drain_timeout_s: float = 30.0,
         max_body_bytes: int = 8 << 20,
+        logger: Optional[StructuredLogger] = None,
     ):
         self.batcher = batcher
+        # Structured logging (obs.StructuredLogger; run.py --log-json):
+        # lifecycle events — recoveries, quarantines, per-request
+        # failures — go through one formatter carrying request_id /
+        # feature fields.  None (the default) stays silent, matching
+        # the old print-free server.
+        self.logger = logger
         self.tokenizer = tokenizer
         self.chat_format = chat_format
         self.max_queue = max_queue
@@ -313,6 +412,15 @@ class LLMServer:
             window_s=quarantine_window_s,
             cooldown_s=quarantine_cooldown_s,
         )
+        # Quarantine state EDGES land in the serving trace next to the
+        # dispatches that caused them (degrade.py only counts totals).
+        if self.degrade.on_transition is None:
+            self.degrade.on_transition = self.batcher.obs.annotate
+        # On-demand jax.profiler session (POST /debug/profiler): the
+        # log_dir of the active trace, None when idle; the lock
+        # serializes handler threads racing start/stop.
+        self._profiler_dir: Optional[str] = None
+        self._profiler_lock = threading.Lock()
         self._base_ctor = (
             batcher.params, batcher.config, dict(batcher._ctor_kwargs)
         )
@@ -381,22 +489,80 @@ class LLMServer:
                 )
 
             def do_GET(self):
-                if self.path == "/healthz":
+                parts = urlsplit(self.path)
+                route, query = parts.path, parse_qs(parts.query)
+
+                def qint(name: str, default: int) -> int:
+                    try:
+                        return int(query.get(name, [default])[0])
+                    except ValueError:
+                        return default
+
+                if route == "/healthz":
                     h = server._health()
                     self._reply_json(200 if h["ok"] else 503, h)
-                elif self.path == "/metrics":
+                elif route == "/metrics":
                     self._reply(
                         200, server._metrics_text().encode(),
                         "text/plain; version=0.0.4",
+                    )
+                elif route == "/debug/requests":
+                    self._reply_json(
+                        200, server.obs.requests_json(qint("n", 64))
+                    )
+                elif route.startswith("/debug/requests/"):
+                    rid = unquote(route[len("/debug/requests/"):])
+                    tl = server.obs.timeline_json(rid)
+                    if tl is None:
+                        self._reply_json(
+                            404,
+                            {"error": f"unknown request id {rid!r} "
+                                      "(timeline evicted or never seen)"},
+                        )
+                    else:
+                        self._reply_json(200, tl)
+                elif route == "/debug/dispatches":
+                    self._reply_json(
+                        200, server.obs.dispatches_json(qint("n", 128))
+                    )
+                elif route == "/debug/trace":
+                    window_ms = None
+                    if "window_s" in query:
+                        try:
+                            window_ms = (
+                                float(query["window_s"][0]) * 1000.0
+                            )
+                        except ValueError:
+                            self._reply_json(
+                                400, {"error": "bad window_s"}
+                            )
+                            return
+                    self._reply_json(
+                        200, server.obs.trace_json(window_ms)
                     )
                 else:
                     self._reply_json(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path not in ("/generate", "/chat"):
+                if self.path not in (
+                    "/generate", "/chat", "/debug/profiler"
+                ):
                     self._reply_json(404, {"error": "not found"})
                     return
-                if server._draining.is_set() or server._closed.is_set():
+                # End-to-end request id: honor the client's
+                # X-Request-Id (so a failure is traceable from THEIR
+                # logs), otherwise mint one; echoed in every reply from
+                # here on — including the refusals below.
+                ext_id = (
+                    self.headers.get("X-Request-Id") or ""
+                ).strip()[:128] or uuid.uuid4().hex[:16]
+                # Every refusal below carries the id as a header too —
+                # proxies correlate on headers, not 4xx/5xx bodies.
+                rid_hdr = {"X-Request-Id": ext_id}
+                is_debug = self.path == "/debug/profiler"
+                if not is_debug and (
+                    server._draining.is_set() or server._closed.is_set()
+                ):
                     # Drain mode / shutdown: refuse BEFORE reading the
                     # body, with Retry-After so well-behaved clients back
                     # off until a replacement instance is routable.
@@ -407,9 +573,10 @@ class LLMServer:
                             if server._draining.is_set()
                             and not server._closed.is_set()
                             else "server shutting down"
-                        )},
+                        ), "request_id": ext_id},
                         headers={
-                            "Retry-After": str(server._retry_after_s())
+                            "Retry-After": str(server._retry_after_s()),
+                            **rid_hdr,
                         },
                     )
                     return
@@ -421,7 +588,9 @@ class LLMServer:
                 cl = self.headers.get("Content-Length")
                 if cl is None:
                     self._reply_json(
-                        413, {"error": "Content-Length required"}
+                        413, {"error": "Content-Length required",
+                              "request_id": ext_id},
+                        headers=rid_hdr,
                     )
                     return
                 try:
@@ -430,7 +599,9 @@ class LLMServer:
                         raise ValueError(cl)
                 except ValueError:
                     self._reply_json(
-                        400, {"error": f"bad Content-Length: {cl!r}"}
+                        400, {"error": f"bad Content-Length: {cl!r}",
+                              "request_id": ext_id},
+                        headers=rid_hdr,
                     )
                     return
                 if n > server.max_body_bytes:
@@ -439,13 +610,32 @@ class LLMServer:
                         {"error": (
                             f"request body too large ({n} bytes > "
                             f"{server.max_body_bytes} allowed)"
-                        )},
+                        ), "request_id": ext_id},
+                        headers=rid_hdr,
                     )
                     return
                 try:
                     payload = json.loads(self.rfile.read(n) or b"{}")
                 except (ValueError, json.JSONDecodeError) as e:
-                    self._reply_json(400, {"error": f"bad request: {e}"})
+                    self._reply_json(
+                        400, {"error": f"bad request: {e}",
+                              "request_id": ext_id},
+                        headers=rid_hdr,
+                    )
+                    return
+                if not isinstance(payload, dict):
+                    # A JSON list/string/number parses fine but every
+                    # consumer downstream calls payload.get — refuse
+                    # here, not via an AttributeError traceback that
+                    # closes the socket with no HTTP response.
+                    self._reply_json(
+                        400, {"error": "request body must be a JSON "
+                                       "object", "request_id": ext_id},
+                        headers=rid_hdr,
+                    )
+                    return
+                if is_debug:
+                    self._reply_json(*server._handle_profiler(payload))
                     return
                 # Admission bound: each blocked POST holds an OS thread for
                 # the full generation, so an unbounded inbox is an
@@ -453,13 +643,16 @@ class LLMServer:
                 depth = server._inbox.qsize() + len(server._active)
                 if depth >= server.max_queue:
                     self._reply_json(
-                        503, {"error": "server overloaded; retry later"}
+                        503, {"error": "server overloaded; retry later",
+                              "request_id": ext_id},
+                        headers=rid_hdr,
                     )
                     return
                 pending = _Pending(
                     payload=payload, stream=bool(payload.get("stream")),
                     chat=self.path == "/chat",
                     want_lp=bool(payload.get("logprobs")),
+                    ext_id=ext_id,
                 )
                 timeout_s = payload.get("timeout_s")
                 if timeout_s is not None:
@@ -472,7 +665,9 @@ class LLMServer:
                     except (TypeError, ValueError):
                         self._reply_json(
                             400,
-                            {"error": "timeout_s must be a finite number"},
+                            {"error": "timeout_s must be a finite number",
+                             "request_id": ext_id},
+                            headers=rid_hdr,
                         )
                         return
                     pending.deadline = time.monotonic() + t
@@ -515,25 +710,29 @@ class LLMServer:
                     if self._client_gone():
                         pending.disconnected = True
                         return  # the loop reaps the request
+                rid_hdr = {"X-Request-Id": pending.ext_id}
                 if pending.timed_out:
                     body: Dict[str, Any] = {
                         "error": "generation timed out",
-                        "request_id": pending.request_id,
+                        "request_id": pending.ext_id,
                         "tokens": pending.tokens,
                     }
                     if pending.want_lp:
                         # Partial results keep their logprobs — the
                         # streaming timeout final line already does.
                         body["logprobs"] = pending.lps
-                    self._reply_json(504, body)
+                    self._reply_json(504, body, headers=rid_hdr)
                     return
                 if pending.error is not None:
                     self._reply_json(
-                        pending.error_code, {"error": pending.error}
+                        pending.error_code,
+                        {"error": pending.error,
+                         "request_id": pending.ext_id},
+                        headers=rid_hdr,
                     )
                     return
                 out: Dict[str, Any] = {
-                    "request_id": pending.request_id,
+                    "request_id": pending.ext_id,
                     "tokens": pending.tokens,
                 }
                 if pending.truncated:
@@ -544,7 +743,7 @@ class LLMServer:
                     out["text"] = server.tokenizer.decode(
                         server._visible(pending.tokens, pending)
                     )
-                self._reply_json(200, out)
+                self._reply_json(200, out, headers=rid_hdr)
 
             def _stream_reply(self, pending: "_Pending"):
                 """NDJSON token stream; body is close-delimited (no
@@ -556,6 +755,7 @@ class LLMServer:
                 )
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                self.send_header("X-Request-Id", pending.ext_id)
                 self.end_headers()
 
                 def emit(obj: Dict[str, Any]) -> bool:
@@ -582,7 +782,12 @@ class LLMServer:
                     if ev is _DONE:
                         break
                     tok, lp = ev
-                    line: Dict[str, Any] = {"token": tok}
+                    # Every stream event carries the end-to-end id, so a
+                    # line-oriented log pipeline can attribute a
+                    # mid-stream failure without joining on the socket.
+                    line: Dict[str, Any] = {
+                        "token": tok, "request_id": pending.ext_id,
+                    }
                     if lp is not None:
                         line["logprob"] = lp
                     if server.tokenizer is not None:
@@ -593,7 +798,7 @@ class LLMServer:
                         return  # client gone; the loop reaps the request
                 final: Dict[str, Any] = {
                     "done": True,
-                    "request_id": pending.request_id,
+                    "request_id": pending.ext_id,
                     "tokens": pending.tokens,
                 }
                 if pending.truncated:
@@ -612,6 +817,30 @@ class LLMServer:
         )
 
     # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def obs(self) -> Observability:
+        """The shared observability sink (rides the batcher so it
+        survives quarantine/recovery rebuilds — same lifetime rule as
+        the fault injector)."""
+        return self.batcher.obs
+
+    def _log(self, event: str, message: str = "", **fields) -> None:
+        if self.logger is not None:
+            self.logger.log(event, message, **fields)
+
+    def _slo_finalize(self, p: "_Pending", completed: bool) -> None:
+        """Score one request against the configured SLOs, exactly once,
+        at its terminal transition (finish / fail / timeout).  Client
+        disconnects are NOT scored — the latency a vanished client
+        would have observed is unattributable, and counting aborts as
+        misses would let a flaky client poison the attainment gauges."""
+        if p.slo_accounted:
+            return
+        p.slo_accounted = True
+        self.obs.slo_account(
+            p.ttft_ms, p.itl_max_ms, len(p.tokens), completed=completed
+        )
 
     @property
     def address(self) -> str:
@@ -754,6 +983,11 @@ class LLMServer:
                 kwargs["stop_tokens"] = tuple(int(t) for t in stops)
         rid = self.batcher.submit(tokens, **kwargs)
         p.request_id = rid
+        # The batcher opened the timeline under a provisional r<rid>
+        # key; attach the END-TO-END id so /debug/requests/<ext_id>
+        # resolves (replays re-bind their fresh rid into the same
+        # timeline — see _rebuild_and_replay).
+        self.obs.bind(rid, p.ext_id)
         if p.submitted_at is None:  # replays keep the original stamp
             p.submitted_at = time.monotonic()
         # Snapshot the replay state (crash recovery resubmits from it):
@@ -777,15 +1011,35 @@ class LLMServer:
             expired = p.deadline is not None and now >= p.deadline
             if not (expired or p.disconnected):
                 continue
-            self.batcher.cancel(rid)
+            # Timeouts record as FAILED (the registry counts timeouts
+            # under requests_failed_total); only disconnects and
+            # explicit cancels are "cancelled".
+            self.batcher.cancel(
+                rid,
+                outcome="cancelled" if p.disconnected else "failed",
+                error=None if p.disconnected else "generation timed out",
+            )
             del self._active[rid]
             if p.disconnected:
+                self._log(
+                    "request_disconnected", request_id=p.ext_id, rid=rid
+                )
                 p.finish()  # nobody is reading; just release state
             elif p.stream:
                 p.timed_out = True
+                self._slo_finalize(p, completed=False)
+                self._log(
+                    "request_timeout", request_id=p.ext_id, rid=rid,
+                    tokens=len(p.tokens),
+                )
                 p.finish()
             else:
                 p.timed_out = True
+                self._slo_finalize(p, completed=False)
+                self._log(
+                    "request_timeout", request_id=p.ext_id, rid=rid,
+                    tokens=len(p.tokens),
+                )
                 p.fail("generation timed out", 504)
 
     def _attribute(self, exc: BaseException) -> Optional[str]:
@@ -854,7 +1108,15 @@ class LLMServer:
         if feature is not None:
             if self.degrade.record_failure(feature):
                 self.quarantine_rebuilds_total += 1
+                self._log(
+                    "quarantine", f"{feature} quarantined: {exc!r}",
+                    feature=feature,
+                )
             self.recoveries_total += 1
+            self._log(
+                "crash_recovery", repr(exc), feature=feature,
+                recoveries_total=self.recoveries_total,
+            )
             self._rebuild_and_replay()
             return True
         now = time.monotonic()
@@ -866,6 +1128,10 @@ class LLMServer:
             return False
         self._recovery_times.append(now)
         self.recoveries_total += 1
+        self._log(
+            "crash_recovery", repr(exc),
+            recoveries_total=self.recoveries_total,
+        )
         self._rebuild_and_replay()
         return True
 
@@ -902,6 +1168,12 @@ class LLMServer:
                 remaining = room
                 p.truncated = True
             if remaining <= 0:
+                # The client receives a (truncated) completion: a
+                # TERMINAL delivery — close the timeline and score it,
+                # or the finished counter and /debug disagree with the
+                # 200 the client saw.
+                self.obs.request_end(p.request_id, "finished")
+                self._slo_finalize(p, completed=True)
                 p.finish()  # deliver what the client already has
                 continue
             kwargs = dict(p.submit_kwargs)
@@ -910,9 +1182,16 @@ class LLMServer:
             try:
                 rid = self.batcher.submit(prompt, **kwargs)
             except (ValueError, TypeError) as e:
-                p.fail(f"lost in crash recovery: {e}", 503)
+                msg = f"lost in crash recovery: {e}"
+                self.obs.request_end(p.request_id, "failed", msg)
+                p.fail(msg, 503)
+                self._slo_finalize(p, completed=False)
                 continue
             p.request_id = rid
+            # Fold the replay's fresh rid (and its new queued span) into
+            # the original external-id timeline, so /debug/requests/<id>
+            # shows the whole story across batcher incarnations.
+            self.obs.bind(rid, p.ext_id, replay=True)
             self._active[rid] = p
 
     def _watchdog(self) -> None:
@@ -929,6 +1208,9 @@ class LLMServer:
                 if not self._stalled:
                     self._stalled = True
                     self.watchdog_stalls_total += 1
+                    self._log(
+                        "watchdog_stall", last_step_age_s=round(age, 3)
+                    )
             else:
                 self._stalled = False
 
@@ -975,6 +1257,60 @@ class LLMServer:
             "features": features,
         }
 
+    def _handle_profiler(self, payload: Dict[str, Any]):
+        """POST /debug/profiler — an on-demand ``jax.profiler`` session
+        (the ``utils/profiling.trace`` context manager unrolled into two
+        HTTP calls so it can bracket LIVE traffic):
+        ``{"action": "start", "log_dir": DIR}`` begins an xplane trace,
+        ``{"action": "stop"}`` ends it.  The resulting trace (view with
+        TensorBoard's profile plugin / XProf) is the device-side
+        complement of the host-side ``/debug/trace`` window.  Returns
+        ``(status_code, body)`` for the handler's ``_reply_json``."""
+        action = payload.get("action")
+        if action == "start":
+            log_dir = payload.get("log_dir")
+            if not isinstance(log_dir, str) or not log_dir:
+                return 400, {"error": 'start needs a "log_dir" string'}
+            # Serialized: two concurrent starts racing the None check
+            # would both reach jax.profiler (handler threads).
+            with self._profiler_lock:
+                if self._profiler_dir is not None:
+                    return 409, {"error": (
+                        f"profiler already tracing into "
+                        f"{self._profiler_dir!r}; stop it first"
+                    )}
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(log_dir)
+                except Exception as e:  # surface, never crash the server
+                    return 500, {"error": f"profiler start failed: {e}"}
+                self._profiler_dir = log_dir
+            self.obs.annotate("profiler_start", log_dir=log_dir)
+            self._log("profiler_start", log_dir=log_dir)
+            return 200, {"ok": True, "log_dir": log_dir}
+        if action == "stop":
+            with self._profiler_lock:
+                if self._profiler_dir is None:
+                    return 409, {"error": "no profiler session active"}
+                log_dir = self._profiler_dir
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    # _profiler_dir is NOT cleared on failure: jax's
+                    # session may still be live, and clearing would
+                    # make both retry-stop (409) and restart (500)
+                    # dead ends — unrecoverable without a process
+                    # restart.  Keeping it lets the client retry stop.
+                    return 500, {"error": f"profiler stop failed: {e}"}
+                self._profiler_dir = None
+            self.obs.annotate("profiler_stop", log_dir=log_dir)
+            self._log("profiler_stop", log_dir=log_dir)
+            return 200, {"ok": True, "log_dir": log_dir}
+        return 400, {"error": 'action must be "start" or "stop"'}
+
     def _loop(self) -> None:
         # The finally-drain guarantees no client blocks forever: whether
         # the loop exits via stop() or an unexpected device/runtime error,
@@ -1018,6 +1354,7 @@ class LLMServer:
                     for f in due:
                         self.degrade.start_probe(f)
                     self.probe_rebuilds_total += 1
+                    self._log("probe_rebuild", features=",".join(due))
                     self._rebuild_and_replay()
                 # Admit whatever is waiting; block briefly when fully idle
                 # so shutdown and new work are both responsive.
@@ -1032,14 +1369,36 @@ class LLMServer:
                         if p.deadline is not None and (
                             time.monotonic() >= p.deadline
                         ):
+                            # Expired while waiting in the inbox — the
+                            # overload signature.  These worst-latency
+                            # requests MUST hit the SLO window, or
+                            # attainment reads healthy exactly when the
+                            # server is drowning; and they get a
+                            # terminal timeline + failed count even
+                            # though no batcher rid ever existed, so
+                            # /debug/requests/<id> explains the 504.
                             p.timed_out = True
+                            self._slo_finalize(p, completed=False)
+                            self.obs.request_rejected(
+                                p.ext_id,
+                                "generation timed out before admission "
+                                "(server overloaded)",
+                            )
+                            self._log(
+                                "request_timeout", "expired pre-admission",
+                                request_id=p.ext_id,
+                            )
                             p.fail("generation timed out", 504)
                             continue
                         try:
                             self._submit(p)
                         except (ValueError, TypeError, KeyError) as e:
                             # Malformed payloads must never kill the
-                            # device-owning thread.
+                            # device-owning thread.  Deliberately NOT
+                            # SLO-scored: a 400 is the client's defect,
+                            # and letting bad payloads drag attainment
+                            # would let one misconfigured client page
+                            # the on-call for a healthy server.
                             p.fail(str(e), 400)
                 except queue.Empty:
                     pass
@@ -1076,7 +1435,13 @@ class LLMServer:
                     p = self._active.pop(rid, None)
                     if p is not None:
                         self.nonfinite_failed_total += 1
+                        self._slo_finalize(p, completed=False)
+                        self._log(
+                            "request_failed", msg,
+                            request_id=p.ext_id, rid=rid,
+                        )
                         p.fail(msg, 500)
+                now = time.monotonic()
                 for ev in events:
                     rid, tok, done = ev[0], ev[1], ev[2]
                     lp = ev[3] if len(ev) > 3 else None
@@ -1084,20 +1449,33 @@ class LLMServer:
                     if p is None:
                         continue
                     p.tokens.append(tok)
-                    if len(p.tokens) == 1 and p.submitted_at is not None:
-                        ttft_ms = (
-                            time.monotonic() - p.submitted_at
-                        ) * 1000.0
-                        self.ttft_ms_ewma = (
-                            ttft_ms if self.ttft_ms_ewma is None
-                            else 0.8 * self.ttft_ms_ewma + 0.2 * ttft_ms
-                        )
+                    if len(p.tokens) == 1:
+                        if p.submitted_at is not None:
+                            ttft_ms = (now - p.submitted_at) * 1000.0
+                            p.ttft_ms = ttft_ms
+                            self.obs.observe_ttft(ttft_ms)
+                            self.ttft_ms_ewma = (
+                                ttft_ms if self.ttft_ms_ewma is None
+                                else 0.8 * self.ttft_ms_ewma
+                                + 0.2 * ttft_ms
+                            )
+                    elif p.last_tok_t is not None:
+                        # Tokens inside one fused chunk arrive together
+                        # (gap ~0); the chunk-period gap lands on the
+                        # chunk's first token.  Both are real client-
+                        # observed inter-token latencies.
+                        itl_ms = (now - p.last_tok_t) * 1000.0
+                        self.obs.observe_itl(itl_ms)
+                        if p.itl_max_ms is None or itl_ms > p.itl_max_ms:
+                            p.itl_max_ms = itl_ms
+                    p.last_tok_t = now
                     if p.want_lp and lp is not None:
                         p.lps.append(lp)
                     if p.stream:
                         p.chunks.put((tok, lp if p.want_lp else None))
                     if done:
                         del self._active[rid]
+                        self._slo_finalize(p, completed=True)
                         p.finish()
         except Exception as e:  # device/runtime failure: fail loudly
             reason = f"serving loop crashed: {e!r}"
@@ -1105,6 +1483,7 @@ class LLMServer:
         finally:
             self._closed.set()
             for p in list(self._active.values()):
+                self._slo_finalize(p, completed=False)
                 p.fail(reason, code)
             self._active.clear()
             while not self._inbox.empty():
@@ -1116,6 +1495,7 @@ class LLMServer:
     def _metrics_text(self) -> str:
         stats = dict(self.batcher.stats())
         stats.update(self.degrade.stats())
+        stats.update(self.obs.metrics())
         stats.update({
             # Server-level fault tolerance (batcher counters above carry
             # the injection-site totals when an injector is attached).
@@ -1138,15 +1518,21 @@ class LLMServer:
         lines = []
         for k, v in stats.items():
             name = f"llm_{k}"
-            # "_total" names a counter by convention — except
-            # radix_nodes_total, a resident-node COUNT that shrinks on
-            # eviction/unpublish; typing it counter would make
-            # Prometheus read every shrink as a reset (rate() spikes).
-            kind = (
-                "gauge"
-                if "total" not in k or k == "radix_nodes_total"
-                else "counter"
-            )
+            meta = metric_meta(k)
+            if meta is None:
+                # Legacy fallback for a scalar nobody registered: the
+                # old "_total names a counter" convention, with a HELP
+                # line that SAYS the registration is missing — the
+                # /metrics parse test (tests/test_server.py) fails on
+                # it, so an unregistered metric cannot ship silently.
+                kind = "gauge" if "total" not in k else "counter"
+                help_text = "UNREGISTERED metric (add to obs.METRICS)"
+            else:
+                kind, help_text = meta
+            lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} {kind}")
             lines.append(f"{name} {v}")
+        # Histogram families (ttft/itl/queue-wait/prefill/swap/dispatch)
+        # render their own HELP/TYPE + _bucket/_sum/_count series.
+        lines.extend(self.obs.expose_histograms("llm_"))
         return "\n".join(lines) + "\n"

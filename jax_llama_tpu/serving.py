@@ -172,6 +172,7 @@ from .kvcache import (
     restore_ready,
     stage_restore,
 )
+from .obs import Observability
 from .models.llama import (
     FLASH_MIN_SEQ,
     KVCache,
@@ -1677,6 +1678,7 @@ class ContinuousBatcher:
         prefill_budget: int = 0,
         prefix_index: str = "radix",
         host_kv_blocks: int = 0,
+        obs: Optional[Observability] = None,
     ):
         # Raw construction arguments, captured before any derivation so
         # ``rebuild()`` (crash recovery) reproduces this batcher exactly
@@ -1693,9 +1695,25 @@ class ContinuousBatcher:
             prefix_cache=prefix_cache, fault_injector=fault_injector,
             decode_chunk=decode_chunk, spec_rounds=spec_rounds,
             prefill_budget=prefill_budget, prefix_index=prefix_index,
-            host_kv_blocks=host_kv_blocks,
+            host_kv_blocks=host_kv_blocks, obs=obs,
         )
+        # Observability sink (obs.py): request span timelines, dispatch
+        # spans, latency histograms, SLO accounting.  Always on — pure
+        # host-side bookkeeping at boundaries the loop already crosses,
+        # zero device dispatches / host syncs of its own (asserted by
+        # make perf-smoke).  Shared across rebuilds like the injector:
+        # the created instance replaces the ctor arg in _ctor_kwargs so
+        # crash recovery keeps one continuous trace.
+        self.obs = obs if obs is not None else Observability()
+        self._ctor_kwargs["obs"] = self.obs
         self.fault_injector = fault_injector
+        if fault_injector is not None and getattr(
+            fault_injector, "trace_sink", None
+        ) is None:
+            # Injections land in the trace's annotation ring, so a
+            # chaos drill's fault is visible next to the dispatch spans
+            # it killed.
+            fault_injector.trace_sink = self.obs.annotate
         if config.attn_impl not in ("xla", "auto"):
             raise ValueError(
                 "continuous batching requires attn_impl 'xla' or 'auto' "
@@ -1792,7 +1810,8 @@ class ContinuousBatcher:
         self.host_kv_blocks = max(0, int(host_kv_blocks))
         self.prefix_cache_enabled = prefix_index != "off"
         self._store = make_prefix_store(
-            prefix_index, host_blocks=self.host_kv_blocks
+            prefix_index, host_blocks=self.host_kv_blocks,
+            on_event=self.obs.annotate,
         )
         self._block_refs: Dict[int, int] = {}    # block -> active users
         # In-flight swap-ins (the ``restoring`` admission state) and
@@ -2026,6 +2045,7 @@ class ContinuousBatcher:
         self._invalidate_and_free(stranded)
         self.failed.append((slot.request_id, message))
         self.nonfinite_rows_total += 1
+        self.obs.request_end(slot.request_id, "failed", message)
         self._free_slot(b, device_done=device_done)
 
     def submit(
@@ -2094,6 +2114,7 @@ class ContinuousBatcher:
         # a burst of submits is admitted as ONE batched prefill dispatch
         # instead of k serialized ones.
         self.queue.append(req)
+        self.obs.request_queued(rid, len(req.tokens))
         return rid
 
     def pending(self) -> bool:
@@ -2104,10 +2125,17 @@ class ContinuousBatcher:
             or any(s is not None for s in self.slots.values())
         )
 
-    def cancel(self, request_id: int) -> bool:
+    def cancel(self, request_id: int, outcome: str = "cancelled",
+               error: Optional[str] = None) -> bool:
         """Abort a request: dequeue it, or free its slot and blocks
         mid-generation.  Returns False if the id is unknown (already
         finished or never submitted).
+
+        ``outcome`` names the terminal state the request's timeline
+        records — "cancelled" (default; client disconnects and explicit
+        cancels) or "failed" (the server's deadline reaper passes it
+        for timeouts, which the metric registry counts as failures,
+        never cancellations).
 
         Like every batcher method, this must be called from the thread
         that owns the batcher (the serving loop); the HTTP server's
@@ -2117,6 +2145,7 @@ class ContinuousBatcher:
         for i, req in enumerate(self.queue):
             if req.rid == request_id:
                 del self.queue[i]
+                self.obs.request_end(request_id, outcome, error)
                 return True
         for r in self._restoring:
             if r.req.rid == request_id:
@@ -2126,6 +2155,7 @@ class ContinuousBatcher:
                 # the nodes fall back to host residency (slab intact).
                 self._restoring.remove(r)
                 self._abort_restore(r)
+                self.obs.request_end(request_id, outcome, error)
                 return True
         for i, (req, chain, hits) in enumerate(self._restored_ready):
             if req.rid == request_id:
@@ -2133,10 +2163,12 @@ class ContinuousBatcher:
                 # claimed — unclaim them back into the idle LRU.
                 del self._restored_ready[i]
                 self._unclaim_blocks(hits)
+                self.obs.request_end(request_id, outcome, error)
                 return True
         for b, slot in self.slots.items():
             if slot is not None and slot.request_id == request_id:
                 self._free_slot(b)
+                self.obs.request_end(request_id, outcome, error)
                 return True
         return False
 
@@ -2444,6 +2476,17 @@ class ContinuousBatcher:
         self.steps_total += K
         self.decode_dispatches_total += 1
         self.decode_chunk_last = K
+        # Dispatch-span bookkeeping (obs.py): capture the riding rids,
+        # prompt tokens this dispatch will advance, and the wall clock
+        # BEFORE the dispatch — recorded after the packed fetch, so the
+        # span covers submit through sync (pure host bookkeeping; the
+        # 1-fetch/0-upload contract is unchanged).
+        t0_obs = time.monotonic()
+        obs_rids = [
+            s.request_id for s in self.slots.values() if s is not None
+        ]
+        pf_adv = 0 if pf is None else min(pf.chunk, pf.remaining_tokens)
+        pf_done_rid: Optional[int] = None
         all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
         if pf is None:
             (packed, self.tau, self.d_tau_lp, self.d_fill, self.d_pos,
@@ -2495,11 +2538,25 @@ class ContinuousBatcher:
                     slot.blocks[pf.n_share: len(pf.chain)],
                     pf.chain[pf.n_share:],
                 )
+                pf_done_rid = pf.req.rid
                 self._pf = None
         # THE one device->host sync of the chunk: tokens (+ bitcast
         # logprobs) in a single packed array.
+        tf_obs = time.monotonic()
         arr = np.asarray(packed)
         self.host_syncs_total += 1
+        now_obs = time.monotonic()
+        self.obs.record_dispatch(
+            kind="decode" if pf_adv == 0 else "fused",
+            k=K, occupancy=len(obs_rids), prefill_tokens=pf_adv,
+            wall_ms=(now_obs - t0_obs) * 1000.0,
+            fetch_ms=(now_obs - tf_obs) * 1000.0,
+            swap_inflight=len(self._restoring), rids=obs_rids,
+        )
+        if pf_done_rid is not None:
+            # The prefill's last chunk linked into the prefilling span
+            # above; the first token it sampled opens the decoding span.
+            self.obs.begin_span(pf_done_rid, "decoding")
         toks = arr[0]
         lps = arr[1].view(np.float32) if self.logprobs else None
 
@@ -2547,6 +2604,7 @@ class ContinuousBatcher:
                     # The device made the same call mid-chunk (stop set
                     # and budget live on device), so the row is already
                     # inactive there — no deactivation upload needed.
+                    self.obs.request_end(slot.request_id, "finished")
                     self._free_slot(b, device_done=True)
                     ended = True
                     break
@@ -2605,6 +2663,7 @@ class ContinuousBatcher:
             else:
                 out.append((slot.request_id, tok, done))
             if done:
+                self.obs.request_end(slot.request_id, "finished")
                 self._free_slot(b, device_done=True)
 
         if any(s is not None for s in self.slots.values()):
@@ -2674,6 +2733,10 @@ class ContinuousBatcher:
         self.spec_dispatches_total += 1
         self.decode_chunk_last = R
         self.spec_rounds_last = R
+        t0_obs = time.monotonic()
+        obs_rids = [
+            s.request_id for s in self.slots.values() if s is not None
+        ]
         all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
         (packed, self.tau, self.d_tau_lp, self.d_fill, self.d_pos,
          self.d_active, self.d_remaining, self.keys, self.pool,
@@ -2690,9 +2753,17 @@ class ContinuousBatcher:
         )
         # THE one device->host sync of the chunk: tokens, acceptance
         # counts and (bitcast) logprobs in a single packed array.
+        tf_obs = time.monotonic()
         arr = np.asarray(packed)  # [B, R, W]
         self.host_syncs_total += 1
         self.spec_host_syncs_total += 1
+        now_obs = time.monotonic()
+        self.obs.record_dispatch(
+            kind="spec", k=R, occupancy=len(obs_rids),
+            wall_ms=(now_obs - t0_obs) * 1000.0,
+            fetch_ms=(now_obs - tf_obs) * 1000.0,
+            swap_inflight=len(self._restoring), rids=obs_rids,
+        )
         G = self.n_draft
         toks = arr[:, :, : G + 1]
         accs = arr[:, :, G + 1]
@@ -2745,6 +2816,7 @@ class ContinuousBatcher:
                     # The device made the same call before running the
                     # round (stop set and budget live on device), so
                     # the row is already inactive there.
+                    self.obs.request_end(slot.request_id, "finished")
                     self._free_slot(b, device_done=True)
                     ended = True
                     break
@@ -2789,6 +2861,9 @@ class ContinuousBatcher:
                     else:
                         out.append((slot.request_id, tok, done))
                     if done:
+                        self.obs.request_end(
+                            slot.request_id, "finished"
+                        )
                         self._free_slot(b, device_done=True)
                         ended = True
                         break
@@ -2821,6 +2896,10 @@ class ContinuousBatcher:
         """Speculative remainder of a step: draft + verify, emit the
         accepted prefix (appended to ``out``, with per-token logprobs
         when ``logprobs=True``), rewind fills past rejected slots."""
+        t0_obs = time.monotonic()
+        obs_rids = [
+            s.request_id for s in self.slots.values() if s is not None
+        ]
         all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
         outs, acc, lps, self.keys, self.pool, self.draft_pool = _spec_round(
             self.params, self.draft_params, self.pool, self.draft_pool,
@@ -2834,6 +2913,7 @@ class ContinuousBatcher:
             use_kernel=self._spec_kernel_ok(), mesh=self.mesh,
             with_logprobs=self.logprobs,
         )
+        tf_obs = time.monotonic()
         outs = np.asarray(outs)
         acc = np.asarray(acc)
         self.host_syncs_total += 2
@@ -2842,6 +2922,13 @@ class ContinuousBatcher:
             lps = np.asarray(lps)
             self.host_syncs_total += 1
             self.spec_host_syncs_total += 1
+        now_obs = time.monotonic()
+        self.obs.record_dispatch(
+            kind="spec", k=1, occupancy=len(obs_rids),
+            wall_ms=(now_obs - t0_obs) * 1000.0,
+            fetch_ms=(now_obs - tf_obs) * 1000.0,
+            swap_inflight=len(self._restoring), rids=obs_rids,
+        )
         round_proposed = round_accepted = 0
         # NOTE: the per-row fill/pos advances below touch the numpy
         # mirrors only — the CLASSIC (spec_rounds=1) path re-uploads
@@ -2885,6 +2972,7 @@ class ContinuousBatcher:
                 if done:
                     break
             if done:
+                self.obs.request_end(slot.request_id, "finished")
                 self._free_slot(b)
             else:
                 new_tau[b] = outs[b, a]
@@ -3257,6 +3345,9 @@ class ContinuousBatcher:
         # suffix chunk.  Claiming flash would fire the wrong fault site
         # and, worse, credit a probing flash kernel with a success it
         # never executed.
+        for req, _, _ in grp:
+            self.obs.begin_span(req.rid, "prefilling")
+        t0_obs = time.monotonic()
         self._record_dispatch(["prefix_cache"])
         self._fault("suffix_insert")
         self._admit_dispatches += 1
@@ -3284,6 +3375,19 @@ class ContinuousBatcher:
                 config=self.draft_config,
                 prefill_chunk=self.prefill_chunk, mesh=self.mesh,
             )
+        # Dispatch span (async submit — wall covers dispatch time only,
+        # the suffix path's known undercount); linked into each
+        # request's prefilling span, which then closes into decoding.
+        self.obs.record_dispatch(
+            kind="suffix_insert", k=k,
+            occupancy=sum(s is not None for s in self.slots.values()),
+            prefill_tokens=sum(
+                len(r.tokens) - len(h) * bs for r, _, h in grp
+            ),
+            wall_ms=(time.monotonic() - t0_obs) * 1000.0,
+            swap_inflight=len(self._restoring),
+            rids=[r.rid for r, _, _ in grp],
+        )
         idx = jnp.asarray(np.asarray(slots, np.int32))
         self.tau = self.tau.at[idx].set(tau[:k])
         if self.logprobs:
@@ -3324,6 +3428,7 @@ class ContinuousBatcher:
             self.prefix_blocks_reused += n_share
             self.prompt_tokens_total += len(req.tokens)
             self.prefix_hit_tokens_total += n_share * bs
+            self.obs.begin_span(req.rid, "decoding")
 
     def _fused_scheduling(self) -> bool:
         """Fused prefill-decode scheduling is in force for this batcher
@@ -3396,12 +3501,13 @@ class ContinuousBatcher:
             self._unclaim_blocks(resident)
             if fresh:
                 self._invalidate_and_free(fresh)
-            self.failed.append((
-                req.rid,
+            msg = (
                 f"kv swap-in failed: {e} (request aborted; host-tier "
-                f"blocks unpinned, server healthy)",
-            ))
+                f"blocks unpinned, server healthy)"
+            )
+            self.failed.append((req.rid, msg))
             self.swap_failures_total += 1
+            self.obs.request_end(req.rid, "failed", msg)
             return False
         self._claim_blocks(fresh)
         self._restoring.append(_Restore(
@@ -3410,6 +3516,7 @@ class ContinuousBatcher:
             staged=staged, t0=time.monotonic(),
         ))
         self.swap_ins_total += 1
+        self.obs.begin_span(req.rid, "restoring")
         return True
 
     def _abort_restore(self, r: "_Restore") -> None:
@@ -3454,6 +3561,9 @@ class ContinuousBatcher:
                 self._restoring.remove(r)
                 self._abort_restore(r)
                 self.queue.insert(0, r.req)
+                self.obs.begin_span(
+                    r.req.rid, "queued", note="swap aborted"
+                )
                 continue
             ready = restore_ready(r.staged)
             if not ready and idle:
@@ -3461,18 +3571,38 @@ class ContinuousBatcher:
                 ready = True
             if not ready or r.polls <= self.swap_poll_min:
                 continue
+            t_adopt = time.monotonic()
             self.pool = adopt_into_pool(self.pool, r.staged)
             if self.spec:
                 self.draft_pool = adopt_into_pool(
                     self.draft_pool, r.staged, prefix="d_"
                 )
+            adopt_ms = (time.monotonic() - t_adopt) * 1000.0
             self._store.complete_restore(r.restore, r.fresh)
             self.swap_in_blocks_total += len(r.fresh)
-            self.swap_in_ms_total += (time.monotonic() - r.t0) * 1000.0
+            swap_ms = (time.monotonic() - r.t0) * 1000.0
+            self.swap_in_ms_total += swap_ms
             self._restoring.remove(r)
             self._restored_ready.append(
                 (r.req, r.chain, [n.block for n in r.path])
             )
+            # The adoption scatter is a real device dispatch: span it
+            # (linked into the request's restoring span) and feed the
+            # swap-in histogram.  wall covers the async submit only
+            # (blocking on the scatter here would ADD the host sync
+            # the overlap design exists to avoid — the suffix path's
+            # documented undercount applies).
+            self.obs.record_swap_in(swap_ms, len(r.fresh))
+            self.obs.record_dispatch(
+                kind="adopt", k=len(r.fresh),
+                occupancy=sum(
+                    s is not None for s in self.slots.values()
+                ),
+                wall_ms=adopt_ms,
+                swap_inflight=len(self._restoring),
+                rids=(r.req.rid,),
+            )
+            self.obs.begin_span(r.req.rid, "queued", note="restored")
 
     def _admit_restored_ready(self) -> None:
         """Admit completed swap-ins as plain prefix hits (their path
@@ -3617,6 +3747,7 @@ class ContinuousBatcher:
         )
         self.fused_admissions_total += 1
         self.prompt_tokens_total += len(req.tokens)
+        self.obs.begin_span(req.rid, "prefilling")
         if n_share:
             self.prefix_requests_hit += 1
             self.prefix_blocks_reused += n_share
@@ -3775,6 +3906,9 @@ class ContinuousBatcher:
                 self.config.attn_impl in ("auto", "flash")
                 and chunk > FLASH_MIN_SEQ
             )
+            for req in batch:
+                self.obs.begin_span(req.rid, "prefilling")
+            t0_obs = time.monotonic()
             self._record_dispatch(
                 ["flash_attention"] if flash else []
             )
@@ -3814,7 +3948,24 @@ class ContinuousBatcher:
                         np.asarray(tau_lps)[:k]
                     )
             self.keys = self.keys.at[idx].set(keys_out[:k])
+            tf_obs = time.monotonic()
             plens_np = np.asarray(plens)
+            now_obs = time.monotonic()
+            # Whole-prompt insert dispatch span: the plens fetch blocks
+            # on the prefill, so wall here is the real admission cost
+            # (what decode_stall_ms_total clocks); linked into each
+            # request's prefilling span.
+            self.obs.record_dispatch(
+                kind="insert", k=k,
+                occupancy=sum(
+                    s is not None for s in self.slots.values()
+                ),
+                prefill_tokens=sum(len(r.tokens) for r in batch),
+                wall_ms=(now_obs - t0_obs) * 1000.0,
+                fetch_ms=(now_obs - tf_obs) * 1000.0,
+                swap_inflight=len(self._restoring),
+                rids=[r.rid for r in batch],
+            )
             for i, req in enumerate(batch):
                 b = slot_ids[i]
                 blocks = row_blocks[i]
@@ -3839,3 +3990,4 @@ class ContinuousBatcher:
                 self._claim_blocks(blocks)
                 chain = chains[req.rid]
                 self._register_chain(blocks[: len(chain)], chain)
+                self.obs.begin_span(req.rid, "decoding")

@@ -73,6 +73,14 @@ def pytest_configure(config):
         "host-DRAM block tier; CPU-safe and part of the default "
         "tier-1 run — select just them with pytest -m kvcache)",
     )
+    config.addinivalue_line(
+        "markers",
+        "obs: observability-layer tests (request timelines, dispatch "
+        "spans, latency histograms, SLO accounting, /metrics "
+        "exposition, /debug endpoints; CPU-safe and part of the "
+        "default tier-1 run — select just them with pytest -m obs "
+        "or make obs)",
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -713,7 +713,7 @@ def test_run_cli_degrade_flags(tmp_path, capsys, monkeypatch):
     )
     run_cli.main()
     out = capsys.readouterr().out
-    assert "fault injection armed" in out
+    assert "faults_armed" in out  # the StructuredLogger line
     assert len(hits["gen"]["tokens"]) == 6
     assert hits["health"]["ok"] is True
     assert hits["health"]["quarantined"] == ["paged_kernel"]
@@ -792,7 +792,7 @@ def test_chaos_drill_all_sites(tmp_path, capsys, monkeypatch):
          "--quarantine-threshold", "3", "--watchdog-s", "30"],
     )
     run_cli.main()
-    assert "fault injection armed" in capsys.readouterr().out
+    assert "faults_armed" in capsys.readouterr().out
     ok = [r for r in hits["results"] if isinstance(r, list)]
     failed = [r for r in hits["results"] if not isinstance(r, list)]
     # Every request either completed with its full budget or was the

@@ -688,7 +688,7 @@ def test_run_cli_fault_flags(tmp_path, capsys, monkeypatch):
     )
     run_cli.main()
     out = capsys.readouterr().out
-    assert "fault injection armed" in out
+    assert "faults_armed" in out  # StructuredLogger line
     assert len(hits["gen"]["tokens"]) == 6
     assert "llm_faults_injected_total 1" in hits["metrics"]
     assert "llm_server_recoveries_total 1" in hits["metrics"]

@@ -1,0 +1,665 @@
+"""Observability layer (obs.py): request span timelines through the
+admission state machine (classic / fused / spec / restoring), dispatch
+spans causally linked to the requests they carried, Prometheus
+histogram bucket math, SLO accounting, and the Chrome/Perfetto
+``trace_event`` export schema.
+
+The unit tests drive :class:`Observability` with an injectable clock;
+the integration tests run the real tiny-model ``ContinuousBatcher`` and
+assert the timelines the serving loop recorded — including the
+acceptance-criterion drill: a request served through a FUSED admission
+after a radix host-tier RESTORE owns a queued/restoring/prefilling/
+decoding timeline whose span links resolve to real dispatch spans, and
+the whole window exports as loadable ``trace_event`` JSON."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.obs import (
+    HISTOGRAMS,
+    METRICS,
+    Histogram,
+    Observability,
+    StructuredLogger,
+    metric_meta,
+)
+from jax_llama_tpu.serving import ContinuousBatcher
+
+pytestmark = pytest.mark.obs
+
+BS = 16  # block size for the tier drills (matches test_kvcache)
+
+CFG = dict(
+    vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    multiple_of=32, max_seq_len=128, dtype="float32", param_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = get_config("tiny", **CFG)
+    params = init_params(jax.random.PRNGKey(0), config)
+    return params, config
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+# ---------------------------------------------------------------------------
+# Histogram bucket math
+# ---------------------------------------------------------------------------
+
+def test_histogram_bucket_math():
+    h = Histogram("x_ms", "help", buckets=(1.0, 2.0, 5.0))
+    for v in (0.5, 1.0, 1.5, 5.0, 7.0):
+        h.observe(v)
+    # le is LESS-THAN-OR-EQUAL: a value on a bound lands in that bucket.
+    assert h.cumulative() == [
+        ("1", 2), ("2", 3), ("5", 4), ("+Inf", 5),
+    ]
+    assert h.count == 5
+    assert h.sum == pytest.approx(15.0)
+
+
+def test_histogram_exposition_format():
+    h = Histogram("lat_ms", "latency help", buckets=(10.0, 100.0))
+    h.observe(3.0)
+    h.observe(250.0)
+    lines = h.expose("llm_")
+    assert lines[0] == "# HELP llm_lat_ms latency help"
+    assert lines[1] == "# TYPE llm_lat_ms histogram"
+    assert 'llm_lat_ms_bucket{le="10"} 1' in lines
+    assert 'llm_lat_ms_bucket{le="+Inf"} 2' in lines
+    assert "llm_lat_ms_sum 253.0" in lines
+    assert "llm_lat_ms_count 2" in lines
+    # The +Inf bucket always equals _count (Prometheus invariant).
+    inf = [ln for ln in lines if 'le="+Inf"' in ln][0]
+    cnt = [ln for ln in lines if ln.endswith("_count 2")][0]
+    assert inf.rsplit(" ", 1)[1] == cnt.rsplit(" ", 1)[1]
+
+
+def test_histogram_rejects_unsorted_buckets():
+    with pytest.raises(ValueError):
+        Histogram("bad", "h", buckets=(5.0, 1.0))
+    with pytest.raises(ValueError):
+        Histogram("bad", "h", buckets=(1.0, 1.0, 2.0))
+
+
+def test_metric_registry_shape():
+    """Every registered metric carries a valid type and a non-empty
+    HELP; the names the exposition derives families from are covered."""
+    for name, (kind, help_text) in METRICS.items():
+        assert kind in ("counter", "gauge"), name
+        assert help_text, name
+    assert metric_meta("emitted_tokens_total") == METRICS[
+        "emitted_tokens_total"
+    ]
+    assert metric_meta("definitely_not_registered") is None
+    # radix_nodes_total is the deliberate counter-convention exception.
+    assert METRICS["radix_nodes_total"][0] == "gauge"
+    assert set(HISTOGRAMS) == {
+        "ttft_ms", "itl_ms", "queue_wait_ms", "prefill_chunk_ms",
+        "swap_in_ms", "dispatch_ms",
+    }
+
+
+# ---------------------------------------------------------------------------
+# Span lifecycle / binding / rings (fake clock)
+# ---------------------------------------------------------------------------
+
+def test_span_lifecycle_and_dispatch_links():
+    clk = FakeClock()
+    obs = Observability(clock=clk)
+    obs.request_queued(7, prompt_tokens=12)
+    clk.advance(0.050)
+    obs.begin_span(7, "prefilling")
+    seq = obs.record_dispatch(
+        kind="insert", k=1, occupancy=1, prefill_tokens=12,
+        wall_ms=5.0, fetch_ms=1.0, rids=[7],
+    )
+    clk.advance(0.010)
+    obs.begin_span(7, "decoding")
+    seq2 = obs.record_dispatch(kind="decode", k=4, occupancy=1,
+                               wall_ms=2.0, rids=[7])
+    clk.advance(0.008)
+    obs.request_end(7, "finished")
+
+    obs.bind(7, "ext-abc")
+    tl = obs.timeline_json("ext-abc")
+    assert tl is not None
+    assert tl["request_id"] == "ext-abc" and tl["rids"] == [7]
+    assert tl["prompt_tokens"] == 12
+    assert tl["outcome"] == "finished" and tl["error"] is None
+    states = [sp["state"] for sp in tl["spans"]]
+    assert states == ["queued", "prefilling", "decoding"]
+    q, pf, dec = tl["spans"]
+    assert q["duration_ms"] == pytest.approx(50.0)
+    assert pf["dispatches"] == [seq]
+    assert dec["dispatches"] == [seq2]
+    # Every linked seq resolves to a real record in the payload.
+    linked = {d["seq"] for d in tl["dispatch_spans"]}
+    assert linked == {seq, seq2}
+    # The queued->prefilling edge fed the queue-wait histogram.
+    assert obs.hist["queue_wait_ms"].count == 1
+    assert obs.hist["queue_wait_ms"].sum == pytest.approx(50.0)
+    # dispatch_ms saw both; prefill_chunk_ms only the insert.
+    assert obs.hist["dispatch_ms"].count == 2
+    assert obs.hist["prefill_chunk_ms"].count == 1
+    # Lookup also works by provisional id and bare rid.
+    assert obs.timeline_json("7")["request_id"] == "ext-abc"
+
+
+def test_bind_before_spans_and_unknown_rid_is_noop():
+    obs = Observability(clock=FakeClock())
+    obs.bind(99, "never-queued")  # unknown rid: no crash, no timeline
+    assert obs.timeline_json("never-queued") is None
+    obs.begin_span(42, "decoding")  # unknown rid: no-op
+    obs.request_end(42, "finished")
+    assert obs.requests_json()["requests"] == []
+
+
+def test_bind_replay_folds_into_existing_timeline():
+    """Crash-recovery replay: the fresh rid (and its queued span) fold
+    into the external id's existing timeline — one continuous story."""
+    clk = FakeClock()
+    obs = Observability(clock=clk)
+    obs.request_queued(1, 8)
+    obs.bind(1, "cli-id")
+    obs.begin_span(1, "decoding")
+    clk.advance(0.010)
+    # crash: replay resubmits under a fresh rid
+    obs.request_queued(2, 8)
+    obs.bind(2, "cli-id", replay=True)
+    clk.advance(0.005)
+    obs.begin_span(2, "decoding")
+    obs.request_end(2, "finished")
+    tl = obs.timeline_json("cli-id")
+    assert tl["rids"] == [1, 2]
+    assert tl["outcome"] == "finished"
+    states = [sp["state"] for sp in tl["spans"]]
+    assert states == ["queued", "decoding", "queued", "decoding"]
+    assert tl["spans"][2]["note"] == "replay"
+    # The rid-2 lookups now resolve to the folded timeline too.
+    assert obs.timeline_json("2")["request_id"] == "cli-id"
+
+
+def test_bind_id_collision_keeps_separate_timelines():
+    """A NON-replay bind onto an id another request owns (a client
+    reusing X-Request-Id) must not merge the two: the live timeline
+    keeps its state, the new request stays addressable by rid."""
+    clk = FakeClock()
+    obs = Observability(clock=clk)
+    obs.request_queued(1, 4)
+    obs.bind(1, "reused-id")
+    obs.begin_span(1, "decoding")
+    obs.request_queued(2, 9)  # different request, same client id
+    obs.bind(2, "reused-id")
+    tl = obs.timeline_json("reused-id")
+    assert tl["rids"] == [1] and tl["prompt_tokens"] == 4
+    tl2 = obs.timeline_json("2")
+    assert tl2["request_id"] == "r2" and tl2["prompt_tokens"] == 9
+    obs.request_end(1, "finished")
+    assert obs.timeline_json("reused-id")["outcome"] == "finished"
+
+
+def test_bind_replay_rid_index_bounded():
+    """Folded replay rids are capped: only the most recent
+    incarnations stay in the by-rid index (a crash-looping request
+    cannot grow its timeline's index entries without bound)."""
+    from jax_llama_tpu.obs import _MAX_RIDS
+
+    obs = Observability(clock=FakeClock())
+    obs.request_queued(0, 4)
+    obs.bind(0, "storm")
+    for rid in range(1, 3 * _MAX_RIDS):
+        obs.request_queued(rid, 4)
+        obs.bind(rid, "storm", replay=True)
+    tl = obs.timeline_json("storm")
+    assert len(tl["rids"]) == _MAX_RIDS
+    assert tl["rids"][-1] == 3 * _MAX_RIDS - 1
+    # Aged-out rids no longer resolve; recent ones do.
+    assert obs.timeline_json("0") is None
+    assert obs.timeline_json(str(3 * _MAX_RIDS - 1)) is not None
+
+
+def test_timeline_lru_eviction_and_dispatch_ring_bound():
+    obs = Observability(max_timelines=4, ring=8, clock=FakeClock())
+    for rid in range(10):
+        obs.request_queued(rid, 4)
+    assert len(obs.requests_json(64)["requests"]) == 4
+    assert obs.timeline_json("r0") is None          # evicted
+    assert obs.timeline_json("r9") is not None      # newest retained
+    for i in range(20):
+        obs.record_dispatch(kind="decode", k=1, wall_ms=1.0)
+    d = obs.dispatches_json(128)["dispatches"]
+    assert len(d) == 8
+    assert d[-1]["seq"] == 19  # seq is ring-global, not index
+    # n <= 0 returns nothing, never the whole store ([-0:] trap).
+    assert obs.dispatches_json(0)["dispatches"] == []
+    assert obs.requests_json(-3)["requests"] == []
+
+
+def test_timeline_eviction_prefers_terminal_over_live():
+    """A long-running LIVE request must survive a burst of newer
+    finished requests: terminal timelines evict first, so its
+    request_end still lands (the finished counter never undercounts a
+    request the server is actively serving)."""
+    obs = Observability(max_timelines=4, clock=FakeClock())
+    obs.request_queued(0, 4)            # the long-running stream
+    obs.begin_span(0, "decoding")
+    for rid in range(1, 10):            # newer, all finished
+        obs.request_queued(rid, 4)
+        obs.request_end(rid, "finished")
+    assert obs.timeline_json("r0") is not None   # live: kept
+    obs.request_end(0, "finished")
+    assert obs.timeline_json("r0")["outcome"] == "finished"
+    assert obs.requests_finished_total == 10
+    # All-live pathology: the hard bound still holds.
+    obs2 = Observability(max_timelines=3, clock=FakeClock())
+    for rid in range(8):
+        obs2.request_queued(rid, 4)
+    assert len(obs2.requests_json(64)["requests"]) == 3
+
+
+def test_slo_accounting_gauges_and_goodput():
+    obs = Observability(slo_ttft_ms=100.0, slo_itl_ms=50.0,
+                        clock=FakeClock())
+    assert obs.slo_account(80.0, 40.0, tokens=10) is True
+    assert obs.slo_account(150.0, 40.0, tokens=7) is False   # ttft miss
+    assert obs.slo_account(80.0, 90.0, tokens=7) is False    # itl miss
+    assert obs.slo_account(None, None, tokens=0) is False    # no token
+    assert obs.slo_account(80.0, 40.0, tokens=9,
+                           completed=False) is False         # failed
+    m = obs.metrics()
+    assert m["requests_slo_ok_total"] == 1
+    assert m["goodput_tokens_total"] == 10
+    # ttft passes rows 1,3 (the no-token row fails a configured TTFT);
+    # itl passes rows 1,2,4 (no-token trivially passes ITL); the
+    # completed=False row passes neither.
+    assert m["slo_ttft_attainment"] == pytest.approx(2 / 5)
+    assert m["slo_itl_attainment"] == pytest.approx(3 / 5)
+    assert m["slo_attainment"] == pytest.approx(1 / 5)
+    assert m["slo_ttft_ms"] == 100.0 and m["slo_itl_ms"] == 50.0
+
+
+def test_slo_unconfigured_dimensions_always_pass():
+    obs = Observability(clock=FakeClock())  # no SLOs set
+    assert obs.slo_account(9999.0, 9999.0, tokens=5) is True
+    assert obs.slo_account(None, None, tokens=3) is True
+    m = obs.metrics()
+    assert m["slo_attainment"] == 1.0
+    assert m["goodput_tokens_total"] == 8  # == delivered tokens
+    # One configured dimension scores independently of the other.
+    obs2 = Observability(slo_itl_ms=50.0, clock=FakeClock())
+    assert obs2.slo_account(99999.0, 10.0, tokens=1) is True
+    assert obs2.slo_account(None, 90.0, tokens=1) is False
+
+
+def test_request_rejected_records_terminal_timeline():
+    """A pre-admission 504 (no batcher rid ever existed) still gets a
+    terminal timeline under its external id and counts as failed, so
+    the overload failure signals (/debug + requests_failed_total +
+    SLO attainment) agree instead of contradicting."""
+    obs = Observability(clock=FakeClock())
+    obs.request_rejected("overload-1", "timed out before admission")
+    tl = obs.timeline_json("overload-1")
+    assert tl["outcome"] == "failed" and tl["rids"] == []
+    assert tl["spans"][0]["state"] == "queued"
+    assert tl["spans"][0]["end_ms"] is not None
+    assert obs.requests_failed_total == 1
+    # Id reuse keeps the existing (richer) record — but the failure
+    # still COUNTS (every 504 the client saw is a failure).
+    obs.request_queued(1, 4)
+    obs.bind(1, "live-id")
+    obs.request_rejected("live-id", "should not clobber")
+    assert obs.timeline_json("live-id")["outcome"] is None
+    assert obs.requests_failed_total == 2
+
+
+def test_annotation_ring_bounded():
+    obs = Observability(max_events=4, clock=FakeClock())
+    for i in range(10):
+        obs.annotate("fault_injected", site="step", kind="error", call=i)
+    assert len(obs.events) == 4
+    assert obs.events[-1]["fields"]["call"] == 9
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome trace_event export schema
+# ---------------------------------------------------------------------------
+
+def test_trace_json_schema():
+    clk = FakeClock()
+    obs = Observability(clock=clk)
+    obs.request_queued(1, 4)
+    obs.bind(1, "req-a")
+    clk.advance(0.020)
+    obs.begin_span(1, "decoding")
+    obs.record_dispatch(kind="decode", k=4, occupancy=1, wall_ms=3.0,
+                        rids=[1])
+    obs.annotate("quarantine_transition", feature="flash_attention",
+                 state="quarantined")
+    clk.advance(0.010)
+    obs.request_end(1, "finished")
+
+    doc = json.loads(json.dumps(obs.trace_json()))  # JSON round-trips
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    assert doc["displayTimeUnit"] == "ms"
+    for ev in evs:
+        assert ev["ph"] in ("M", "X", "i")
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        assert "name" in ev
+        if ev["ph"] == "X":
+            assert ev["ts"] >= 0 and ev["dur"] >= 1  # us, integer-safe
+        if ev["ph"] == "i":
+            assert ev["s"] == "g"
+    # One metadata track for dispatches, one per request.
+    meta = [e for e in evs if e["ph"] == "M"]
+    names = {e["args"]["name"] for e in meta}
+    assert "dispatches" in names and "req req-a" in names
+    # Request lifecycle slices carry their dispatch links.
+    req_slices = [e for e in evs if e.get("cat") == "request"]
+    assert any(e["args"]["dispatches"] for e in req_slices)
+    annos = [e for e in evs if e.get("cat") == "annotation"]
+    assert annos and annos[0]["args"]["feature"] == "flash_attention"
+
+
+def test_trace_json_window_filters_old_events():
+    clk = FakeClock()
+    obs = Observability(clock=clk)
+    obs.record_dispatch(kind="decode", k=1, wall_ms=1.0)
+    clk.advance(10.0)
+    obs.record_dispatch(kind="decode", k=2, wall_ms=1.0)
+    evs = obs.trace_json(window_ms=1000.0)["traceEvents"]
+    dispatch = [e for e in evs if e.get("cat") == "dispatch"]
+    assert len(dispatch) == 1 and dispatch[0]["args"]["seq"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Structured logging
+# ---------------------------------------------------------------------------
+
+def test_structured_logger_json_and_text(capsys):
+    StructuredLogger(json_mode=True).log(
+        "request_failed", "nan guard", request_id="abc", rid=3,
+        skipped=None,
+    )
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["event"] == "request_failed"
+    assert rec["message"] == "nan guard"
+    assert rec["request_id"] == "abc" and rec["rid"] == 3
+    assert "skipped" not in rec and "ts" in rec
+    StructuredLogger(json_mode=False).log(
+        "serving", address="http://x", endpoints="a, b"
+    )
+    line = capsys.readouterr().out.strip()
+    assert line.startswith("serving ") and "address=http://x" in line
+
+
+# ---------------------------------------------------------------------------
+# Integration: the real serving loop's timelines (tiny model, CPU)
+# ---------------------------------------------------------------------------
+
+def _timeline(cb, rid):
+    tl = cb.obs.timeline_json(str(rid))
+    assert tl is not None, f"no timeline for rid {rid}"
+    return tl
+
+
+def _assert_links_resolve(cb, tl):
+    """Every span's dispatch links resolve to real records of the
+    global ring, and each linked record lists this request's rid."""
+    ring = {d["seq"]: d for d in cb.obs.dispatches_json(4096)["dispatches"]}
+    rids = set(tl["rids"])
+    linked = [s for sp in tl["spans"] for s in sp["dispatches"]]
+    assert linked, "expected at least one dispatch link"
+    for seq in linked:
+        assert seq in ring, f"span links dispatch {seq} not in ring"
+        assert rids & set(ring[seq]["rids"])
+
+
+def test_classic_admission_span_lifecycle(model):
+    """prefill_budget=0: whole-prompt insert admission.  Timeline is
+    queued -> prefilling -> decoding -> finished, the prefilling span
+    links the classic ``insert`` dispatch, decoding links decode
+    chunks."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=128,
+                           decode_chunk=4, prefill_budget=0)
+    rid = cb.submit([5, 6, 7, 8], max_new_tokens=8)
+    cb.run_to_completion()
+    tl = _timeline(cb, rid)
+    assert [sp["state"] for sp in tl["spans"]] == [
+        "queued", "prefilling", "decoding",
+    ]
+    assert tl["outcome"] == "finished"
+    _assert_links_resolve(cb, tl)
+    kinds = {d["kind"] for d in tl["dispatch_spans"]}
+    assert "insert" in kinds and "decode" in kinds
+    ins = [d for d in tl["dispatch_spans"] if d["kind"] == "insert"][0]
+    assert ins["prefill_tokens"] == 4
+    assert cb.obs.hist["dispatch_ms"].count >= len(tl["dispatch_spans"])
+
+
+def test_fused_admission_span_lifecycle(model):
+    """A warm-pool admission rides the fused prefill lane: its
+    prefilling span links prefill-carrying chunk dispatches (kind
+    ``fused``, prefill_tokens > 0) and decode rows kept emitting."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=128,
+                           decode_chunk=4, prefill_budget=32)
+    cb.submit(list(np.random.RandomState(0).randint(1, 128, 9)),
+              max_new_tokens=60)
+    for _ in range(4):
+        cb.step()  # get row 0 into steady decode
+    rid = cb.submit(list(np.random.RandomState(1).randint(1, 128, 40)),
+                    max_new_tokens=4)
+    cb.run_to_completion()
+    tl = _timeline(cb, rid)
+    states = [sp["state"] for sp in tl["spans"]]
+    assert states == ["queued", "prefilling", "decoding"]
+    assert tl["outcome"] == "finished"
+    _assert_links_resolve(cb, tl)
+    pf_span = tl["spans"][1]
+    fused = [
+        d for d in tl["dispatch_spans"]
+        if d["seq"] in pf_span["dispatches"]
+    ]
+    assert fused and all(d["prefill_tokens"] > 0 for d in fused)
+    assert any(d["kind"] == "fused" for d in fused)
+    # The fused dispatches carried decode rows too (occupancy >= 2).
+    assert all(d["occupancy"] >= 2 for d in fused)
+
+
+def test_spec_admission_span_lifecycle(model):
+    """Speculative serving records ``spec`` dispatch spans; the
+    request's decoding span links them."""
+    params, config = model
+    draft_config = get_config(
+        "tiny", **{**CFG, "dim": 32, "n_layers": 1, "n_heads": 2,
+                   "n_kv_heads": 1}
+    )
+    draft_params = init_params(jax.random.PRNGKey(1), draft_config)
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64,
+                           draft_params=draft_params,
+                           draft_config=draft_config,
+                           n_draft=2, spec_rounds=4)
+    rid = cb.submit([4, 5, 6], max_new_tokens=10)
+    cb.run_to_completion()
+    tl = _timeline(cb, rid)
+    assert tl["outcome"] == "finished"
+    assert [sp["state"] for sp in tl["spans"]] == [
+        "queued", "prefilling", "decoding",
+    ]
+    _assert_links_resolve(cb, tl)
+    dec = tl["spans"][2]
+    spec = [
+        d for d in tl["dispatch_spans"]
+        if d["seq"] in dec["dispatches"]
+    ]
+    assert spec and all(d["kind"] == "spec" for d in spec)
+
+
+def test_failed_request_timeline_records_error(model):
+    """cancel() closes the timeline as cancelled; the non-finite path
+    is covered by the faults suite — here we pin the terminal record."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64)
+    rid = cb.submit([4, 5, 6], max_new_tokens=40)
+    cb.step()
+    assert cb.cancel(rid)
+    tl = _timeline(cb, rid)
+    assert tl["outcome"] == "cancelled"
+    assert tl["spans"][-1]["end_ms"] is not None
+    # The server's deadline reaper passes outcome="failed" so timeouts
+    # count under requests_failed_total, never as cancellations.
+    rid2 = cb.submit([7, 8, 9], max_new_tokens=40)
+    cb.step()
+    assert cb.cancel(rid2, outcome="failed", error="generation timed out")
+    tl2 = _timeline(cb, rid2)
+    assert tl2["outcome"] == "failed"
+    assert tl2["error"] == "generation timed out"
+    assert cb.obs.requests_failed_total == 1
+    assert cb.obs.requests_cancelled_total == 1
+
+
+def test_restoring_fused_admission_full_timeline(model):
+    """THE acceptance-criterion drill: a session whose radix prefix was
+    demoted to the host tier comes back while another row decodes — it
+    admits through restoring (async swap-in overlapped on the decode
+    chunk) and then the FUSED prefill lane.  Its timeline holds all
+    four lifecycle states, every span links real dispatch spans (the
+    restoring span links the ``adopt`` scatter), the swap-in histogram
+    saw the restore, and the whole window exports as Perfetto-loadable
+    trace_event JSON."""
+    params, config = model
+    rng = np.random.RandomState(41)
+    session = rng.randint(1, 128, size=40).tolist()
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=128, block_size=BS,
+        n_blocks=8, prefix_cache=True, host_kv_blocks=4,
+        decode_chunk=4, prefill_budget=32,
+    )
+    # Seed the session chain (2 keyed blocks), then demote it to the
+    # host tier explicitly.
+    cb.submit(list(session), max_new_tokens=4)
+    cb.run_to_completion()
+    assert cb.demote_idle(2) == 2
+    assert cb.stats()["host_tier_blocks"] == 2
+    # A long-running decode occupies a row, so the session's revisit
+    # must overlap its swap-in with live decode chunks and admit fused.
+    # Geometry: the filler reserves 4 of 8 blocks (9+40 -> 64 padded),
+    # leaving 4 free — enough for the 2-block restore staging plus the
+    # session's suffix, so the swap really does fly WHILE the filler
+    # decodes (a bigger filler would starve the restore of fresh
+    # blocks and the session would fall back to a cold-pool suffix
+    # admission after the filler finished).
+    cb.submit(rng.randint(1, 128, size=9).tolist(), max_new_tokens=40)
+    for _ in range(4):
+        cb.step()
+    cb.swap_poll_min = 2  # hold the restore window open >= 2 polls
+    rid = cb.submit(list(session), max_new_tokens=4)
+    saw_restoring = False
+    guard = 0
+    while cb.pending():
+        guard += 1
+        assert guard < 300
+        cb.step()
+        saw_restoring = saw_restoring or bool(cb._restoring)
+    assert saw_restoring
+    st = cb.stats()
+    assert st["swap_ins_total"] == 1
+
+    tl = _timeline(cb, rid)
+    assert tl["outcome"] == "finished"
+    states = [sp["state"] for sp in tl["spans"]]
+    # queued -> restoring -> queued(restored) -> prefilling -> decoding
+    assert set(states) >= {"queued", "restoring", "prefilling",
+                           "decoding"}
+    assert states[0] == "queued" and states[1] == "restoring"
+    assert states[-1] == "decoding"
+    restored = tl["spans"][2]
+    assert restored["state"] == "queued" and restored["note"] == "restored"
+    _assert_links_resolve(cb, tl)
+    # The restoring span links the adoption scatter dispatch.
+    rest_span = tl["spans"][1]
+    adopt = [
+        d for d in tl["dispatch_spans"]
+        if d["seq"] in rest_span["dispatches"]
+    ]
+    assert adopt and adopt[-1]["kind"] == "adopt"
+    # The fused prefill rode dispatches that also carried the decode row.
+    pf_span = tl["spans"][states.index("prefilling")]
+    carried = [
+        d for d in tl["dispatch_spans"]
+        if d["seq"] in pf_span["dispatches"]
+    ]
+    assert carried and all(d["occupancy"] >= 2 for d in carried)
+    # Swap-in latency landed in its histogram + the annotation ring.
+    assert cb.obs.hist["swap_in_ms"].count == 1
+    assert any(e["name"] == "kv_swap_in" for e in cb.obs.events)
+    assert any(e["name"] == "kv_demote" for e in cb.obs.events)
+    # The serving window exports as valid trace_event JSON.
+    doc = json.loads(json.dumps(cb.obs.trace_json()))
+    evs = doc["traceEvents"]
+    assert any(
+        e.get("cat") == "request" and e["name"] == "restoring"
+        for e in evs
+    )
+    assert any(
+        e.get("cat") == "dispatch" and e["name"].startswith("adopt")
+        for e in evs
+    )
+
+
+def test_obs_survives_rebuild_one_continuous_trace(model):
+    """rebuild() (the crash-recovery primitive) reuses the SAME
+    Observability via the captured ctor kwargs: timelines and dispatch
+    seqs continue instead of resetting."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64)
+    cb.submit([4, 5, 6], max_new_tokens=4)
+    cb.run_to_completion()
+    seq_before = cb.obs._seq
+    cb2 = cb.rebuild()
+    assert cb2.obs is cb.obs
+    rid = cb2.submit([7, 8, 9], max_new_tokens=4)
+    cb2.run_to_completion()
+    tl = cb2.obs.timeline_json(str(rid))
+    assert tl["outcome"] == "finished"
+    assert min(
+        s for sp in tl["spans"] for s in sp["dispatches"]
+    ) >= seq_before
+
+
+def test_fault_injection_annotated_in_trace(model):
+    """An injected fault lands as an instant event in the annotation
+    ring (the batcher wires injector.trace_sink at construction), so a
+    chaos drill's fault is explainable next to the dispatch spans it
+    killed."""
+    from jax_llama_tpu.faults import FaultInjector, InjectedFault
+
+    params, config = model
+    inj = FaultInjector("step@1:error")
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64,
+                           fault_injector=inj)
+    cb.submit([4, 5, 6], max_new_tokens=8)
+    with pytest.raises(InjectedFault):
+        for _ in range(8):
+            cb.step()
+    faults = [e for e in cb.obs.events if e["name"] == "fault_injected"]
+    assert faults and faults[0]["fields"]["site"] == "step"
+    assert faults[0]["fields"]["kind"] == "error"

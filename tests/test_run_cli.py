@@ -109,6 +109,52 @@ def test_run_cli_http_mode(tmp_path, capsys, monkeypatch):
     )
     run_cli.main()
     out = capsys.readouterr().out
-    assert "serving on http://" in out
+    # The operational log line goes through obs.StructuredLogger now:
+    # "serving address=http://... endpoints=..." in text mode.
+    assert "serving" in out and "http://" in out
     assert len(hits["gen"]["tokens"]) == 4 and "text" in hits["gen"]
     assert hits["health"]["ok"] is True
+
+
+def test_run_cli_http_log_json(tmp_path, capsys, monkeypatch):
+    """--log-json routes every operational line through one JSON
+    formatter: each log line parses as a JSON object with an "event"
+    field (checkpoint_restored, serving, ...) — no bare prints left on
+    the serving path."""
+    import json
+    import urllib.request
+
+    config = get_config(
+        "tiny", vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        multiple_of=32, max_seq_len=64,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(str(ckpt), params, config)
+
+    def hook(srv):
+        with urllib.request.urlopen(srv.address + "/healthz", timeout=60):
+            pass
+
+    orig = run_cli._serve_http
+    monkeypatch.setattr(
+        run_cli, "_serve_http",
+        lambda *a, **kw: orig(*a, **kw, _test_hook=hook),
+    )
+    monkeypatch.setattr(
+        sys, "argv",
+        ["run", "--ckpt-dir", str(ckpt), "--byte-tokenizer",
+         "--tensor", "2", "--http", "0", "--log-json"],
+    )
+    run_cli.main()
+    lines = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.strip()
+    ]
+    assert lines, "expected structured log output"
+    events = []
+    for ln in lines:
+        rec = json.loads(ln)  # every line is one JSON object
+        assert "event" in rec and "ts" in rec
+        events.append(rec["event"])
+    assert "checkpoint_restored" in events
+    assert "serving" in events

@@ -707,3 +707,285 @@ def test_http_body_size_cap(model):
             srv.address, {"prompt": [1, 2, 3], "max_new_tokens": 4}
         )
         assert status == 200 and len(body["tokens"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# Observability surface: /metrics exposition, end-to-end request ids,
+# /debug endpoints, SLO gauges (obs.py)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = __import__("re").compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9]+(\.[0-9]+)?"
+    r"([eE][+-][0-9]+)?$"
+)
+
+
+def _parse_exposition(text):
+    """Minimal Prometheus text-format parser: returns
+    ({family: type}, {family: help}, {sample_name_with_labels: value})
+    and asserts every line is well-formed."""
+    types, helps, samples = {}, {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+        elif line.startswith("# HELP "):
+            _, _, name, help_text = line.split(" ", 3)
+            assert help_text.strip(), line
+            helps[name] = help_text
+        else:
+            assert _SAMPLE_RE.match(line), f"malformed sample: {line!r}"
+            name, value = line.rsplit(" ", 1)
+            samples[name] = float(value)
+    return types, helps, samples
+
+
+@pytest.mark.obs
+def test_metrics_exposition_valid_prometheus(model):
+    """Every /metrics line is valid Prometheus text format, every
+    family carries an explicit # TYPE AND # HELP from the obs.METRICS
+    registry (no heuristic, no unregistered stragglers), TYPE is
+    consistent with semantics, and the histogram families obey the
+    cumulative-bucket invariants."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    with LLMServer(cb, tokenizer=ByteTokenizer()) as srv:
+        status, _ = _post(
+            srv.address, {"prompt": [3, 4, 5], "max_new_tokens": 6}
+        )
+        assert status == 200
+        status, text = _get(srv.address, "/metrics")
+        assert status == 200
+    types, helps, samples = _parse_exposition(text)
+    # The legacy fallback marks unregistered scalars; none may ship.
+    assert "UNREGISTERED" not in text
+    # Every TYPE has a HELP and vice versa.
+    assert set(types) == set(helps)
+    # Every sample belongs to a typed family (histograms expose
+    # _bucket/_sum/_count series under the family name).
+    for name in samples:
+        family = name.split("{")[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            if family.endswith(suffix) and (
+                family[: -len(suffix)] in types
+            ):
+                family = family[: -len(suffix)]
+                break
+        assert family in types, f"untyped sample {name}"
+    # TYPE consistent with semantics: *_total names counters — except
+    # llm_radix_nodes_total, the documented resident-count exception.
+    for family, kind in types.items():
+        if kind == "histogram":
+            continue
+        if family.endswith("_total") and family != "llm_radix_nodes_total":
+            assert kind == "counter", family
+    assert types["llm_radix_nodes_total"] == "gauge"
+    assert types["llm_active_slots"] == "gauge"
+    # The serving histograms are exposed and internally consistent.
+    for fam in ("llm_ttft_ms", "llm_itl_ms", "llm_queue_wait_ms",
+                "llm_prefill_chunk_ms", "llm_swap_in_ms",
+                "llm_dispatch_ms"):
+        assert types[fam] == "histogram"
+        buckets = [
+            (n, v) for n, v in samples.items()
+            if n.startswith(fam + "_bucket{")
+        ]
+        assert buckets, fam
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), f"{fam} buckets not cumulative"
+        inf = [v for n, v in buckets if 'le="+Inf"' in n]
+        assert len(inf) == 1
+        assert inf[0] == samples[fam + "_count"]
+        assert samples[fam + "_sum"] >= 0.0
+    # The request actually fed TTFT and dispatch histograms.
+    assert samples["llm_ttft_ms_count"] >= 1
+    assert samples["llm_dispatch_ms_count"] >= 1
+    # SLO gauges present (unset deadlines -> 0 / attainment 1.0).
+    assert samples["llm_slo_ttft_ms"] == 0.0
+    assert samples["llm_slo_attainment"] == 1.0
+    assert samples["llm_goodput_tokens_total"] >= 6
+
+
+@pytest.mark.obs
+def test_request_id_end_to_end(model):
+    """A client-supplied X-Request-Id is honored and echoed in the
+    blocking body, the response header, every stream line, and error
+    bodies; absent the header, the server mints one."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    with LLMServer(cb, tokenizer=ByteTokenizer()) as srv:
+        req = urllib.request.Request(
+            srv.address + "/generate",
+            data=json.dumps(
+                {"prompt": [3, 4, 5], "max_new_tokens": 4}
+            ).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "client-abc-123"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            body = json.loads(r.read())
+            assert body["request_id"] == "client-abc-123"
+            assert r.headers["X-Request-Id"] == "client-abc-123"
+        # Minted id when the client sends none.
+        status, body = _post(
+            srv.address, {"prompt": [3, 4, 5], "max_new_tokens": 4}
+        )
+        assert status == 200
+        assert isinstance(body["request_id"], str) and body["request_id"]
+        # Every stream event carries the id, and the final line agrees.
+        req = urllib.request.Request(
+            srv.address + "/generate",
+            data=json.dumps(
+                {"prompt": [5, 6], "max_new_tokens": 4, "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "stream-id-9"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            assert r.headers["X-Request-Id"] == "stream-id-9"
+            lines = [json.loads(ln) for ln in r.read().splitlines()]
+        assert all(ln["request_id"] == "stream-id-9" for ln in lines)
+        assert lines[-1]["done"] is True
+        # A well-formed JSON body that is not an object is refused
+        # cleanly (an AttributeError traceback would close the socket
+        # with no HTTP response at all).
+        req = urllib.request.Request(
+            srv.address + "/generate", data=b"[1, 2, 3]",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "JSON object" in json.loads(e.read())["error"]
+        # Error bodies carry the id too (malformed payload -> 400).
+        req = urllib.request.Request(
+            srv.address + "/generate", data=b"{not json",
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "err-id-7"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            # Body AND header: proxies correlate on the header.
+            assert e.headers["X-Request-Id"] == "err-id-7"
+            assert json.loads(e.read())["request_id"] == "err-id-7"
+
+
+@pytest.mark.obs
+def test_debug_endpoints_and_slo_gauges(model):
+    """/debug/requests/<id> returns the request's span timeline (spans
+    linked to real dispatch spans), /debug/dispatches the ring,
+    /debug/trace Perfetto-loadable JSON; configured SLOs feed the
+    attainment gauges and goodput counter."""
+    from jax_llama_tpu.obs import Observability
+
+    params, config = model
+    obs = Observability(slo_ttft_ms=60_000.0, slo_itl_ms=60_000.0)
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64,
+                           obs=obs)
+    with LLMServer(cb, tokenizer=ByteTokenizer()) as srv:
+        req = urllib.request.Request(
+            srv.address + "/generate",
+            data=json.dumps(
+                {"prompt": [7, 8, 9], "max_new_tokens": 5}
+            ).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "dbg-1"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            assert json.loads(r.read())["request_id"] == "dbg-1"
+
+        status, body = _get(srv.address, "/debug/requests/dbg-1")
+        assert status == 200
+        tl = json.loads(body)
+        assert tl["request_id"] == "dbg-1"
+        assert tl["outcome"] == "finished"
+        states = [sp["state"] for sp in tl["spans"]]
+        assert states[0] == "queued" and "decoding" in states
+        ring = {d["seq"] for d in tl["dispatch_spans"]}
+        linked = [s for sp in tl["spans"] for s in sp["dispatches"]]
+        assert linked and set(linked) <= ring
+
+        status, body = _get(srv.address, "/debug/requests?n=8")
+        assert status == 200
+        idx = json.loads(body)["requests"]
+        assert any(r["request_id"] == "dbg-1" for r in idx)
+
+        status, body = _get(srv.address, "/debug/dispatches?n=16")
+        assert status == 200
+        dispatches = json.loads(body)["dispatches"]
+        assert dispatches and all("kind" in d for d in dispatches)
+
+        status, body = _get(srv.address, "/debug/trace")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["traceEvents"]
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+        try:
+            _get(srv.address, "/debug/requests/no-such-id")
+            assert False, "expected HTTP 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        status, text = _get(srv.address, "/metrics")
+        _, _, samples = _parse_exposition(text)
+        assert samples["llm_slo_ttft_ms"] == 60000.0
+        assert samples["llm_slo_attainment"] == 1.0
+        assert samples["llm_requests_slo_ok_total"] >= 1
+        assert samples["llm_goodput_tokens_total"] >= 5
+
+
+@pytest.mark.obs
+def test_debug_profiler_endpoint(model, tmp_path):
+    """POST /debug/profiler brackets a jax.profiler session: start
+    writes a trace under log_dir, double-start/stray-stop are 409s,
+    bad actions 400."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64)
+
+    def post_prof(srv, payload):
+        req = urllib.request.Request(
+            srv.address + "/debug/profiler",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    log_dir = str(tmp_path / "xplane")
+    with LLMServer(cb) as srv:
+        status, body = post_prof(srv, {"action": "bogus"})
+        assert status == 400
+        status, body = post_prof(srv, {"action": "stop"})
+        assert status == 409  # nothing active
+        status, body = post_prof(
+            srv, {"action": "start", "log_dir": log_dir}
+        )
+        assert status == 200 and body["ok"] is True
+        status, body = post_prof(
+            srv, {"action": "start", "log_dir": log_dir}
+        )
+        assert status == 409  # already tracing
+        status, _ = _post(
+            srv.address, {"prompt": [3, 4], "max_new_tokens": 3}
+        )
+        assert status == 200
+        status, body = post_prof(srv, {"action": "stop"})
+        assert status == 200 and body["log_dir"] == log_dir
+    import os
+
+    assert any(
+        f for _, _, fs in os.walk(log_dir) for f in fs
+    ), "profiler session wrote no trace files"
