@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs
+.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs lint lint-invariants
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -55,6 +55,26 @@ kvcache:
 # plumbing and exposition live there).
 obs:
 	$(PYTEST) tests/test_obs.py tests/test_server.py -q -m 'not slow'
+
+# Invariant auditor (jax_llama_tpu/analysis): host-boundary lint,
+# lowering-contract audit (donated args actually alias, host-fetch
+# surface within budget, no full-pool-copy equations — all ten
+# registered jitted programs lowered at a tiny geometry), and the
+# lock-discipline / thread-confinement check — plus `ruff check`
+# (pyflakes-class rules, [tool.ruff] in pyproject.toml) when ruff is
+# installed in the environment.  Exit non-zero on any finding; the
+# static layers also gate tier-1 via tests/test_analysis.py.
+lint-invariants:
+	env JAX_PLATFORMS=cpu python -m jax_llama_tpu.analysis
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check .; \
+	else \
+		echo "ruff not installed; skipping ruff check (pip install ruff)"; \
+	fi
+
+# The full lint gate (alias kept separate so CI can grow style/type
+# layers here without slowing the invariant auditor).
+lint: lint-invariants
 
 # On-chip kernel regressions (run on a TPU host; self-skip elsewhere).
 tpu:

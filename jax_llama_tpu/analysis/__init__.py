@@ -1,0 +1,60 @@
+"""Invariant auditor: static-analysis contracts for the serving stack.
+
+The serving stack's load-bearing guarantees — 1 packed fetch + 0
+steady-state uploads per chunk, donated pool carries, no full-pool-copy
+lowerings, single-owner batcher state — were enforced only by runtime
+smoke tests (``make perf-smoke``) and two hand-written HLO pins
+(``tests/test_tpu_compiled.py``).  This package turns them into
+machine-checked contracts, runnable on any backend in seconds:
+
+  * :mod:`.hostsync`  — **host-boundary lint** (AST + taint): flags
+    device->host syncs (``np.asarray`` on device values, ``float()`` /
+    ``.item()`` on tracers, ``block_until_ready``, ``jax.device_get``),
+    Python control flow on device values, and ``jnp.*`` construction
+    inside host loops; every sanctioned crossing carries an
+    ``# audit: host-fetch(<reason>)``-style pragma, so
+    ``grep 'audit: host-fetch'`` lists the stack's entire device->host
+    surface with justifications.
+  * :mod:`.lowering` + :mod:`.contracts` — **lowering auditor**
+    (jaxpr/StableHLO): a declarative registry where every jitted
+    program the batcher dispatches declares its donated args, its
+    live-output (host-fetchable) surface and byte budget, and the
+    forbidden full-pool-copy equation classes; the auditor lowers each
+    program at a tiny example shape and verifies donation actually
+    resolves to input-output aliases.  New programs must register a
+    contract — the coverage check fails on any unregistered jitted
+    function in serving.py / kvcache.py.
+  * :mod:`.lockcheck` — **lock-discipline checker** (AST): a guarded-
+    field registry for ``Observability`` / ``DegradeManager`` /
+    ``LLMServer`` (lock-guarded) and ``ContinuousBatcher`` /
+    ``LLMServer`` (owner-thread-confined); unguarded touches need an
+    ``# audit: racy-read(...)`` / ``locked(...)`` / ``unguarded(...)``
+    pragma carrying the safety argument.
+
+Run everything with ``python -m jax_llama_tpu.analysis`` (exit 0 =
+clean) or ``make lint-invariants``; tier-1 runs the same checks via
+``tests/test_analysis.py`` (``pytest -m analysis``), so a violating
+change fails CI before any bench round notices.  The pragma grammar
+and the how-to for registering a new program's contract live in
+README.md ("Static analysis").
+"""
+
+from .common import Finding, Pragmas  # noqa: F401
+from .contracts import REGISTRY, ProgramContract  # noqa: F401
+from .hostsync import AUDITED_MODULES, HostBoundaryChecker  # noqa: F401
+from .lockcheck import (  # noqa: F401
+    CONFINEMENTS, LOCK_GUARDS, LockDisciplineChecker, LockGuard,
+    ThreadConfinement,
+)
+from .lowering import LoweringAuditor  # noqa: F401
+
+from typing import List
+
+
+def run_all(trace: bool = True) -> List[Finding]:
+    """Run all three checkers over the package; [] means clean."""
+    findings: List[Finding] = []
+    findings.extend(HostBoundaryChecker().check_package())
+    findings.extend(LockDisciplineChecker().check_package())
+    findings.extend(LoweringAuditor().check_package(trace=trace))
+    return findings

@@ -1,0 +1,139 @@
+"""CLI: ``python -m jax_llama_tpu.analysis`` — run the invariant
+auditor over the package (or explicit files) and exit non-zero on any
+finding.
+
+    python -m jax_llama_tpu.analysis                  # all three checkers
+    python -m jax_llama_tpu.analysis --checker host   # one checker
+    python -m jax_llama_tpu.analysis --no-trace       # skip the (slower)
+                                                      # abstract-trace layer
+    python -m jax_llama_tpu.analysis path/to/file.py  # lint given files
+                                                      # (host + lock only)
+    python -m jax_llama_tpu.analysis --contracts pkg.mod
+                                                      # audit an external
+                                                      # REGISTRY (tests)
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+from typing import List, Optional, Sequence
+
+from .common import Finding
+from .hostsync import HostBoundaryChecker
+from .lockcheck import LockDisciplineChecker
+from .lowering import LoweringAuditor
+
+
+def _file_findings(paths: Sequence[str], checker: str) -> List[Finding]:
+    out: List[Finding] = []
+    host, lock = HostBoundaryChecker(), LockDisciplineChecker()
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        if checker in ("all", "host"):
+            out.extend(host.check_source(path, source))
+        if checker in ("all", "lock"):
+            out.extend(lock.check_source(path, source))
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m jax_llama_tpu.analysis",
+        description="Invariant auditor for the serving stack "
+                    "(host-boundary lint, lowering contracts, lock "
+                    "discipline).",
+    )
+    parser.add_argument(
+        "--checker", choices=("all", "host", "lowering", "lock"),
+        default="all",
+    )
+    parser.add_argument(
+        "--no-trace", action="store_true",
+        help="lowering auditor: static (AST) layer only — skip the "
+             "abstract trace of each registered program",
+    )
+    parser.add_argument(
+        "--contracts", metavar="MODULE",
+        help="import MODULE and audit its REGISTRY instead of the "
+             "built-in one (fixture/testing hook)",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="explicit .py files to lint (host + lock checkers only); "
+             "default: the audited package modules",
+    )
+    args = parser.parse_args(argv)
+
+    if args.contracts and args.no_trace:
+        # An external registry has ONLY the trace layer — static-only
+        # would silently audit nothing.
+        print(
+            "--contracts audits an external registry's lowerings; "
+            "--no-trace would skip the only layer it has",
+            file=sys.stderr,
+        )
+        return 2
+    if args.paths and args.checker == "lowering":
+        # The lowering auditor works from the contract registry, not
+        # from source paths — "clean" here would mean "never ran".
+        print(
+            "--checker lowering audits the contract registry and does "
+            "not take file paths (use --checker host/lock/all with "
+            "paths)",
+            file=sys.stderr,
+        )
+        return 2
+
+    findings: List[Finding] = []
+    try:
+        if args.paths:
+            findings.extend(_file_findings(args.paths, args.checker))
+        else:
+            if args.checker in ("all", "host"):
+                findings.extend(HostBoundaryChecker().check_package())
+            if args.checker in ("all", "lock"):
+                findings.extend(LockDisciplineChecker().check_package())
+        if args.checker in ("all", "lowering") and not args.paths:
+            if args.contracts:
+                # External registry: audit ITS programs' lowerings only
+                # (the static coverage layer is about the package's own
+                # modules and would mis-fire against a fixture registry).
+                from .lowering import check_traces
+
+                registry = importlib.import_module(args.contracts).REGISTRY
+                findings.extend(check_traces(registry))
+            else:
+                findings.extend(
+                    LoweringAuditor().check_package(
+                        trace=not args.no_trace
+                    )
+                )
+    except Exception as e:  # noqa: BLE001 - CLI boundary
+        print(f"analysis failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if args.json:
+        print(json.dumps([vars(f) for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(
+            f"invariant audit: {n} finding{'s' if n != 1 else ''}"
+            + ("" if n else " — clean")
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,363 @@
+"""Lowering auditor: jaxpr/StableHLO contract checks for jitted programs.
+
+Three layers, cheapest first (contracts.py holds the registry):
+
+  1. **Coverage + decorator audit (static, AST).**  Every jit-decorated
+     module-level function in serving.py / kvcache.py must have a
+     registered :class:`~.contracts.ProgramContract` (new programs must
+     JOIN the registry to be dispatched), the registry must not hold
+     stale names, and each program's ``donate_argnames`` /
+     ``donate_argnums`` decorator must match its contract exactly —
+     in BOTH directions (a dropped donation silently doubles KV HBM; an
+     undeclared one silently invalidates the host's buffer reuse).
+  2. **Donation resolution (abstract trace).**  The program is
+     ``.lower()``-ed at the contract's tiny example shape (CPU-safe:
+     lowering records ``tf.aliasing_output`` even on backends that drop
+     donation at compile time).  Every leaf of every donated argument
+     must actually resolve to an input-output alias — donated-but-
+     unusable buffers (shape/dtype drift between an input and its
+     carried output) are exactly how "donated" state quietly starts
+     copying.
+  3. **Host-fetch surface + forbidden equations (abstract trace).**
+     The outputs NOT aliased onto donations are what the host can
+     fetch: their count must not exceed ``max_live_outputs`` (the
+     "1 packed fetch" discipline) and their bytes-per-batch-row must
+     fit ``max_fetch_bytes_per_row`` (a [B, V] logits leak fails
+     immediately).  Finally the traced jaxpr — recursively through
+     scan/cond/while sub-jaxprs — must contain no copy-class equation
+     (broadcast, gather, dynamic-slice, concatenate, transpose,
+     convert, copy) producing a full-pool-sized or one-plane-sized
+     array: the abstract version of test_tpu_compiled.py's
+     no-full-pool-copy HLO pins, enforceable on any backend.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .common import (
+    Finding, iter_package_sources, jit_decorations, parse_module,
+)
+from .contracts import (
+    CONTRACT_MODULES, NON_DISPATCHED, REGISTRY, ProgramContract,
+    pool_shapes,
+)
+
+CHECKER = "lowering"
+
+# Copy-class primitives: producing a pool-sized result through any of
+# these means XLA will materialize a full-pool copy (scatter /
+# dynamic_update_slice are the sanctioned in-place writes and are NOT
+# listed).
+FORBIDDEN_PRIMITIVES = frozenset({
+    "broadcast_in_dim", "gather", "dynamic_slice", "concatenate",
+    "transpose", "rev", "copy", "convert_element_type", "select_n",
+    "pad", "iota",
+})
+
+_ALIAS_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+
+
+# ---------------------------------------------------------------------------
+# Static layer
+# ---------------------------------------------------------------------------
+
+def _declared_donations(
+    fn: ast.FunctionDef, dec: Optional[ast.Call]
+) -> Tuple[str, ...]:
+    """donate_argnames (or argnums mapped through the signature) the
+    decorator declares."""
+    if dec is None:
+        return ()
+    names: List[str] = []
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in dec.keywords:
+        if kw.arg == "donate_argnames":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    names.append(elt.value)
+        elif kw.arg == "donate_argnums":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, int
+                ):
+                    if elt.value < len(params):
+                        names.append(params[elt.value])
+                    else:
+                        names.append(f"<argnum {elt.value} OOB>")
+    return tuple(names)
+
+
+def check_static(
+    registry: Dict[str, ProgramContract] = REGISTRY,
+    modules: Sequence[str] = CONTRACT_MODULES,
+    non_dispatched: frozenset = NON_DISPATCHED,
+) -> List[Finding]:
+    """Coverage + decorator audit over the contract modules' sources."""
+    findings: List[Finding] = []
+    seen: Dict[str, Tuple[str, ast.FunctionDef, Optional[ast.Call]]] = {}
+    for path, source in iter_package_sources(only=modules):
+        tree, errs = parse_module(path, source, CHECKER)
+        findings.extend(errs)
+        if tree is None:
+            continue
+        for name, (fn, dec) in jit_decorations(tree).items():
+            seen[name] = (path, fn, dec)
+
+    for name, (path, fn, dec) in sorted(seen.items()):
+        if name in non_dispatched:
+            continue
+        contract = registry.get(name)
+        if contract is None:
+            findings.append(Finding(
+                checker=CHECKER, rule="unregistered-program",
+                path=path, line=fn.lineno,
+                message=(
+                    f"jitted program {name!r} has no lowering contract "
+                    "— register it in analysis/contracts.py (donated "
+                    "args, fetch budget, forbidden shapes) before the "
+                    "batcher may dispatch it"
+                ),
+            ))
+            continue
+        declared = _declared_donations(fn, dec)
+        if tuple(sorted(declared)) != tuple(sorted(contract.donated)):
+            findings.append(Finding(
+                checker=CHECKER, rule="donation-mismatch",
+                path=path, line=fn.lineno,
+                message=(
+                    f"{name}: decorator donates {sorted(declared)} but "
+                    f"the contract declares {sorted(contract.donated)} "
+                    "— update whichever is wrong (both are load-"
+                    "bearing: donation drops double HBM silently)"
+                ),
+            ))
+    for name, contract in sorted(registry.items()):
+        if name not in seen:
+            findings.append(Finding(
+                checker=CHECKER, rule="stale-contract",
+                path=contract.module.replace(".", "/") + ".py", line=0,
+                message=(
+                    f"contract {name!r} names a jitted program that no "
+                    "longer exists in its module"
+                ),
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Trace layer
+# ---------------------------------------------------------------------------
+
+def _aval_bytes(aval) -> int:
+    return int(math.prod(aval.shape)) * aval.dtype.itemsize
+
+
+def _walk_jaxprs(jaxpr) -> Iterable[Any]:
+    """Yield every equation in a (Closed)Jaxpr, recursing into
+    sub-jaxprs (scan/while/cond/pjit bodies)."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    yield from _walk_jaxprs(sub)
+
+
+def _resolve_program(contract: ProgramContract):
+    import importlib
+
+    mod = importlib.import_module(contract.module)
+    return getattr(mod, contract.name)
+
+
+def _batch_rows(args: tuple, argnames: Tuple[str, ...]) -> int:
+    """Example batch size: rows of the first per-row state array."""
+    for name, arg in zip(argnames, args):
+        if name in ("tau", "fill", "active", "prompt_tokens",
+                    "suffix_tokens"):
+            return int(arg.shape[0])
+    return 1
+
+
+def check_lowering(
+    contract: ProgramContract,
+    path_hint: Optional[str] = None,
+) -> List[Finding]:
+    """Trace ``contract``'s program at its example shape and audit the
+    donation resolution, host-fetch surface and forbidden equations."""
+    import jax.tree_util as jtu
+
+    findings: List[Finding] = []
+    path = path_hint or contract.module.replace(".", "/") + ".py"
+    if contract.build is None:
+        return findings
+    program = _resolve_program(contract)
+    argnames, args, kwargs = contract.build()
+    # ONE abstract trace serves both layers: the Traced carries the
+    # jaxpr (forbidden-equation walk) and lowers into the StableHLO
+    # whose arg attributes carry the alias resolution.
+    traced = program.trace(*args, **kwargs)
+    lowered = traced.lower()
+
+    # -- donation resolution -------------------------------------------------
+    # args_info is ((per-positional-arg trees...), kwargs-dict); each
+    # leaf records whether jit will donate it.
+    donated_leaves = 0
+    for name, info in zip(argnames, lowered.args_info[0]):
+        leaves = jtu.tree_leaves(info)
+        want = name in contract.donated
+        got = [bool(leaf.donated) for leaf in leaves]
+        donated_leaves += sum(got)
+        if want and not all(got):
+            findings.append(Finding(
+                checker=CHECKER, rule="donation-not-applied",
+                path=path, line=0,
+                message=(
+                    f"{contract.name}: contract donates {name!r} but "
+                    f"{len(got) - sum(got)}/{len(got)} of its leaves "
+                    "are not donated at trace time"
+                ),
+            ))
+        elif not want and any(got):
+            findings.append(Finding(
+                checker=CHECKER, rule="donation-not-applied",
+                path=path, line=0,
+                message=(
+                    f"{contract.name}: argument {name!r} is donated at "
+                    "trace time but the contract does not declare it"
+                ),
+            ))
+
+    text = lowered.as_text()
+    aliased_outputs = {int(m) for m in _ALIAS_RE.findall(text)}
+    if len(aliased_outputs) != donated_leaves:
+        findings.append(Finding(
+            checker=CHECKER, rule="donation-unresolved",
+            path=path, line=0,
+            message=(
+                f"{contract.name}: {donated_leaves} leaves are donated "
+                f"but only {len(aliased_outputs)} resolve to an "
+                "input-output alias — a donated buffer with no aliased "
+                "output is silently copied instead of reused"
+            ),
+        ))
+
+    # -- host-fetch surface --------------------------------------------------
+    out_avals = traced.jaxpr.out_avals
+    live = [
+        (i, aval) for i, aval in enumerate(out_avals)
+        if i not in aliased_outputs
+    ]
+    if len(live) > contract.max_live_outputs:
+        findings.append(Finding(
+            checker=CHECKER, rule="fetch-count",
+            path=path, line=0,
+            message=(
+                f"{contract.name}: {len(live)} outputs are not aliased "
+                f"onto donated inputs (contract allows "
+                f"{contract.max_live_outputs}) — every live output is "
+                "host-fetchable surface; pack or donate it"
+            ),
+        ))
+    rows = _batch_rows(args, argnames)
+    live_bytes = sum(_aval_bytes(a) for _, a in live)
+    budget = contract.max_fetch_bytes_per_row * rows
+    if live_bytes > budget:
+        findings.append(Finding(
+            checker=CHECKER, rule="fetch-bytes",
+            path=path, line=0,
+            message=(
+                f"{contract.name}: live outputs total {live_bytes} B "
+                f"for {rows} rows (contract: "
+                f"{contract.max_fetch_bytes_per_row} B/row = {budget} "
+                "B) — something vocab-sized or per-position is "
+                "escaping to the host"
+            ),
+        ))
+
+    # -- forbidden pool-shaped equations -------------------------------------
+    if contract.forbid_pool_shapes:
+        shapes = set()
+        if contract.forbidden_shapes is not None:
+            shapes.update(
+                tuple(s) for s in contract.forbidden_shapes(args)
+            )
+        else:
+            for name, arg in zip(argnames, args):
+                for leaf in jtu.tree_leaves(
+                    arg, is_leaf=lambda x: hasattr(x, "block_size")
+                    and hasattr(x, "k")
+                ):
+                    if hasattr(leaf, "block_size") and hasattr(
+                        leaf, "k"
+                    ):
+                        shapes.update(pool_shapes(leaf))
+        if not shapes:
+            # An empty forbidden set would make the full-pool-copy
+            # check pass vacuously — the silent-cap failure mode.
+            findings.append(Finding(
+                checker=CHECKER, rule="no-forbidden-shapes",
+                path=path, line=0,
+                message=(
+                    f"{contract.name}: forbid_pool_shapes is set but "
+                    "no pool shapes are derivable from the example "
+                    "args — give the contract a forbidden_shapes "
+                    "callable (or set forbid_pool_shapes=False with "
+                    "justification)"
+                ),
+            ))
+        hits: List[str] = []
+        if shapes:
+            for eqn in _walk_jaxprs(traced.jaxpr):
+                prim = getattr(eqn.primitive, "name", str(eqn.primitive))
+                if prim not in FORBIDDEN_PRIMITIVES:
+                    continue
+                for outvar in eqn.outvars:
+                    shape = tuple(getattr(outvar.aval, "shape", ()))
+                    if shape in shapes:
+                        hits.append(f"{prim} -> {shape}")
+        for hit in hits[:8]:
+            findings.append(Finding(
+                checker=CHECKER, rule="full-pool-copy",
+                path=path, line=0,
+                message=(
+                    f"{contract.name}: copy-class equation {hit} "
+                    "materializes a pool-sized array — the no-full-"
+                    "pool-copy invariant (doubles KV HBM, ms-class "
+                    "per-dispatch regression)"
+                ),
+            ))
+    return findings
+
+
+def check_traces(
+    registry: Dict[str, ProgramContract] = REGISTRY,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for name in sorted(registry):
+        findings.extend(check_lowering(registry[name]))
+    return findings
+
+
+class LoweringAuditor:
+    """Facade bundling the static and trace layers."""
+
+    def __init__(self, registry: Dict[str, ProgramContract] = REGISTRY):
+        self.registry = registry
+
+    def check_package(self, trace: bool = True) -> List[Finding]:
+        findings = check_static(self.registry)
+        if trace and not any(
+            f.rule in ("unregistered-program", "stale-contract",
+                       "syntax-error")
+            for f in findings
+        ):
+            findings.extend(check_traces(self.registry))
+        return findings

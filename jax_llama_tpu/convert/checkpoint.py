@@ -36,14 +36,12 @@ from pathlib import Path
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding
 
 from ..config import LLaMAConfig
 from ..models.llama import init_params
 from ..ops.quant import is_quantized, quantize_params
-from ..parallel.partition import param_partition_specs
 
 MANIFEST_NAME = "manifest.json"
 

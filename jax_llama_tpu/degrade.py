@@ -128,6 +128,8 @@ class DegradeManager:
                 "quarantine_transition", feature=feature, state=state
             )
 
+    # audit: locked(every caller is a public method that already holds
+    # self._lock around this lookup)
     def _get(self, name: str) -> _Feature:
         if name not in self._features:
             raise KeyError(

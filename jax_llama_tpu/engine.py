@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .config import LLaMAConfig
-from .models.llama import KVCache, forward, init_cache
+from .models.llama import forward, init_cache
 from .ops.sampling import sample
 from .parallel.mesh import use_mesh
 

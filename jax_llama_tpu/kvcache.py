@@ -54,7 +54,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 from collections import OrderedDict
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -589,6 +589,9 @@ def fetch_slab(pool, blk: int, prefix: str = "") -> Dict[str, np.ndarray]:
     for name in _pool_names(pool):
         arr = getattr(pool, name)
         sl = arr[blk] if name == "pos" else arr[:, :, blk]
+        # audit: host-fetch(demotion D2H on the admission/capacity
+        # path — counted in swap_out_blocks_total, never in
+        # host_syncs_total, see _demote_block)
         out[prefix + name] = np.asarray(sl)
     return out
 
@@ -625,6 +628,9 @@ def stage_restore(
             stacked = np.concatenate(
                 [stacked, np.zeros(pad_shape, stacked.dtype)], axis=axis
             )
+        # audit: host-upload(slab staging H2D, deliberately OFF the
+        # pool's dependency chain — the async transfer decode chunks
+        # never queue behind; one per restored pool field)
         staged[name] = jax.device_put(stacked)
     return staged
 

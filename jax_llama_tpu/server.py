@@ -640,6 +640,9 @@ class LLMServer:
                 # Admission bound: each blocked POST holds an OS thread for
                 # the full generation, so an unbounded inbox is an
                 # unbounded thread/memory leak under flood.
+                # audit: racy-read(admission-bound estimate: _active
+                # is mutated by the loop thread; an off-by-a-few depth
+                # only shifts when the 503 overload refusal fires)
                 depth = server._inbox.qsize() + len(server._active)
                 if depth >= server.max_queue:
                     self._reply_json(
@@ -848,6 +851,8 @@ class LLMServer:
         return f"http://{host}:{port}"
 
     def start(self) -> "LLMServer":
+        # audit: unguarded(happens-before: the loop/watchdog threads
+        # start below, after this write)
         self._heartbeat = time.monotonic()
         self._loop_thread.start()
         if self._watchdog_thread is not None:
@@ -1206,12 +1211,18 @@ class LLMServer:
             age = time.monotonic() - self._heartbeat
             if age > self.watchdog_deadline_s:
                 if not self._stalled:
+                    # audit: unguarded(single-writer: only the watchdog
+                    # thread mutates _stalled / its counter; readers
+                    # see a GIL-atomic bool/int snapshot)
                     self._stalled = True
+                    # audit: unguarded(single-writer: watchdog thread
+                    # only; readers snapshot a GIL-atomic int)
                     self.watchdog_stalls_total += 1
                     self._log(
                         "watchdog_stall", last_step_age_s=round(age, 3)
                     )
             else:
+                # audit: unguarded(single-writer: watchdog thread only)
                 self._stalled = False
 
     def _health(self) -> Dict[str, Any]:
@@ -1244,6 +1255,9 @@ class LLMServer:
             "degraded": self.degrade.degraded(),
             "quarantined": list(self.degrade.quarantined()),
             "kv": {
+                # audit: racy-read(point-in-time /healthz snapshot of
+                # loop-owned batcher state: len()/count reads are
+                # GIL-atomic, a scrape may be one step stale)
                 "prefix_index": getattr(
                     self.batcher, "prefix_index", "off"
                 ),

@@ -151,7 +151,7 @@ import dataclasses
 import functools
 import hashlib
 import time
-from collections import OrderedDict, deque
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -2179,10 +2179,22 @@ class ContinuousBatcher:
         return self.drafts_accepted / self.drafts_proposed
 
     def stats(self) -> Dict[str, float]:
-        """Counters for observability (the HTTP /metrics endpoint)."""
+        """Counters for observability (the HTTP /metrics endpoint).
+
+        Runs on HTTP handler threads while the serving loop owns the
+        batcher: every read below is a point-in-time snapshot of
+        single-writer state (GIL-consistent; a scrape may be one step
+        stale, never torn).  ``_pf`` is snapshotted into a local first
+        — the loop can null it between a check and a dereference (the
+        TOCTOU the lock-discipline checker flagged)."""
+        # audit: racy-read(point-in-time /metrics snapshot of
+        # single-writer loop state; stale by <= 1 step, never torn)
+        pf = self._pf
         out: Dict[str, float] = {} if self.fault_injector is None else (
             dict(self.fault_injector.stats())
         )
+        # audit: racy-read(point-in-time /metrics snapshot of
+        # single-writer loop state; stale by <= 1 step, never torn)
         out.update({
             "emitted_tokens_total": self.emitted_total,
             "decode_steps_total": self.steps_total,
@@ -2253,7 +2265,7 @@ class ContinuousBatcher:
             # admissions cost (≈0 once fused scheduling is on).
             "prefill_budget": self.prefill_budget,
             "prefill_tokens_inflight": (
-                self._pf.remaining_tokens if self._pf is not None else 0
+                pf.remaining_tokens if pf is not None else 0
             ),
             "prefill_chunks_total": self.prefill_chunks_total,
             "fused_admissions_total": self.fused_admissions_total,
@@ -2262,11 +2274,19 @@ class ContinuousBatcher:
         return out
 
     def _window_acceptance(self) -> float:
-        """Acceptance rate over the recent spec-dispatch window."""
-        proposed = sum(p for p, _ in self._accept_window)
+        """Acceptance rate over the recent spec-dispatch window.
+
+        Called from /metrics handler threads: iterating the live deque
+        while the loop appends raises RuntimeError mid-scrape, so take
+        an atomic ``list()`` snapshot first (C-level copy under the
+        GIL) — the race the lock-discipline checker flagged."""
+        # audit: racy-read(atomic list() snapshot of the single-writer
+        # window; a scrape may miss the newest dispatch, never crash)
+        window = list(self._accept_window)
+        proposed = sum(p for p, _ in window)
         if not proposed:
             return 0.0
-        return sum(a for _, a in self._accept_window) / proposed
+        return sum(a for _, a in window) / proposed
 
     def step(self) -> List[Tuple]:
         """One decode dispatch for every active slot.
@@ -2318,6 +2338,8 @@ class ContinuousBatcher:
             # admit through the same classic insert program even with
             # the queue empty (``_restored_ready``), so in-flight and
             # landed restores arm the barrier too.
+            # audit: host-fetch(deferred-error barrier before admission
+            # overwrites dispatch attribution; counted)
             np.asarray(self.tau)
             self.host_syncs_total += 1
         self._admit()
@@ -2427,6 +2449,7 @@ class ContinuousBatcher:
             # Surface any async admission-dispatch error NOW, while
             # last_dispatch_features still names the insert (the chunk's
             # _record_dispatch below would otherwise steal attribution).
+            # audit: host-fetch(post-admission error barrier; counted)
             np.asarray(self.tau)
             self.host_syncs_total += 1
         self._admits_at_last_chunk = self._admit_dispatches
@@ -2543,6 +2566,7 @@ class ContinuousBatcher:
         # THE one device->host sync of the chunk: tokens (+ bitcast
         # logprobs) in a single packed array.
         tf_obs = time.monotonic()
+        # audit: host-fetch(the one packed [B, K] fetch per chunk; counted)
         arr = np.asarray(packed)
         self.host_syncs_total += 1
         now_obs = time.monotonic()
@@ -2632,6 +2656,8 @@ class ContinuousBatcher:
         # the round so a completing request doesn't pay for one more
         # forward whose output would be discarded.
         out: List[Tuple] = []
+        # audit: host-fetch(classic spec path: per-round pending-tau
+        # emit fetch; counted)
         taus = np.asarray(self.tau)
         self.host_syncs_total += 1
         self.spec_host_syncs_total += 1
@@ -2709,6 +2735,7 @@ class ContinuousBatcher:
             # Surface any async admission-dispatch error NOW, while
             # last_dispatch_features still names the insert (see
             # _step_chunked).
+            # audit: host-fetch(post-admission error barrier; counted)
             np.asarray(self.tau)
             self.host_syncs_total += 1
             self.spec_host_syncs_total += 1
@@ -2754,6 +2781,8 @@ class ContinuousBatcher:
         # THE one device->host sync of the chunk: tokens, acceptance
         # counts and (bitcast) logprobs in a single packed array.
         tf_obs = time.monotonic()
+        # audit: host-fetch(the one packed [B, R, W] fetch per spec
+        # chunk; counted)
         arr = np.asarray(packed)  # [B, R, W]
         self.host_syncs_total += 1
         self.spec_host_syncs_total += 1
@@ -2914,11 +2943,16 @@ class ContinuousBatcher:
             with_logprobs=self.logprobs,
         )
         tf_obs = time.monotonic()
+        # audit: host-fetch(classic spec path: per-round outs fetch; counted)
         outs = np.asarray(outs)
+        # audit: host-fetch(classic spec path: per-round acceptance fetch;
+        # counted)
         acc = np.asarray(acc)
         self.host_syncs_total += 2
         self.spec_host_syncs_total += 2
         if self.logprobs:
+            # audit: host-fetch(classic spec path: per-round logprobs
+            # fetch; counted)
             lps = np.asarray(lps)
             self.host_syncs_total += 1
             self.spec_host_syncs_total += 1
@@ -3057,11 +3091,14 @@ class ContinuousBatcher:
             ids[: len(chunk)] = chunk
             self.pool = dataclasses.replace(
                 self.pool,
+                # audit: host-upload(eviction-batch id upload on the
+                # admission/capacity path, never per-token)
                 pos=_release_blocks(self.pool.pos, jnp.asarray(ids)),
             )
             if self.spec:
                 self.draft_pool = dataclasses.replace(
                     self.draft_pool,
+                    # audit: host-upload(draft-pool twin of the above)
                     pos=_release_blocks(
                         self.draft_pool.pos, jnp.asarray(ids)
                     ),
@@ -3397,7 +3434,13 @@ class ContinuousBatcher:
             # chunked path (plain or fused-spec) needs.
             self.d_tau_lp = self.d_tau_lp.at[idx].set(tau_lp[:k])
             if self.spec and self.spec_rounds == 1:
+                # audit: host-fetch(classic-spec admission: the numpy
+                # tau_lp mirror feeds the per-round emit scan; counted
+                # — was an uncounted sync until the host-boundary lint
+                # flagged it)
                 self.tau_lp[np.asarray(slots)] = np.asarray(tau_lp)[:k]
+                self.host_syncs_total += 1
+                self.spec_host_syncs_total += 1
         self.keys = self.keys.at[idx].set(keys_out[:k])
         for i, (req, chain, hits) in enumerate(grp):
             b = slots[i]
@@ -3567,6 +3610,8 @@ class ContinuousBatcher:
                 continue
             ready = restore_ready(r.staged)
             if not ready and idle:
+                # audit: host-fetch(blocking swap-in wait ONLY when
+                # nothing is decoding — nobody to stall)
                 jax.block_until_ready(list(r.staged.values()))
                 ready = True
             if not ready or r.polls <= self.swap_poll_min:
@@ -3917,6 +3962,9 @@ class ContinuousBatcher:
                 self._fault("flash_kernel")
             self._admit_dispatches += 1
             taus, tau_lps, plens, keys_out, self.pool = _paged_insert(
+                # audit: host-upload(admission-time prompt/state upload
+                # for the whole batch — once per admission round, never
+                # per-token)
                 self.params, self.pool, jnp.asarray(bid),
                 jnp.asarray(pt), jnp.asarray(pm), jnp.asarray(keys),
                 jnp.asarray(temps), jnp.asarray(top_ps),
@@ -3930,6 +3978,8 @@ class ContinuousBatcher:
                 # tau, and each row's key chain carries from the TARGET
                 # insert only).
                 _, _, _, _, self.draft_pool = _paged_insert(
+                    # audit: host-upload(draft-pool twin of the
+                    # admission-time upload above)
                     self.draft_params, self.draft_pool, jnp.asarray(bid),
                     jnp.asarray(pt), jnp.asarray(pm), jnp.asarray(keys),
                     jnp.zeros((kb,), jnp.float32),
@@ -3939,17 +3989,28 @@ class ContinuousBatcher:
                     prefill_chunk=self.prefill_chunk, mesh=self.mesh,
                 )
             slot_ids = [next(slot_iter) for _ in range(k)]
+            # audit: host-upload(slot-index upload, once per admission)
             idx = jnp.asarray(np.asarray(slot_ids, np.int32))
             self.tau = self.tau.at[idx].set(taus[:k])
             if self.logprobs:
                 self.d_tau_lp = self.d_tau_lp.at[idx].set(tau_lps[:k])
                 if self.spec and self.spec_rounds == 1:
+                    # audit: host-fetch(classic-spec admission: numpy
+                    # tau_lp mirror for the per-round emit scan;
+                    # counted — was an uncounted sync until the
+                    # host-boundary lint flagged it)
                     self.tau_lp[np.asarray(slot_ids)] = (
                         np.asarray(tau_lps)[:k]
                     )
+                    self.host_syncs_total += 1
+                    self.spec_host_syncs_total += 1
             self.keys = self.keys.at[idx].set(keys_out[:k])
             tf_obs = time.monotonic()
+            # audit: host-fetch(admission-path prompt-length fetch —
+            # blocks on the batched prefill; counted — was an
+            # uncounted sync until the host-boundary lint flagged it)
             plens_np = np.asarray(plens)
+            self.host_syncs_total += 1
             now_obs = time.monotonic()
             # Whole-prompt insert dispatch span: the plens fetch blocks
             # on the prefill, so wall here is the real admission cost

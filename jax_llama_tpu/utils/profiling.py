@@ -18,7 +18,7 @@ import re
 import shutil
 import tempfile
 import time
-from typing import Callable, Dict, Iterator, Optional
+from typing import Callable, Dict, Iterator
 
 import jax
 

@@ -81,6 +81,14 @@ def pytest_configure(config):
         "default tier-1 run — select just them with pytest -m obs "
         "or make obs)",
     )
+    config.addinivalue_line(
+        "markers",
+        "analysis: invariant-auditor tests (host-boundary lint, "
+        "lowering contracts, lock discipline — jax_llama_tpu.analysis; "
+        "the static package-cleanliness gates run in tier-1, the "
+        "abstract-trace layer is also marked slow — select just them "
+        "with pytest -m analysis or make lint-invariants)",
+    )
 
 
 # ---------------------------------------------------------------------------

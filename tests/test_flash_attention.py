@@ -412,8 +412,6 @@ def _dense_weights(q, k, q_pos, kv_pos):
 
 @pytest.mark.slow  # interpret-mode Pallas / long decode on CPU; out of the tier-1 budget (plain `pytest tests/` still runs it)
 def test_flash_dropout_mask_is_inverted_bernoulli():
-    import jax
-
     B, T, S, H, d = 1, 64, 64, 2, 64
     rng = np.random.RandomState(3)
     q = rng.randn(B, T, H, d).astype(np.float32) * 0.2

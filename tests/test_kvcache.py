@@ -545,7 +545,7 @@ def test_cancel_mid_restore_unpins_everything(model):
     cb.submit(list(session), max_new_tokens=4)
     cb.run_to_completion()
     assert cb.demote_idle(2) == 2
-    r0 = cb.submit([3, 5, 9], max_new_tokens=40)
+    cb.submit([3, 5, 9], max_new_tokens=40)
     cb.step()
     cb.step()
     cb.swap_poll_min = 100  # keep the restore in flight
@@ -593,7 +593,7 @@ def test_broken_restore_path_requeues_cold(model):
     # (the parent) with one host-tier node — the mixed shape finding 2
     # needs.
     assert cb.demote_idle(1) == 1
-    r0 = cb.submit([3, 5, 9], max_new_tokens=40)
+    cb.submit([3, 5, 9], max_new_tokens=40)
     cb.step()
     cb.swap_poll_min = 100  # hold the swap-in open
     rid = cb.submit(list(session), max_new_tokens=4)
@@ -661,7 +661,7 @@ def test_metrics_surface(model):
     rng = np.random.RandomState(61)
     session = rng.randint(1, 128, size=40).tolist()
     _seed_and_demote(cb, session, rng)
-    rid = cb.submit(list(session), max_new_tokens=4)
+    cb.submit(list(session), max_new_tokens=4)
     cb.run_to_completion()
     stats = cb.stats()
     for key in (

@@ -6,9 +6,7 @@ implementations, tight fp32 tolerances.
 """
 
 import numpy as np
-import jax
 import jax.numpy as jnp
-import pytest
 import torch
 
 from jax_llama_tpu.ops import (

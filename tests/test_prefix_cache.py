@@ -141,7 +141,7 @@ def test_cancel_sharer_keeps_other_alive(model):
 
     cb = ContinuousBatcher(params, config, n_slots=2, max_len=128,
                            block_size=16, prefix_cache=True)
-    r0 = cb.submit(list(prefix) + [1], max_new_tokens=2)
+    cb.submit(list(prefix) + [1], max_new_tokens=2)
     cb.run_to_completion()  # seed the cache
     ra = cb.submit(list(a), max_new_tokens=8)
     rb = cb.submit(list(b), max_new_tokens=8)
@@ -183,7 +183,7 @@ def test_chunked_suffix_and_logprobs(model):
             prefill_chunk=32, logprobs=True, prefix_cache=pc,
         )
         # Seed the cache with a short request sharing only the prefix.
-        r0 = cb.submit(list(prefix) + [7], max_new_tokens=2)
+        cb.submit(list(prefix) + [7], max_new_tokens=2)
         cb.run_to_completion()
         rid = cb.submit(list(prompt), max_new_tokens=6)
         out = []

@@ -8,7 +8,6 @@ oracle-checked.
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
 
 from jax_llama_tpu import get_config, init_params, make_mesh
 from jax_llama_tpu.models import forward

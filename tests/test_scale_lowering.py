@@ -11,7 +11,6 @@ as 4 — no Python-unrolled stack, reference model.py:579-592).
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from jax_llama_tpu import get_config, make_mesh
 from jax_llama_tpu.engine import GenerationConfig, generate

@@ -303,12 +303,16 @@ def _spec_matrix(models, R, *, logprobs=False, stop=(), int8=False,
     )
 
 
-# R=2 rides the slow tier (r06 budget rebalance: it is the same
-# contract as R=4 at a ~32 s price — the scan-length axis is already
-# spanned by the R=4 cell plus the R∈{1,2,4} cells of the stop/budget
-# tests below).
+# Both cells ride the slow tier (r06 rebalanced R=2 out; r08 moved
+# R=4 too — at ~30 s it was the single heaviest tier-1 test while the
+# suite sat within 1% of its 870 s budget).  The R>1 ≡ classic
+# identity class keeps tier-1 coverage through the stop-token /
+# non-finite mid-chunk cells below and the perf-smoke spec matrix;
+# the full greedy+sampled+acceptance-pattern matrix still runs in the
+# unfiltered suite (plain `pytest tests/`, `make chaos`).
 @pytest.mark.parametrize("R", [
-    pytest.param(2, marks=pytest.mark.slow), 4,
+    pytest.param(2, marks=pytest.mark.slow),
+    pytest.param(4, marks=pytest.mark.slow),
 ])
 def test_spec_rounds_token_identity_greedy_and_sampled(models, R):
     """R ∈ {2, 4} × {greedy, seeded-sampled} × max_new mid-chunk:
