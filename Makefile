@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs lint lint-invariants
+.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -55,6 +55,16 @@ kvcache:
 # plumbing and exposition live there).
 obs:
 	$(PYTEST) tests/test_obs.py tests/test_server.py -q -m 'not slow'
+
+# Overload control (overload.py): priority-class admission, the
+# cost-based deadline refusal, the brownout ladder's transitions and
+# hysteresis recovery, and the open-loop flood + ladder drills —
+# including the slow-marked acceptance drill (Poisson mixed-class
+# flood at >= 2x the sustainable rate: interactive attainment held,
+# batch shed with clean 503 + Retry-After, zero hung clients, ladder
+# stepped back to normal afterwards) that tier-1 excludes for time.
+overload:
+	$(PYTEST) tests/test_overload.py -q
 
 # Invariant auditor (jax_llama_tpu/analysis): host-boundary lint,
 # lowering-contract audit (donated args actually alias, host-fetch

@@ -30,6 +30,225 @@ V5E_HBM_BYTES_PER_S = 819e9     # HBM bandwidth
 V5E_BF16_FLOPS = 197e12         # MXU bf16 peak
 
 
+def load_harness(params, config, *, n_slots=8, max_len=1024,
+                 block_size=128, duration_s=6.0, max_requests=400,
+                 interactive_frac=0.5, seed=0,
+                 i_prompt=64, i_new=8, b_prompt=512, b_new=32):
+    """Open-loop (Poisson-arrival) load sweep over the HTTP server —
+    the closed loop for the overload controller (overload.py): offered
+    request rate vs goodput and per-class TTFT/ITL SLO attainment.
+
+    Three phases:
+      1. CALIBRATE: a closed-loop drain measures the sustainable
+         request rate, and a low-rate flood sets the TTFT SLO at
+         8x its median TTFT (attainment ~1.0 when healthy, degrading
+         under overload — the sweep's y-axis).
+      2. SWEEP (``serving_goodput_vs_rate``): floods at {0.5, 1, 2, 4}x
+         the sustainable rate, mixed interactive/batch traffic, ladder
+         + priority classes ON.  Each point reports per-class served/
+         refused/hung counts, TTFT percentiles, SLO attainment over
+         served requests, and goodput tokens/s.
+      3. A/B at 4x (``serving_overload_ladder_vs_static``): the same
+         flood against priority_classes=off (the pre-PR-9 static
+         max_queue 503) vs on — the record that the ladder holds
+         interactive attainment where the static config collapses,
+         with zero hung clients either way.  (4x, not 2x: the
+         sustainable anchor reads conservative — see phase 3.)
+
+    Pure host/HTTP-side measurement: the device work is the same
+    serving stack every other bench drives."""
+    from jax_llama_tpu.obs import Observability
+    from jax_llama_tpu.overload import (
+        open_loop_flood, poisson_schedule, summarize_flood,
+    )
+    from jax_llama_tpu.serving import ContinuousBatcher
+    from jax_llama_tpu.server import LLMServer
+
+    rng = np.random.RandomState(9000 + seed)
+    V = config.vocab_size
+    # Interactive: short chat-turn shape.  Batch: long-prompt bulk
+    # shape — the cost asymmetry the static depth count cannot see.
+    I_PROMPT, I_NEW = i_prompt, i_new
+    B_PROMPT, B_NEW = b_prompt, b_new
+
+    def payload_fn(i):
+        # Golden-ratio stride: a deterministic, well-interleaved mix
+        # at any fraction (blocks of one class would skew the short
+        # floods below).
+        interactive = (i * 0.6180339887) % 1.0 < interactive_frac
+        if interactive:
+            toks = rng.randint(1, V, I_PROMPT).tolist()
+            return {"prompt": toks, "max_new_tokens": I_NEW,
+                    "priority": "interactive", "stream": True,
+                    "timeout_s": 30.0}
+        toks = rng.randint(1, V, B_PROMPT).tolist()
+        return {"prompt": toks, "max_new_tokens": B_NEW,
+                "priority": "batch", "stream": True,
+                "timeout_s": 30.0}
+
+    def make_server(priority_on, slo_ttft_ms=None, slo_itl_ms=None):
+        obs = Observability(slo_ttft_ms=slo_ttft_ms,
+                            slo_itl_ms=slo_itl_ms)
+        cb = ContinuousBatcher(
+            params, config, n_slots=n_slots, max_len=max_len,
+            block_size=block_size, decode_chunk=16, prefill_budget=512,
+            obs=obs,
+        )
+        return LLMServer(
+            cb, max_queue=64, priority_classes=priority_on,
+            # React within the flood window: these are drill-scale
+            # dwell/cooldown, not the production defaults.
+            brownout_dwell_s=0.5, brownout_cooldown_s=2.0,
+            watchdog_deadline_s=None,
+        )
+
+    # -- phases 0/1: warmup + calibrate -------------------------------------
+    # The warmup burst compiles every program the floods will hit
+    # (multi-row inserts, the K ramp, fused prefill chunks); the SAME
+    # burst is then re-run timed for the sustainable rate, and a few
+    # SEQUENTIAL interactive requests (no queueing) set the TTFT SLO
+    # at 8x their median — attainment ~1.0 when healthy, degrading
+    # under overload.  Controller OFF here: the drill-scale dwell
+    # would let the ladder escalate (even shed) during the
+    # compile-stalled warmup, leaving batch-shape programs uncompiled
+    # and inflating the sustainable-rate anchor the whole sweep keys
+    # off.
+    n_cal = 2 * n_slots
+    with make_server(False) as srv:
+        open_loop_flood(
+            srv.address, [0.0] * n_cal, payload_fn,
+            timeout_s=600.0, join_timeout_s=900.0,
+        )
+        t0 = time.time()
+        open_loop_flood(
+            srv.address, [0.0] * n_cal, payload_fn,
+            timeout_s=120.0, join_timeout_s=300.0,
+        )
+        cal_wall = time.time() - t0
+        base_ttfts = []
+        for j in range(4):
+            r = open_loop_flood(
+                srv.address, [0.0], lambda i: payload_fn(0),
+                timeout_s=120.0, join_timeout_s=300.0,
+            )[0]
+            if r["ttft_ms"] is not None:
+                base_ttfts.append(r["ttft_ms"])
+    sustainable = n_cal / cal_wall
+    base_ttfts.sort()
+    base_ttft = (
+        base_ttfts[len(base_ttfts) // 2] if base_ttfts else 100.0
+    )
+    # 8x the UNLOADED median: an SLO that is attainable (~1.0) at the
+    # sustainable rate — normal queueing behind a few concurrent
+    # requests costs several unloaded-TTFTs — so the sweep measures
+    # overload degradation, not a bar nobody could hold (3x was
+    # already missed at 1x offered load).
+    slo_ttft_ms = max(50.0, round(8.0 * base_ttft, 1))
+
+    # -- phase 2: rate sweep, ladder on -------------------------------------
+    def flood(rate, priority_on):
+        # Adaptive window: at least ~24 expected arrivals per point
+        # (a 6 s window at a slow backend's sustainable rate would
+        # sample almost nothing), capped so the sweep stays bounded.
+        dur = min(60.0, max(duration_s, 24.0 / max(rate, 1e-6)))
+        sched = poisson_schedule(rate, dur, seed=seed + 1)
+        if len(sched) > max_requests:
+            # Truncation shortens the real flood window: goodput and
+            # the point's effective offered rate must be computed over
+            # the span actually flooded, not the nominal one.
+            sched = sched[:max_requests]
+            dur = sched[-1]
+        with make_server(priority_on, slo_ttft_ms=slo_ttft_ms) as srv:
+            recs = open_loop_flood(
+                srv.address, sched, payload_fn,
+                timeout_s=60.0, join_timeout_s=240.0,
+            )
+            summary = summarize_flood(
+                recs, slo_ttft_ms=slo_ttft_ms, duration_s=dur
+            )
+            h = srv.overload.health()
+            summary["rung_final"] = h["rung"]
+            summary["sheds"] = h["sheds_total"]
+            summary["refused"] = dict(h["refused"])
+        return summary
+
+    sweep = {}
+    for mult in (0.5, 1.0, 2.0, 4.0):
+        sweep[f"x{mult:g}"] = flood(sustainable * mult, True)
+
+    # -- phase 3: ladder vs static at 4x ------------------------------------
+    # 4x, not 2x: the sustainable estimate comes from a closed-loop
+    # burst drain and reads conservative, so 2x of it may not saturate
+    # a fast backend at all — 4x reliably lands in the regime the
+    # drill is about (the ISSUE criterion is ">= 2x").
+    def _ab_view(s):
+        return {
+            "interactive_attainment": s["interactive"]["slo_attainment"],
+            "interactive_ttft_ms_p99": s["interactive"]["ttft_ms_p99"],
+            "interactive_served": s["interactive"]["served"],
+            "batch_served": s["batch"]["served"],
+            "batch_refused_503": s["batch"]["refused_503"],
+            "timeouts_504": (
+                s["interactive"]["timeout_504"] + s["batch"]["timeout_504"]
+            ),
+            "hung_total": s["hung_total"],
+            "goodput_tokens_per_s": s.get("goodput_tokens_per_s"),
+            "rung_final": s.get("rung_final"),
+            "sheds": s.get("sheds"),
+        }
+
+    static = flood(sustainable * 4.0, False)
+    return {
+        "sustainable_req_per_s": round(sustainable, 2),
+        "slo_ttft_ms": slo_ttft_ms,
+        "mix": {
+            "interactive": {"prompt": I_PROMPT, "max_new": I_NEW},
+            "batch": {"prompt": B_PROMPT, "max_new": B_NEW},
+            "interactive_frac": interactive_frac,
+        },
+        "duration_s": duration_s,
+        "serving_goodput_vs_rate": sweep,
+        "serving_overload_ladder_vs_static": {
+            "offered_x_sustainable": 4.0,
+            "ladder": _ab_view(sweep["x4"]),
+            "static_max_queue": _ab_view(static),
+        },
+    }
+
+
+def load_harness_main() -> None:
+    """Standalone entry (``python bench.py --load-harness``): the
+    open-loop overload sweep on a small model, printed as one JSON
+    line.  CPU-safe — the harness measures controller behavior
+    (attainment held, sheds clean, zero hangs), not chip throughput;
+    the full-size TPU round embeds the same keys via main()."""
+    import jax
+    import jax_llama_tpu as jlt
+
+    config = jlt.get_config(
+        "llama3-8b",
+        dim=256, n_layers=4, n_heads=8, n_kv_heads=4,
+        multiple_of=128, vocab_size=4096, max_seq_len=1024,
+        param_dtype="float32" if jax.default_backend() == "cpu"
+        else "bfloat16",
+    )
+    params = jlt.init_params(jax.random.PRNGKey(0), config)
+    result = {
+        "metric": "open-loop overload sweep (goodput + per-class SLO "
+                  "attainment vs offered rate), small-model harness",
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "params": jlt.param_count(params),
+        # Lighter request shapes than the TPU round: the small-model
+        # harness proves controller BEHAVIOR, and a CPU backend's
+        # sustainable rate would make the full shapes crawl.
+        "detail": load_harness(
+            params, config, n_slots=4, b_prompt=256, b_new=16
+        ),
+    }
+    print(json.dumps(result))
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -514,6 +733,18 @@ def main() -> None:
 
     chat_bench()  # warmup (suffix-insert + fused-walk + restore programs)
     chat_ttft, sessions_resident = chat_bench()
+
+    # ------------------------------------------------------------------
+    # Overload: open-loop (Poisson) load sweep through the HTTP server
+    # (overload.py, r06) — goodput + per-class TTFT SLO attainment vs
+    # offered rate, and the ladder-vs-static A/B at 4x the sustainable
+    # rate (the drill the brownout ladder exists to win: interactive
+    # attainment held, batch shed cleanly, zero hung clients).
+    # ------------------------------------------------------------------
+    try:
+        overload_sweep = load_harness(params, config)
+    except Exception as e:  # the headline numbers must survive
+        overload_sweep = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
     # ------------------------------------------------------------------
     # Speculative serving.  The draft is the target NUDGED by ~2%
@@ -1231,6 +1462,14 @@ def main() -> None:
             # (revisits swap back in through the restoring state).
             "chat_prefix_hit_ttft_ms": chat_ttft,
             "sessions_resident_max": sessions_resident,
+            # Overload control (overload.py, r06): the open-loop
+            # Poisson sweep — per-class served/refused/attainment and
+            # goodput tokens/s at {0.5, 1, 2, 4}x the sustainable
+            # request rate with the brownout ladder on, plus the
+            # ladder-vs-static-max_queue A/B at 4x (interactive
+            # attainment held vs collapsed; all refusals 503 +
+            # Retry-After; hung_total must read 0 on both sides).
+            "serving_overload": overload_sweep,
             # Long-context paged serving (2 slots, 8k/16k contexts):
             # device-op ms per decode step, kernel vs gathered view at
             # identical pool geometry (xplane; wall would be tunnel-
@@ -1314,4 +1553,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--load-harness" in sys.argv[1:]:
+        load_harness_main()
+    else:
+        main()

@@ -100,6 +100,23 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
         module="server", cls="LLMServer", lock="_profiler_lock",
         fields=frozenset({"_profiler_dir"}),
     ),
+    # Overload controller (overload.py): HTTP handler threads call
+    # admit() while the serving loop pushes/pops/ticks — every access
+    # to the queues, EWMAs, ladder state, and counters goes under the
+    # one lock (its dispatch-record ingest is called OUTSIDE the obs
+    # lock, so the two locks never nest in either order).
+    LockGuard(
+        module="overload", cls="OverloadController", lock="_lock",
+        fields=frozenset({
+            "_queues", "_queued_tokens", "_inflight_tokens",
+            "_prefill_tps", "_decode_tps",
+            "_rung", "_rung_since", "_pressure_since", "_calm_since",
+            "_slo_windows", "_wait_window",
+            "transitions_total", "sheds_total",
+            "refused_backlog_total", "refused_deadline_total",
+            "refused_batch_total", "ttft_estimate_last_ms",
+        }),
+    ),
 )
 
 CONFINEMENTS: Tuple[ThreadConfinement, ...] = (

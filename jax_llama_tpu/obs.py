@@ -290,6 +290,45 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "slo_attainment": _reg(
         "gauge", "Fraction of recent requests meeting every configured "
                  "SLO (window 256)"),
+    # -- overload control (overload.py) --------------------------------------
+    "overload_rung": _reg(
+        "gauge", "Brownout-ladder rung (0=normal 1=elevated "
+                 "2=brownout-1 3=brownout-2 4=shed)"),
+    "overload_transitions_total": _reg(
+        "counter", "Brownout-ladder rung transitions (both directions)"),
+    "overload_sheds_total": _reg(
+        "counter", "Queued batch-class requests shed at the shed rung "
+                   "(each got a clean 503 + Retry-After)"),
+    "overload_refused_backlog_total": _reg(
+        "counter", "Admissions refused by the queue-depth backstop "
+                   "(503 + Retry-After)"),
+    "overload_refused_deadline_total": _reg(
+        "counter", "Admissions refused because the TTFT lower-bound "
+                   "estimate provably misses the request's timeout_s"),
+    "overload_refused_batch_total": _reg(
+        "counter", "Batch-class admissions refused while the ladder "
+                   "suspends the class (brownout-2 and above)"),
+    "queued_interactive": _reg(
+        "gauge", "Interactive-class requests waiting pre-admission"),
+    "queued_batch": _reg(
+        "gauge", "Batch-class requests waiting pre-admission"),
+    "prefill_tokens_per_s_ewma": _reg(
+        "gauge", "Observed prefill throughput EWMA (tokens/s; the "
+                 "admission cost model's denominator)"),
+    "decode_tokens_per_s_ewma": _reg(
+        "gauge", "Observed decode throughput EWMA (tokens/s)"),
+    "overload_ttft_estimate_ms": _reg(
+        "gauge", "Most recent admission-time TTFT lower-bound estimate "
+                 "(ms)"),
+    "overload_batch_max_new_cap": _reg(
+        "gauge", "Current brownout cap on batch-class max_new_tokens "
+                 "(0 = uncapped)"),
+    "slo_interactive_attainment": _reg(
+        "gauge", "Interactive-class SLO attainment over the ladder's "
+                 "recent signal window"),
+    "slo_batch_attainment": _reg(
+        "gauge", "Batch-class SLO attainment over the ladder's recent "
+                 "signal window"),
 }
 
 # Generated families: per-site injection counters, per-feature
@@ -392,6 +431,12 @@ class Observability:
         self._max_timelines = int(max_timelines)
         self._timelines: "OrderedDict[str, _Timeline]" = OrderedDict()
         self._by_rid: Dict[int, _Timeline] = {}
+        # Optional dispatch-record sink (overload.py's throughput
+        # EWMAs feed off it).  Called OUTSIDE self._lock with the
+        # already-built record dict — the sink takes its own lock, and
+        # calling out under ours would order the two locks.  Settable
+        # after construction (the server wires its controller here).
+        self.on_dispatch: Optional[Any] = None
         self.hist: Dict[str, Histogram] = {
             name: Histogram(name, help_text)
             for name, help_text in HISTOGRAMS.items()
@@ -581,19 +626,21 @@ class Observability:
         submit through the packed fetch (what the host actually waited);
         ``fetch_ms`` isolates the ``np.asarray`` device sync."""
         t = self._now_ms()
+        rec = {
+            "seq": -1, "kind": kind, "k": int(k),
+            "occupancy": int(occupancy),
+            "prefill_tokens": int(prefill_tokens),
+            "start_ms": round(t - wall_ms, 3),
+            "wall_ms": round(wall_ms, 3),
+            "fetch_ms": round(fetch_ms, 3),
+            "swap_inflight": int(swap_inflight),
+            "rids": list(rids),
+        }
         with self._lock:
             seq = self._seq
             self._seq += 1
-            self.dispatches.append({
-                "seq": seq, "kind": kind, "k": int(k),
-                "occupancy": int(occupancy),
-                "prefill_tokens": int(prefill_tokens),
-                "start_ms": round(t - wall_ms, 3),
-                "wall_ms": round(wall_ms, 3),
-                "fetch_ms": round(fetch_ms, 3),
-                "swap_inflight": int(swap_inflight),
-                "rids": list(rids),
-            })
+            rec["seq"] = seq
+            self.dispatches.append(rec)
             self.hist["dispatch_ms"].observe(wall_ms)
             if prefill_tokens > 0 or kind in ("insert", "suffix_insert"):
                 self.hist["prefill_chunk_ms"].observe(wall_ms)
@@ -608,7 +655,12 @@ class Observability:
                     sp.dispatches.append(seq)
                 else:
                     sp.dropped += 1
-            return seq
+        # Outside the lock: the overload controller's EWMA ingest takes
+        # its own lock (lock-order discipline; the record dict is
+        # already fully built and never mutated after this point).
+        if self.on_dispatch is not None:
+            self.on_dispatch(rec)
+        return seq
 
     def record_swap_in(self, ms: float, blocks: int) -> None:
         """A host-tier swap-in landed (staging start -> adoption)."""

@@ -186,6 +186,53 @@ def main() -> None:
                          "--http: a request passes when its WORST "
                          "token gap stays under it.  0 (default) "
                          "leaves the dimension unset")
+    ap.add_argument("--priority-classes", default="on",
+                    choices=["on", "off"],
+                    help="overload control for --http (overload.py): "
+                         "'on' (default) enables the optional "
+                         "per-request \"priority\" field (interactive "
+                         "| batch) with strict interactive-first "
+                         "admission, cost-based deadline refusals "
+                         "(503 + load-derived Retry-After when a "
+                         "request's timeout_s provably cannot be "
+                         "met), and the SLO-driven brownout ladder; "
+                         "'off' keeps plain FIFO admission with only "
+                         "the --max-queue depth backstop")
+    ap.add_argument("--max-queue", type=int, default=256,
+                    help="pre-admission queue depth backstop for "
+                         "--http: past it new POSTs are refused 503 + "
+                         "Retry-After (each blocked POST holds an OS "
+                         "thread, so this bounds handler-thread "
+                         "memory under flood)")
+    ap.add_argument("--brownout-attainment", type=float, default=0.85,
+                    help="brownout ladder escalation bar: escalate "
+                         "one rung when windowed interactive-class "
+                         "SLO attainment drops below this (needs "
+                         "--slo-ttft-ms / --slo-itl-ms to be scored)")
+    ap.add_argument("--brownout-recover-attainment", type=float,
+                    default=0.95,
+                    help="brownout ladder recovery bar: step DOWN one "
+                         "rung only once attainment is back at/above "
+                         "this (must be >= --brownout-attainment — "
+                         "the gap is the hysteresis band)")
+    ap.add_argument("--brownout-queue-wait-ms", type=float, default=0.0,
+                    help="queue-wait pressure bar for the ladder "
+                         "(recent pre-admission wait p90 above it = "
+                         "pressure); 0 derives 2x --slo-ttft-ms, or "
+                         "2000 ms when no TTFT SLO is set")
+    ap.add_argument("--brownout-dwell-s", type=float, default=2.0,
+                    help="pressure must persist this long before each "
+                         "one-rung escalation")
+    ap.add_argument("--brownout-cooldown-s", type=float, default=10.0,
+                    help="calm must persist this long before each "
+                         "one-rung recovery step")
+    ap.add_argument("--brownout-batch-max-new", type=int, default=64,
+                    help="batch-class max_new_tokens cap applied at "
+                         "brownout-1 (halves again at deeper rungs)")
+    ap.add_argument("--brownout-demote-blocks", type=int, default=32,
+                    help="idle KV blocks proactively demoted to the "
+                         "host tier on entering brownout-1 and deeper "
+                         "(no-op without --host-kv-blocks)")
     ap.add_argument("--log-json", action="store_true",
                     help="structured JSON logging: one JSON object per "
                          "operational log line (event / request_id / "
@@ -424,6 +471,29 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None,
             ),
             drain_timeout_s=drain_timeout_s,
             logger=logger,
+            max_queue=getattr(args, "max_queue", 256),
+            priority_classes=(
+                getattr(args, "priority_classes", "on") == "on"
+            ),
+            brownout_enter_attainment=getattr(
+                args, "brownout_attainment", 0.85
+            ),
+            brownout_exit_attainment=getattr(
+                args, "brownout_recover_attainment", 0.95
+            ),
+            brownout_queue_wait_ms=(
+                getattr(args, "brownout_queue_wait_ms", 0.0) or None
+            ),
+            brownout_dwell_s=getattr(args, "brownout_dwell_s", 2.0),
+            brownout_cooldown_s=getattr(
+                args, "brownout_cooldown_s", 10.0
+            ),
+            brownout_batch_max_new=getattr(
+                args, "brownout_batch_max_new", 64
+            ),
+            brownout_demote_blocks=getattr(
+                args, "brownout_demote_blocks", 32
+            ),
         ) as srv:
             endpoints = "POST /generate" + (
                 ", /chat" if chat_format is not None else ""

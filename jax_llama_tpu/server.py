@@ -115,6 +115,18 @@ parity.  Design constraints, in order:
         "swap_queue_depth": int,   # swap-ins in flight (restoring)
         "restored_waiting": int    # swapped in, awaiting a slot
       },
+      "overload": {            # overload controller (overload.py)
+        "enabled": bool,           # priority classes + ladder active
+        "rung": "normal"|"elevated"|"brownout-1"|"brownout-2"|"shed",
+        "rung_since_s": float,
+        "queued": {"interactive": int, "batch": int},
+        "queued_tokens": {"interactive": int, "batch": int},
+        "transitions_total": int,
+        "sheds_total": int,        # queued batch entries shed (503)
+        "refused": {"backlog": int, "deadline": int, "batch": int},
+        "prefill_tokens_per_s_ewma": float,
+        "interactive_attainment": float   # ladder's signal window
+      },
       "features": {            # per degradable feature
         "<name>": {"state": "healthy"|"quarantined"|"probing",
                     "failures_in_window": int, "failures_total": int,
@@ -193,6 +205,30 @@ error bodies (400/413/500/503/504) as ``"request_id"``, plus an
 header (<= 128 chars) — it is honored verbatim, so a failure is
 traceable from the client's logs without a join.
 
+Overload control (``overload.py``, run.py ``--priority-classes`` /
+``--brownout-*``): POST payloads may carry ``"priority"``
+("interactive" | "batch"; junk is a 400).  The server keeps per-class
+pre-admission queues with strict interactive-first ordering, admission
+is cost-based (an EWMA of observed prefill/decode throughput converts
+prompt length + backlog into a TTFT lower bound; a request whose
+``timeout_s`` provably cannot be met is refused 503 + load-derived
+``Retry-After`` immediately instead of queuing to die in the reaper),
+and an SLO-driven brownout ladder (normal -> elevated -> brownout-1 ->
+brownout-2 -> shed, hysteresis both ways) shrinks ``prefill_budget``,
+caps batch-class ``max_new``, proactively demotes idle KV blocks to
+the host tier, suspends batch admissions, and finally sheds queued
+batch entries (clean 503 + Retry-After — never a hang).  ``/metrics``
+gains ``llm_overload_rung`` (0=normal..4=shed),
+``llm_overload_transitions_total``, ``llm_overload_sheds_total``,
+``llm_overload_refused_{backlog,deadline,batch}_total``,
+``llm_queued_interactive`` / ``llm_queued_batch``,
+``llm_prefill_tokens_per_s_ewma`` / ``llm_decode_tokens_per_s_ewma``,
+``llm_overload_ttft_estimate_ms``, ``llm_overload_batch_max_new_cap``,
+and per-class ``llm_slo_interactive_attainment`` /
+``llm_slo_batch_attainment``; ``/healthz`` gains the ``overload``
+section (schema above).  Every ladder transition is a structured-log
+line, an obs annotation, and visible in both surfaces.
+
 Drain semantics: ``begin_drain()`` (run.py wires it to SIGTERM/SIGINT)
 finishes every in-flight request, answers new POSTs ``503`` with a
 ``Retry-After`` header, and exits the serving loop once idle — bounded
@@ -213,7 +249,9 @@ Endpoints:
                    decode with stop ids stripped.
   POST /generate   {"prompt": [ids]} or {"text": "..."} (needs tokenizer),
                    optional max_new_tokens / temperature / top_p / top_k /
-                   seed / stop_tokens / timeout_s / stream / logprobs
+                   seed / stop_tokens / timeout_s / stream / logprobs /
+                   priority ("interactive" default | "batch" — the
+                   overload controller's class; see above)
                    (per-token model logprobs; needs a logprobs=True
                    batcher — run.py --logprobs).
                    Default: blocks until the request finishes; returns
@@ -255,6 +293,7 @@ from urllib.parse import parse_qs, unquote, urlsplit
 
 from .degrade import DegradeManager
 from .obs import Observability, StructuredLogger, metric_meta
+from .overload import PRIORITIES, RUNG_INDEX, OverloadController
 from .serving import ContinuousBatcher, _round_up
 
 # Injection-site -> degradable-feature attribution for dispatch
@@ -343,6 +382,19 @@ class _Pending:
     last_tok_t: Optional[float] = None
     itl_max_ms: Optional[float] = None
     slo_accounted: bool = False
+    # Overload control (overload.py): the request's priority class
+    # ("interactive" | "batch"; validated in do_POST), its admission
+    # cost estimate in prompt tokens (exact for token prompts, a
+    # chars/4 heuristic for text/chat — it only feeds the TTFT lower
+    # bound and Retry-After, nothing token-exact), and the POST-arrival
+    # stamp the pre-admission queue wait is measured from.
+    priority: str = "interactive"
+    cost_tokens: int = 0
+    received_at: Optional[float] = None
+    # Retry-After (seconds) for a 503 delivered through fail() — set by
+    # the shed path so the reply carries the load-derived header even
+    # though the refusal happens long after do_POST returned.
+    retry_after_s: Optional[int] = None
 
     def fail(self, message: str, code: int) -> None:
         self.error = message
@@ -378,6 +430,15 @@ class LLMServer:
         drain_timeout_s: float = 30.0,
         max_body_bytes: int = 8 << 20,
         logger: Optional[StructuredLogger] = None,
+        priority_classes: bool = True,
+        overload: Optional[OverloadController] = None,
+        brownout_enter_attainment: float = 0.85,
+        brownout_exit_attainment: float = 0.95,
+        brownout_queue_wait_ms: Optional[float] = None,
+        brownout_dwell_s: float = 2.0,
+        brownout_cooldown_s: float = 10.0,
+        brownout_batch_max_new: int = 64,
+        brownout_demote_blocks: int = 32,
     ):
         self.batcher = batcher
         # Structured logging (obs.StructuredLogger; run.py --log-json):
@@ -416,6 +477,35 @@ class LLMServer:
         # dispatches that caused them (degrade.py only counts totals).
         if self.degrade.on_transition is None:
             self.degrade.on_transition = self.batcher.obs.annotate
+        # Overload controller (overload.py): per-class admission
+        # queues, the cost-based deadline refusal, and the brownout
+        # ladder.  Server-owned like the DegradeManager, so it survives
+        # batcher rebuilds; the dispatch sink feeds its throughput
+        # EWMAs from the obs records the loop already produces.
+        # ``priority_classes=False`` keeps the controller as a plain
+        # FIFO with only the depth backstop (the pre-PR-9 behavior,
+        # plus the Retry-After header the bare 503 lacked).
+        self.overload = overload if overload is not None else (
+            OverloadController(
+                enabled=priority_classes,
+                max_queue=max_queue,
+                enter_attainment=brownout_enter_attainment,
+                exit_attainment=brownout_exit_attainment,
+                queue_wait_ms=brownout_queue_wait_ms,
+                slo_ttft_ms=self.batcher.obs.slo_ttft_ms,
+                dwell_s=brownout_dwell_s,
+                cooldown_s=brownout_cooldown_s,
+                batch_max_new=brownout_batch_max_new,
+                demote_blocks=brownout_demote_blocks,
+            )
+        )
+        # The depth backstop now lives in the controller; an
+        # explicitly-injected controller brings its OWN max_queue, so
+        # mirror it back — ``server.max_queue`` must never disagree
+        # with the bound actually enforced.
+        self.max_queue = self.overload.max_queue
+        if self.batcher.obs.on_dispatch is None:
+            self.batcher.obs.on_dispatch = self.overload.on_dispatch
         # On-demand jax.profiler session (POST /debug/profiler): the
         # log_dir of the active trace, None when idle; the lock
         # serializes handler threads racing start/stop.
@@ -637,30 +727,29 @@ class LLMServer:
                 if is_debug:
                     self._reply_json(*server._handle_profiler(payload))
                     return
-                # Admission bound: each blocked POST holds an OS thread for
-                # the full generation, so an unbounded inbox is an
-                # unbounded thread/memory leak under flood.
-                # audit: racy-read(admission-bound estimate: _active
-                # is mutated by the loop thread; an off-by-a-few depth
-                # only shifts when the 503 overload refusal fires)
-                depth = server._inbox.qsize() + len(server._active)
-                if depth >= server.max_queue:
+                # Priority class (overload.py): optional "priority"
+                # field, strictly validated — junk is the client's
+                # defect (400), not a silent default that would let a
+                # typo'd "interactiv" jump the batch queue.
+                priority = payload.get("priority", "interactive")
+                if priority not in PRIORITIES:
                     self._reply_json(
-                        503, {"error": "server overloaded; retry later",
-                              "request_id": ext_id},
+                        400,
+                        {"error": (
+                            f'"priority" must be one of '
+                            f'{list(PRIORITIES)}, got {priority!r}'
+                        ), "request_id": ext_id},
                         headers=rid_hdr,
                     )
                     return
-                pending = _Pending(
-                    payload=payload, stream=bool(payload.get("stream")),
-                    chat=self.path == "/chat",
-                    want_lp=bool(payload.get("logprobs")),
-                    ext_id=ext_id,
-                )
+                # timeout_s parses BEFORE admission: the deadline-aware
+                # refusal needs it, and a malformed value must 400, not
+                # feed the cost model garbage.  NaN would make every
+                # deadline comparison False and silently disable the
+                # bound; inf is equally useless.
                 timeout_s = payload.get("timeout_s")
+                t = None
                 if timeout_s is not None:
-                    # NaN would make every deadline comparison False and
-                    # silently disable the bound; inf is equally useless.
                     try:
                         t = float(timeout_s)
                         if not math.isfinite(t):
@@ -673,7 +762,47 @@ class LLMServer:
                             headers=rid_hdr,
                         )
                         return
-                    pending.deadline = time.monotonic() + t
+                # Admission control (overload.py): the queue-depth
+                # backstop (each blocked POST holds an OS thread for
+                # the full generation, so an unbounded inbox is an
+                # unbounded thread/memory leak under flood), the
+                # brownout ladder's batch-class gate, and the
+                # cost-based deadline proof.  Every refusal is a 503
+                # with a load-derived Retry-After.
+                # audit: racy-read(admission-bound estimate: _active
+                # is mutated by the loop thread; an off-by-a-few depth
+                # only shifts when the 503 overload refusal fires)
+                depth = (
+                    server._inbox.qsize() + len(server._active)
+                    + server.overload.queued_total()
+                )
+                cost = server._cost_estimate(payload)
+                refusal = server.overload.admit(priority, cost, t, depth)
+                if refusal is not None:
+                    self._reply_json(
+                        503,
+                        {"error": refusal.reason, "request_id": ext_id},
+                        headers={
+                            "Retry-After": str(refusal.retry_after_s),
+                            **rid_hdr,
+                        },
+                    )
+                    return
+                now = time.monotonic()
+                pending = _Pending(
+                    payload=payload, stream=bool(payload.get("stream")),
+                    chat=self.path == "/chat",
+                    want_lp=bool(payload.get("logprobs")),
+                    ext_id=ext_id,
+                    priority=priority, cost_tokens=cost,
+                    # TTFT counts from POST arrival: with per-class
+                    # queues a request can wait pre-admission far
+                    # longer than the old always-drained inbox, and
+                    # the client's clock started here.
+                    received_at=now, submitted_at=now,
+                )
+                if t is not None:
+                    pending.deadline = now + t
                 server._inbox.put(pending)
                 if pending.stream:
                     self._stream_reply(pending)
@@ -727,6 +856,14 @@ class LLMServer:
                     self._reply_json(504, body, headers=rid_hdr)
                     return
                 if pending.error is not None:
+                    if pending.retry_after_s is not None:
+                        # Shed under overload: the 503 carries the
+                        # load-derived Retry-After like every other
+                        # refusal path.
+                        rid_hdr = {
+                            "Retry-After": str(pending.retry_after_s),
+                            **rid_hdr,
+                        }
                     self._reply_json(
                         pending.error_code,
                         {"error": pending.error,
@@ -750,19 +887,35 @@ class LLMServer:
 
             def _stream_reply(self, pending: "_Pending"):
                 """NDJSON token stream; body is close-delimited (no
-                Content-Length).  A failed socket write marks the request
-                disconnected; the loop cancels it at the next step."""
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "application/x-ndjson"
-                )
-                self.send_header("Cache-Control", "no-cache")
-                self.send_header("Connection", "close")
-                self.send_header("X-Request-Id", pending.ext_id)
-                self.end_headers()
+                Content-Length).  Response headers are DEFERRED until
+                the first event: a stream request that terminates
+                before emitting any token (shed under overload, queued
+                past its deadline, server drain) gets a REAL HTTP
+                error status — 503s with the load-derived Retry-After
+                — instead of a 200 stream whose only line is an error
+                (load balancers and retry layers act on status codes,
+                not NDJSON bodies).  A failed socket write marks the
+                request disconnected; the loop cancels it at the next
+                step."""
+                started = False
+
+                def start_stream() -> None:
+                    nonlocal started
+                    if started:
+                        return
+                    started = True
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-ndjson"
+                    )
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self.send_header("X-Request-Id", pending.ext_id)
+                    self.end_headers()
 
                 def emit(obj: Dict[str, Any]) -> bool:
                     try:
+                        start_stream()
                         self.wfile.write(json.dumps(obj).encode() + b"\n")
                         self.wfile.flush()
                         return True
@@ -799,6 +952,28 @@ class LLMServer:
                         )
                     if not emit(line):
                         return  # client gone; the loop reaps the request
+                if not started and not pending.tokens and (
+                    pending.error is not None or pending.timed_out
+                ):
+                    # Terminal before any token flowed: reply with the
+                    # real status (the stream never started, so the
+                    # status line is still ours to send).
+                    code = (
+                        504 if pending.timed_out else pending.error_code
+                    )
+                    headers = {"X-Request-Id": pending.ext_id}
+                    if pending.retry_after_s is not None:
+                        headers["Retry-After"] = str(
+                            pending.retry_after_s
+                        )
+                    self._reply_json(
+                        code,
+                        {"error": (
+                            pending.error or "generation timed out"
+                        ), "request_id": pending.ext_id},
+                        headers=headers,
+                    )
+                    return
                 final: Dict[str, Any] = {
                     "done": True,
                     "request_id": pending.ext_id,
@@ -843,6 +1018,21 @@ class LLMServer:
         p.slo_accounted = True
         self.obs.slo_account(
             p.ttft_ms, p.itl_max_ms, len(p.tokens), completed=completed
+        )
+        # Per-class window for the brownout ladder (overload.py) —
+        # the same pass/fail math as slo_account (an unset dimension
+        # always passes); the ladder reads the interactive window.
+        o = self.obs
+        ttft_ok = completed and (
+            o.slo_ttft_ms is None
+            or (p.ttft_ms is not None and p.ttft_ms <= o.slo_ttft_ms)
+        )
+        itl_ok = completed and (
+            o.slo_itl_ms is None
+            or p.itl_max_ms is None or p.itl_max_ms <= o.slo_itl_ms
+        )
+        self.overload.note_slo(
+            p.priority, ttft_ok, itl_ok, completed and ttft_ok and itl_ok
         )
 
     @property
@@ -901,6 +1091,54 @@ class LLMServer:
         if dl is None:
             return max(1, int(math.ceil(self.drain_timeout_s)))
         return max(1, int(math.ceil(dl - time.monotonic())))
+
+    @staticmethod
+    def _cost_estimate(payload: Dict[str, Any]) -> int:
+        """Admission-cost estimate in prompt tokens: exact for token
+        prompts, a chars/4 heuristic for text and chat dialogs (BPE
+        averages ~4 chars/token on English text).  Feeds only the
+        overload controller's TTFT lower bound and Retry-After — an
+        estimate by design, never token accounting."""
+        p = payload.get("prompt")
+        if isinstance(p, (list, tuple)):
+            return len(p)
+        text = payload.get("text")
+        if isinstance(text, str):
+            return max(1, len(text) // 4)
+        msgs = payload.get("messages")
+        if isinstance(msgs, list):
+            n = sum(
+                len(m["content"]) // 4
+                for m in msgs
+                if isinstance(m, dict)
+                and isinstance(m.get("content"), str)
+            )
+            # + a few framing tokens per message (role headers).
+            return max(1, n + 4 * len(msgs))
+        return 1
+
+    def _apply_overload_knobs(self, entering: bool = False) -> None:
+        """Apply the current brownout rung's knobs to the batcher
+        (loop thread only — the batcher has a single owner).  Called
+        on every ladder transition AND after every batcher rebuild: a
+        rebuilt batcher starts from the base ctor's prefill budget, so
+        the rung's shrink must be re-applied or a crash recovery would
+        silently reset the brownout.  ``entering=True`` additionally
+        fires the rung's one-shot host-tier demotion sweep (an
+        operational HBM-pressure release, not a steady-state drain).
+        The batch-class max_new cap is NOT applied here — it clamps at
+        ``_submit`` time, so it follows the ladder dynamically."""
+        kn = self.overload.knobs()
+        base = int(self._base_ctor[2].get("prefill_budget", 0) or 0)
+        if base > 0 and not self.batcher.spec:
+            # Shrink, never zero: prefill_budget=0 would flip the
+            # batcher to classic whole-prompt admission — the opposite
+            # of protecting ITL.
+            self.batcher.prefill_budget = max(
+                1, int(base * kn.prefill_budget_scale)
+            )
+        if entering and kn.demote_blocks > 0:
+            self.batcher.demote_idle(kn.demote_blocks)
 
     def __enter__(self) -> "LLMServer":
         return self.start()
@@ -972,6 +1210,16 @@ class LLMServer:
         for k in ("max_new_tokens", "top_k", "seed"):
             if payload.get(k) is not None:
                 kwargs[k] = int(payload[k])
+        # Brownout cap (overload.py): at brownout-1 and deeper the
+        # ladder caps batch-class generation budgets so each batch
+        # admission returns its slot and blocks sooner; interactive
+        # budgets are never touched.
+        cap = self.overload.knobs().batch_max_new_cap
+        if cap > 0 and p.priority == "batch":
+            kwargs["max_new_tokens"] = min(
+                int(kwargs.get("max_new_tokens", _SUBMIT_DEFAULT_MAX_NEW)),
+                cap,
+            )
         for k in ("temperature", "top_p"):
             if payload.get(k) is not None:
                 kwargs[k] = float(payload[k])
@@ -1046,6 +1294,36 @@ class LLMServer:
                     tokens=len(p.tokens),
                 )
                 p.fail("generation timed out", 504)
+
+    def _reap_preadmission(self) -> None:
+        """Deadline/disconnect reaping for requests still waiting in
+        the overload controller's class queues — the pre-admission arm
+        of ``_reap``.  These checks used to happen at inbox pop, but
+        the per-class queues can hold an entry much longer (a batch
+        request behind a brownout, anything behind a backlog)."""
+        expired, gone = self.overload.reap(time.monotonic())
+        for p in gone:
+            self._log("request_disconnected", request_id=p.ext_id)
+            p.finish()  # client vanished before admission
+        for p in expired:
+            # Expired while queued — the overload signature.  These
+            # worst-latency requests MUST hit the SLO window, or
+            # attainment reads healthy exactly when the server is
+            # drowning; and they get a terminal timeline + failed
+            # count even though no batcher rid ever existed, so
+            # /debug/requests/<id> explains the 504.
+            p.timed_out = True
+            self._slo_finalize(p, completed=False)
+            self.obs.request_rejected(
+                p.ext_id,
+                "generation timed out before admission "
+                "(server overloaded)",
+            )
+            self._log(
+                "request_timeout", "expired pre-admission",
+                request_id=p.ext_id,
+            )
+            p.fail("generation timed out", 504)
 
     def _attribute(self, exc: BaseException) -> Optional[str]:
         """Map a dispatch exception to the degradable feature that
@@ -1155,6 +1433,11 @@ class LLMServer:
         # Any un-credited step success died with the old batcher: the
         # exception that brought us here may have been its async work.
         self._pending_success = ()
+        # The brownout ladder's knobs survive the rebuild: a fresh
+        # batcher carries the BASE prefill budget, so re-apply the
+        # rung's shrink (controller state itself is server-owned and
+        # untouched by rebuilds, like the DegradeManager).
+        self._apply_overload_knobs()
         bs = self.batcher.block_size
         for p in old_active.values():
             prompt = list(p.prompt_tokens) + list(p.tokens)
@@ -1268,6 +1551,7 @@ class LLMServer:
                 "swap_queue_depth": len(self.batcher._restoring),
                 "restored_waiting": len(self.batcher._restored_ready),
             },
+            "overload": self.overload.health(),
             "features": features,
         }
 
@@ -1340,6 +1624,7 @@ class LLMServer:
                     idle = (
                         not self._active
                         and self._inbox.empty()
+                        and self.overload.queued_total() == 0
                         and not self.batcher.pending()
                     )
                     if idle:
@@ -1370,52 +1655,87 @@ class LLMServer:
                     self.probe_rebuilds_total += 1
                     self._log("probe_rebuild", features=",".join(due))
                     self._rebuild_and_replay()
-                # Admit whatever is waiting; block briefly when fully idle
-                # so shutdown and new work are both responsive.
+                # Drain the inbox into the controller's per-class
+                # queues (strict interactive-first ordering lives
+                # there); block briefly when fully idle so shutdown
+                # and new work are both responsive.
                 try:
-                    block = not self.batcher.pending()
+                    block = (
+                        not self.batcher.pending()
+                        and self.overload.queued_total() == 0
+                    )
                     while True:
                         p = self._inbox.get(block=block, timeout=0.05)
                         block = False
-                        if p.disconnected:
-                            p.finish()  # client vanished before admission
-                            continue
-                        if p.deadline is not None and (
-                            time.monotonic() >= p.deadline
-                        ):
-                            # Expired while waiting in the inbox — the
-                            # overload signature.  These worst-latency
-                            # requests MUST hit the SLO window, or
-                            # attainment reads healthy exactly when the
-                            # server is drowning; and they get a
-                            # terminal timeline + failed count even
-                            # though no batcher rid ever existed, so
-                            # /debug/requests/<id> explains the 504.
-                            p.timed_out = True
-                            self._slo_finalize(p, completed=False)
-                            self.obs.request_rejected(
-                                p.ext_id,
-                                "generation timed out before admission "
-                                "(server overloaded)",
-                            )
-                            self._log(
-                                "request_timeout", "expired pre-admission",
-                                request_id=p.ext_id,
-                            )
-                            p.fail("generation timed out", 504)
-                            continue
-                        try:
-                            self._submit(p)
-                        except (ValueError, TypeError, KeyError) as e:
-                            # Malformed payloads must never kill the
-                            # device-owning thread.  Deliberately NOT
-                            # SLO-scored: a 400 is the client's defect,
-                            # and letting bad payloads drag attainment
-                            # would let one misconfigured client page
-                            # the on-call for a healthy server.
-                            p.fail(str(e), 400)
+                        self.overload.push(p)
                 except queue.Empty:
                     pass
+                self._reap_preadmission()
+                # Brownout ladder (overload.py): evaluate the rung,
+                # apply its knobs on a transition, shed queued batch
+                # entries at the top rung.
+                tr = self.overload.tick()
+                if tr is not None:
+                    old, new = tr
+                    self._log(
+                        "overload_transition", f"{old} -> {new}",
+                        rung=new,
+                    )
+                    self.obs.annotate(
+                        "overload_transition", old=old, state=new
+                    )
+                    # The one-shot demotion sweep is an ESCALATION
+                    # pressure release only — re-firing it on recovery
+                    # steps would evict warm prefix KV exactly as
+                    # traffic returns.
+                    self._apply_overload_knobs(
+                        entering=RUNG_INDEX[new] > RUNG_INDEX[old]
+                    )
+                for p in self.overload.shed_batch():
+                    msg = (
+                        "shed under overload (brownout rung 'shed'); "
+                        "retry later"
+                    )
+                    p.retry_after_s = self.overload.retry_after_s()
+                    self.obs.request_rejected(p.ext_id, msg)
+                    self._log(
+                        "request_shed", request_id=p.ext_id,
+                        priority=p.priority,
+                    )
+                    # Deliberately NOT SLO-scored: a shed is the
+                    # controller protecting attainment — counting it
+                    # as a miss would wedge the ladder at 'shed'.
+                    p.fail(msg, 503)
+                # Submit interactive-first while free slots can take
+                # them; the rest wait ORDERED in the controller (the
+                # batcher's own queue is FIFO, so keeping it shallow
+                # is what makes interactive-first stick — at most
+                # ``free`` entries are committed to FIFO order ahead
+                # of a later interactive arrival).
+                # audit: unguarded(serving-loop thread — the batcher's
+                # owner — reading through its own holder alias)
+                free = sum(
+                    s is None for s in self.batcher.slots.values()
+                )
+                # audit: unguarded(owner-thread read, as above)
+                while len(self.batcher.queue) < free:
+                    p = self.overload.pop()
+                    if p is None:
+                        break
+                    if p.received_at is not None:
+                        self.overload.observe_queue_wait(
+                            (time.monotonic() - p.received_at) * 1000.0
+                        )
+                    try:
+                        self._submit(p)
+                    except (ValueError, TypeError, KeyError) as e:
+                        # Malformed payloads must never kill the
+                        # device-owning thread.  Deliberately NOT
+                        # SLO-scored: a 400 is the client's defect,
+                        # and letting bad payloads drag attainment
+                        # would let one misconfigured client page
+                        # the on-call for a healthy server.
+                        p.fail(str(e), 400)
                 self._reap()
                 if not self.batcher.pending():
                     continue
@@ -1500,6 +1820,11 @@ class LLMServer:
                 self._slo_finalize(p, completed=False)
                 p.fail(reason, code)
             self._active.clear()
+            # Pre-admission entries in the controller's class queues
+            # must drain too — a shed-proof client is one that never
+            # hangs, whatever queue it was waiting in.
+            for p in self.overload.drain_all():
+                p.fail(reason, code)
             while not self._inbox.empty():
                 p = self._inbox.get_nowait()
                 p.fail(reason, code)
@@ -1510,6 +1835,7 @@ class LLMServer:
         stats = dict(self.batcher.stats())
         stats.update(self.degrade.stats())
         stats.update(self.obs.metrics())
+        stats.update(self.overload.stats())
         stats.update({
             # Server-level fault tolerance (batcher counters above carry
             # the injection-site totals when an injector is attached).

@@ -83,6 +83,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "overload: overload-control tests (priority classes, "
+        "deadline-aware admission, brownout ladder, open-loop flood "
+        "drills — overload.py; CPU-safe, the core set runs in tier-1 "
+        "and the heavy acceptance drill is also marked slow — select "
+        "with pytest -m overload or make overload)",
+    )
+    config.addinivalue_line(
+        "markers",
         "analysis: invariant-auditor tests (host-boundary lint, "
         "lowering contracts, lock discipline — jax_llama_tpu.analysis; "
         "the static package-cleanliness gates run in tier-1, the "

@@ -989,3 +989,71 @@ def test_debug_profiler_endpoint(model, tmp_path):
     assert any(
         f for _, _, fs in os.walk(log_dir) for f in fs
     ), "profiler session wrote no trace files"
+
+
+def test_http_overload_refusal_503_carries_retry_after(model):
+    """The queue-depth overload 503 (ISSUE 9 satellite): it used to be
+    a bare 503 while the drain-mode 503 carried Retry-After — now both
+    do, load-derived, so retry layers back off instead of hammering."""
+    import time
+
+    from jax_llama_tpu.faults import FaultInjector
+
+    params, config = model
+    # A 20 ms injected step delay pins the resident in its slot long
+    # enough to observe the depth-1 refusal deterministically.
+    cb = ContinuousBatcher(
+        params, config, n_slots=1, max_len=256,
+        fault_injector=FaultInjector("step~1.0:delay=0.02"),
+    )
+    with LLMServer(cb, max_queue=1) as srv:
+        status, _ = _post(srv.address,
+                          {"prompt": [1, 2], "max_new_tokens": 2})
+        assert status == 200  # warm the compile caches
+        done = {}
+
+        def run():
+            done["resident"] = _post(
+                srv.address, {"prompt": [3, 4], "max_new_tokens": 60},
+                timeout=300,
+            )
+
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(0.4)  # resident admitted: depth budget consumed
+        try:
+            _post(srv.address, {"prompt": [5, 6], "max_new_tokens": 2})
+            assert False, "expected HTTP 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert int(e.headers["Retry-After"]) >= 1
+            body = json.loads(e.read())
+            assert "overloaded" in body["error"]
+            assert body["request_id"]  # refusals stay traceable
+        t.join(timeout=300)
+        assert not t.is_alive()
+        assert done["resident"][0] == 200  # the resident was untouched
+
+
+def test_healthz_overload_section(model):
+    """/healthz carries the overload controller's state (schema in the
+    server.py module docstring) next to the kv and features sections."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=32)
+    with LLMServer(cb) as srv:
+        status, body = _get(srv.address, "/healthz")
+        assert status == 200
+        ov = json.loads(body)["overload"]
+        assert ov["enabled"] is True
+        assert ov["rung"] == "normal"
+        assert set(ov["queued"]) == {"interactive", "batch"}
+        assert ov["refused"] == {
+            "backlog": 0, "deadline": 0, "batch": 0,
+        }
+        assert ov["transitions_total"] == 0
+        # priority_classes=False keeps the FIFO/backstop-only mode and
+        # says so in the same section.
+    cb2 = ContinuousBatcher(params, config, n_slots=1, max_len=32)
+    with LLMServer(cb2, priority_classes=False) as srv:
+        _, body = _get(srv.address, "/healthz")
+        assert json.loads(body)["overload"]["enabled"] is False
