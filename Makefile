@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants
+.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -65,6 +65,20 @@ obs:
 # stepped back to normal afterwards) that tier-1 excludes for time.
 overload:
 	$(PYTEST) tests/test_overload.py -q
+
+# Scale-out serving (parallel/serve_mesh.py + router.py): the full
+# mesh_serving suite including the slow matrices (tensor-only mesh,
+# sharded speculative chunk, host-tier restore under sharded
+# placement), the router fault drills, and the multichip_serving
+# dryrun round (sharded-chunk parity + mesh lowering contracts +
+# routed-replica token identity on the forced 8-host-device mesh —
+# what MULTICHIP_r06.json records; add `--record MULTICHIP_rNN.json`
+# to roll a new round).
+mesh-serve:
+	$(PYTEST) tests/test_serve_mesh.py tests/test_router.py -q
+	$(PYTEST) tests/test_faults.py -q -k router
+	$(PYTEST) tests/test_run_cli.py -q -k serve_mesh
+	env JAX_PLATFORMS=cpu python bench.py --multichip-serving
 
 # Invariant auditor (jax_llama_tpu/analysis): host-boundary lint,
 # lowering-contract audit (donated args actually alias, host-fetch

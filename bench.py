@@ -249,6 +249,204 @@ def load_harness_main() -> None:
     print(json.dumps(result))
 
 
+def multichip_serving_main(record_path=None) -> None:
+    """``python bench.py --multichip-serving [--record PATH]``: the
+    scale-out serving dryrun round (MULTICHIP_r06) on the forced
+    8-host-device CPU mesh — no TPU pod required.  Three certs:
+
+      1. **Sharded-chunk parity**: the mesh-placed batcher
+         (``--serve-mesh 2,2`` geometry: KV pool head-sharded over
+         tensor, state rows over data) serves a chunked + fused-
+         admission mix TOKEN-IDENTICALLY to single-chip.
+      2. **Sharded lowering contracts**: the analysis mesh pass
+         (donated-leaf donor attributes + sharding stability) is clean
+         for every registered mesh variant.
+      3. **Routed-replica serving**: 2 LLMServer replicas behind a
+         ReplicaRouter serve a concurrent burst token-identically to
+         one replica, with the wall tokens/s recorded.
+
+    CPU numbers measure BEHAVIOR, not chips — the throughput keys roll
+    forward at the next TPU-attached round, like BENCH_r06 did for the
+    overload controller."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+    import json as _json
+    import threading
+    import urllib.request
+
+    import jax_llama_tpu as jlt
+    from jax_llama_tpu.parallel.partition import shard_params
+    from jax_llama_tpu.parallel.serve_mesh import (
+        ServeMeshSpec, build_serve_mesh, mesh_shape,
+    )
+    from jax_llama_tpu.router import ReplicaRouter
+    from jax_llama_tpu.server import LLMServer
+    from jax_llama_tpu.serving import ContinuousBatcher
+
+    n_devices = len(jax.devices())
+    tail: list = []
+
+    config = jlt.get_config(
+        "tiny", vocab_size=512, dim=128, n_layers=2, n_heads=4,
+        n_kv_heads=2, multiple_of=32, max_seq_len=256,
+        dtype="float32", param_dtype="float32",
+    )
+    params = jlt.init_params(jax.random.PRNGKey(0), config)
+
+    # -- 1. sharded-chunk parity on the 2x2 serving mesh -------------------
+    mesh = build_serve_mesh(
+        ServeMeshSpec(data=2, tensor=2), devices=jax.devices()[:4]
+    )
+    sp = shard_params(params, mesh, config)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 512, size=n).tolist()
+               for n in (12, 30, 48)]
+
+    def serve(p, m):
+        cb = ContinuousBatcher(
+            p, config, n_slots=4, max_len=256, mesh=m,
+            decode_chunk=8, prefill_budget=32,
+        )
+        rids = [cb.submit(pr, max_new_tokens=8, seed=7 + i)
+                for i, pr in enumerate(prompts)]
+        t0 = time.time()
+        done = cb.run_to_completion()
+        wall = time.time() - t0
+        return [done[r] for r in rids], wall, cb
+
+    base, _, _ = serve(params, None)
+    sharded, _, cb = serve(sp, mesh)
+    parity_ok = sharded == base and cb._mesh_placed
+    tail.append(
+        f"dryrun_multichip_serving ok: sharded chunk programs on "
+        f"data=2 tensor=2 mesh token-identical={parity_ok} "
+        f"({sum(map(len, sharded))} tokens)"
+    )
+
+    # -- 2. sharded lowering contracts (analysis mesh pass) -----------------
+    from jax_llama_tpu.analysis.lowering import check_mesh_traces
+
+    findings = check_mesh_traces()
+    lowering_ok = not findings
+    mesh_contracts = sorted(
+        name for name, c in __import__(
+            "jax_llama_tpu.analysis.contracts", fromlist=["REGISTRY"]
+        ).REGISTRY.items() if c.mesh_build is not None
+    )
+    tail.append(
+        f"dryrun_multichip_serving ok: mesh lowering contracts clean="
+        f"{lowering_ok} ({len(mesh_contracts)} sharded programs: "
+        f"{', '.join(mesh_contracts)})"
+    )
+
+    # -- 3. routed 2-replica serving vs 1 replica ---------------------------
+    def mk_server(i):
+        return LLMServer(
+            ContinuousBatcher(
+                params, config, n_slots=2, max_len=256, decode_chunk=8,
+            ),
+            replica_id=i,
+        ).start()
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url + "/generate", data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=600) as r:
+            return _json.loads(r.read())
+
+    burst = [
+        {"prompt": prompts[i % len(prompts)], "max_new_tokens": 8,
+         "seed": 100 + i}
+        for i in range(6)
+    ]
+
+    def flood(url):
+        out = [None] * len(burst)
+
+        def one(i):
+            out[i] = post(url, burst[i])["tokens"]
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(len(burst))]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out, time.time() - t0
+
+    solo = mk_server(0)
+    try:
+        want, _ = flood(solo.address)
+    finally:
+        solo.stop()
+    servers = [mk_server(i) for i in range(2)]
+    router = ReplicaRouter(servers, policy="least-loaded").start()
+    try:
+        got, wall = flood(router.address)
+        routed_ok = got == want
+        toks = sum(len(t) for t in got if t)
+        routed_tps = round(toks / max(wall, 1e-9), 2)
+        h = router.health()
+        both_served = all(
+            r["routed_total"] > 0 for r in h["replicas"]
+        )
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+    tail.append(
+        f"dryrun_multichip_serving ok: routed 2-replica serving "
+        f"token-identical={routed_ok}, both replicas served="
+        f"{both_served}, {routed_tps} tok/s wall (CPU behavior round)"
+    )
+
+    ok = parity_ok and lowering_ok and routed_ok
+    result = {
+        "n_devices": n_devices,
+        "rc": 0 if ok else 1,
+        "ok": ok,
+        "skipped": False,
+        "tail": "\n".join(tail) + "\n",
+        "serving_mesh": {
+            "mesh": mesh_shape(mesh),
+            "sharded_chunk_token_identical": parity_ok,
+            "mesh_lowering_contracts_clean": lowering_ok,
+            "mesh_contract_programs": mesh_contracts,
+            "routed_replicas": 2,
+            "routed_token_identical": routed_ok,
+            "routed_both_replicas_served": both_served,
+            "routed_tokens_per_s_wall_cpu": routed_tps,
+            "route_policy": "least-loaded",
+        },
+    }
+    print(_json.dumps(result))
+    if record_path:
+        with open(record_path, "w") as f:
+            _json.dump(result, f, indent=1)
+            f.write("\n")
+    if not ok:
+        # The certs are the point: a red parity/lowering/routing cert
+        # must fail `make mesh-serve` (and any CI wiring), not just
+        # print "ok": false.
+        raise SystemExit(result["rc"])
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -1557,5 +1755,10 @@ if __name__ == "__main__":
 
     if "--load-harness" in sys.argv[1:]:
         load_harness_main()
+    elif "--multichip-serving" in sys.argv[1:]:
+        record = None
+        if "--record" in sys.argv[1:]:
+            record = sys.argv[sys.argv.index("--record") + 1]
+        multichip_serving_main(record_path=record)
     else:
         main()

@@ -20,8 +20,18 @@ from __future__ import annotations
 import argparse
 import importlib
 import json
+import os
 import sys
 from typing import List, Optional, Sequence
+
+# The serving-mesh contract pass lowers sharded program variants on
+# forced host devices — the flag must land before ANY jax import (the
+# checkers import jax lazily, so setting it here covers them all).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 from .common import Finding
 from .hostsync import HostBoundaryChecker

@@ -59,6 +59,21 @@ class ProgramContract:
     # new pool carrier cannot silently derive an empty shape set and
     # pass the full-pool-copy check vacuously.
     forbidden_shapes: Optional[Callable[[tuple], List[Tuple[int, ...]]]] = None
+    # Serving-mesh variant (parallel/serve_mesh.py): ``mesh_build``
+    # produces the SAME program's example arguments placed on a small
+    # forced-host-device serving mesh (sharded pool + row-sharded
+    # state + sharded params, mesh static kwarg set).  The auditor's
+    # mesh pass then proves donated-leaf aliasing still RESOLVES under
+    # the sharded lowering, and — via ``mesh_aliases`` (donated
+    # argname -> output position in the program's return tuple) —
+    # executes the program once and asserts each donated input's
+    # sharding equals its carried output's (sharding drift between a
+    # donated input and its output is exactly how "donated" state
+    # silently starts copying/resharding per dispatch on a mesh).
+    mesh_build: Optional[
+        Callable[[], Tuple[Tuple[str, ...], tuple, dict]]
+    ] = None
+    mesh_aliases: Optional[Dict[str, int]] = None
 
 
 # -- example-argument factories ---------------------------------------------
@@ -144,6 +159,81 @@ def _spec_batcher():
     return _CACHE["spec"]
 
 
+def _serve_mesh4():
+    """A data=2 x tensor=2 serving mesh over 4 of the forced host
+    devices (conftest / the analysis CLI force 8): tensor=2 divides
+    the tiny config's 2 KV heads, data=2 divides the 2 example slots."""
+    if "mesh" not in _CACHE:
+        import jax
+
+        from ..parallel.serve_mesh import ServeMeshSpec, build_serve_mesh
+
+        if len(jax.devices()) < 4:
+            raise RuntimeError(
+                "serving-mesh contract pass needs >= 4 host devices "
+                "(set XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+            )
+        _CACHE["mesh"] = build_serve_mesh(
+            ServeMeshSpec(data=2, tensor=2),
+            devices=jax.devices()[:4],
+        )
+    return _CACHE["mesh"]
+
+
+def _mesh_params():
+    if "params_mesh" not in _CACHE:
+        from ..parallel.partition import shard_params
+
+        cfg, params = _tiny_config_params()
+        _CACHE["params_mesh"] = shard_params(
+            params, _serve_mesh4(), cfg
+        )
+    return _CACHE["params_mesh"]
+
+
+def _plain_batcher_mesh():
+    if "plain_mesh" not in _CACHE:
+        import numpy as np
+
+        from ..serving import ContinuousBatcher
+
+        cfg, _ = _tiny_config_params()
+        cb = ContinuousBatcher(
+            _mesh_params(), cfg, n_slots=_SLOTS, max_len=_MAXLEN,
+            block_size=_BLOCK, decode_chunk=2, mesh=_serve_mesh4(),
+        )
+        assert cb._mesh_placed, "mesh example fell outside placement"
+        rng = np.random.RandomState(0)
+        for _ in range(_SLOTS):
+            cb.submit(list(rng.randint(1, _VOCAB, 20)), max_new_tokens=4)
+        cb.step()
+        _CACHE["plain_mesh"] = cb
+    return _CACHE["plain_mesh"]
+
+
+def _fused_batcher_mesh():
+    if "fused_mesh" not in _CACHE:
+        import numpy as np
+
+        from ..serving import ContinuousBatcher
+
+        cfg, _ = _tiny_config_params()
+        cb = ContinuousBatcher(
+            _mesh_params(), cfg, n_slots=_SLOTS, max_len=_MAXLEN,
+            block_size=_BLOCK, decode_chunk=2, prefill_budget=_BLOCK,
+            mesh=_serve_mesh4(),
+        )
+        rng = np.random.RandomState(1)
+        cb.submit(list(rng.randint(1, _VOCAB, 20)), max_new_tokens=8)
+        cb.step()
+        cb.step()
+        cb.submit(list(rng.randint(1, _VOCAB, 40)), max_new_tokens=8)
+        cb.step()
+        assert cb._pf is not None, "fused mesh example missed prefill"
+        _CACHE["fused_mesh"] = cb
+    return _CACHE["fused_mesh"]
+
+
 def clear_examples() -> None:
     """Drop the cached example batchers (tests)."""
     _CACHE.clear()
@@ -203,6 +293,40 @@ def _build_fused_chunk():
                   all_greedy=True, mesh=None, allow_kernel=True,
                   with_logprobs=False)
     return names, args, kwargs
+
+
+def _build_paged_decode_chunk_mesh():
+    cb = _plain_batcher_mesh()
+    names = ("params", "pool") + _STATE_NAMES
+    args = (cb.params, cb.pool) + _chunk_state(cb)
+    kwargs = dict(config=cb.config, n_iter=2, all_greedy=True,
+                  mesh=cb.mesh, allow_kernel=True, with_logprobs=False,
+                  placed=True)
+    return names, args, kwargs
+
+
+def _build_fused_chunk_mesh():
+    cb = _fused_batcher_mesh()
+    pf = cb._pf
+    names = ("params", "pool") + _STATE_NAMES + (
+        "pf_row", "pf_toks", "pf_len", "pf_base", "pf_off", "pf_key",
+    )
+    args = (cb.params, cb.pool) + _chunk_state(cb) + (
+        pf.d_row, pf.d_toks, pf.d_len, pf.d_base, pf.d_off, pf.d_key,
+    )
+    kwargs = dict(config=cb.config, n_iter=2, pf_chunk=pf.chunk,
+                  all_greedy=True, mesh=cb.mesh, allow_kernel=True,
+                  with_logprobs=False, placed=True)
+    return names, args, kwargs
+
+
+# Donated argname -> position in the chunk programs' return tuple
+# (packed, tau, tau_lp, fill, pos, active, remaining, keys, pool[,
+# pf_off]) — the mesh pass's sharding-stability map.
+_CHUNK_ALIASES = {
+    "tau": 1, "tau_lp": 2, "fill": 3, "pos": 4, "active": 5,
+    "remaining": 6, "keys": 7, "pool": 8,
+}
 
 
 def _build_spec_round():
@@ -361,12 +485,16 @@ REGISTRY: Dict[str, ProgramContract] = {
             donated=_CHUNK_DONATED, max_live_outputs=1,
             max_fetch_bytes_per_row=16,
             build=_build_paged_decode_chunk,
+            mesh_build=_build_paged_decode_chunk_mesh,
+            mesh_aliases=dict(_CHUNK_ALIASES),
         ),
         ProgramContract(
             name="_fused_chunk", module="jax_llama_tpu.serving",
             donated=_CHUNK_DONATED + ("pf_off",), max_live_outputs=1,
             max_fetch_bytes_per_row=16,
             build=_build_fused_chunk,
+            mesh_build=_build_fused_chunk_mesh,
+            mesh_aliases=dict(_CHUNK_ALIASES, pf_off=9),
         ),
         ProgramContract(
             name="_spec_round", module="jax_llama_tpu.serving",

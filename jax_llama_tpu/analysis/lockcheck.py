@@ -117,6 +117,18 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
             "refused_batch_total", "ttft_estimate_last_ms",
         }),
     ),
+    # Replica router (router.py): HTTP handler threads (forward /
+    # metrics / healthz) and the health-poller thread share the replica
+    # table, sticky-session map, and routing counters — every access
+    # goes under the one lock.  The router holds no jax state.
+    LockGuard(
+        module="router", cls="ReplicaRouter", lock="_lock",
+        fields=frozenset({
+            "_replicas", "_affinity", "routed_by_policy",
+            "reroutes_total", "replica_failures_total",
+            "kv_handoffs_total",
+        }),
+    ),
 )
 
 CONFINEMENTS: Tuple[ThreadConfinement, ...] = (

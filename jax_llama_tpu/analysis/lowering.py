@@ -59,6 +59,11 @@ FORBIDDEN_PRIMITIVES = frozenset({
 })
 
 _ALIAS_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+# Sharded lowerings record donation as a buffer-donor attribute and
+# defer the alias RESOLUTION to compile time — the mesh pass accepts
+# either spelling (and proves the resolution's precondition, sharding
+# stability, by running the program).
+_DONOR_RE = re.compile(r"jax\.buffer_donor")
 
 
 # ---------------------------------------------------------------------------
@@ -346,8 +351,110 @@ def check_traces(
     return findings
 
 
+# ---------------------------------------------------------------------------
+# Serving-mesh pass
+# ---------------------------------------------------------------------------
+
+def check_mesh_lowering(
+    contract: ProgramContract,
+    path_hint: Optional[str] = None,
+) -> List[Finding]:
+    """Audit ``contract``'s SHARDED variant (``mesh_build`` example:
+    sharded pool / row-sharded state / sharded params on a small
+    forced-host-device serving mesh):
+
+      1. the sharded lowering must still resolve EVERY donated leaf to
+         an input-output alias (donation that survives single-chip but
+         not the mesh is the silent-copy failure mode this PR's
+         placement layer exists to prevent);
+      2. sharding STABILITY (``mesh_aliases``): the program runs once
+         and each donated input's sharding must be equivalent to its
+         carried output's — drift means the next dispatch reshards
+         (and un-aliases) the "donated" buffer every time."""
+    import jax.tree_util as jtu
+
+    findings: List[Finding] = []
+    path = path_hint or contract.module.replace(".", "/") + ".py"
+    if contract.mesh_build is None:
+        return findings
+    program = _resolve_program(contract)
+    argnames, args, kwargs = contract.mesh_build()
+    traced = program.trace(*args, **kwargs)
+    lowered = traced.lower()
+    donated_leaves = sum(
+        sum(bool(leaf.donated) for leaf in jtu.tree_leaves(info))
+        for info in lowered.args_info[0]
+    )
+    text = lowered.as_text()
+    carried = len({int(m) for m in _ALIAS_RE.findall(text)}) + len(
+        _DONOR_RE.findall(text)
+    )
+    if carried != donated_leaves:
+        findings.append(Finding(
+            checker=CHECKER, rule="mesh-donation-unresolved",
+            path=path, line=0,
+            message=(
+                f"{contract.name} [mesh]: {donated_leaves} leaves are "
+                f"donated but only {carried} carry an alias/buffer-"
+                "donor attribute under the SHARDED lowering — donation "
+                "that holds single-chip but not on the mesh silently "
+                "copies the pool/state every dispatch"
+            ),
+        ))
+    if not contract.mesh_aliases:
+        return findings
+    in_shardings: Dict[str, list] = {}
+    for name, arg in zip(argnames, args):
+        if name in contract.mesh_aliases:
+            in_shardings[name] = [
+                leaf.sharding for leaf in jtu.tree_leaves(arg)
+            ]
+    out = program(*args, **kwargs)
+    for name, idx in sorted(contract.mesh_aliases.items()):
+        want = in_shardings.get(name)
+        if want is None:
+            findings.append(Finding(
+                checker=CHECKER, rule="mesh-alias-map",
+                path=path, line=0,
+                message=(
+                    f"{contract.name} [mesh]: mesh_aliases names "
+                    f"{name!r} but the mesh example has no such "
+                    "argument"
+                ),
+            ))
+            continue
+        leaves = jtu.tree_leaves(out[idx])
+        drift = [
+            i for i, (a, b) in enumerate(zip(want, leaves))
+            if not a.is_equivalent_to(b.sharding, b.ndim)
+        ]
+        if len(leaves) != len(want) or drift:
+            findings.append(Finding(
+                checker=CHECKER, rule="mesh-sharding-drift",
+                path=path, line=0,
+                message=(
+                    f"{contract.name} [mesh]: donated {name!r} leaves "
+                    f"{drift or 'shape-mismatched'} leave the program "
+                    "with a DIFFERENT sharding than they entered with "
+                    "— the next dispatch reshards (and un-aliases) the "
+                    "donated buffer every time; pin the output with "
+                    "serve_mesh.constrain_pool/constrain_rows"
+                ),
+            ))
+    return findings
+
+
+def check_mesh_traces(
+    registry: Dict[str, ProgramContract] = REGISTRY,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for name in sorted(registry):
+        findings.extend(check_mesh_lowering(registry[name]))
+    return findings
+
+
 class LoweringAuditor:
-    """Facade bundling the static and trace layers."""
+    """Facade bundling the static, trace, and serving-mesh layers."""
 
     def __init__(self, registry: Dict[str, ProgramContract] = REGISTRY):
         self.registry = registry
@@ -360,4 +467,5 @@ class LoweringAuditor:
             for f in findings
         ):
             findings.extend(check_traces(self.registry))
+            findings.extend(check_mesh_traces(self.registry))
         return findings

@@ -91,6 +91,14 @@ from typing import Dict, List, Optional, Sequence, Union
 SITES = (
     "step", "insert", "suffix_insert", "prefill_chunk", "alloc",
     "kv_swap", "flash_kernel", "paged_kernel", "spec_decode",
+    # Router-side site (router.ReplicaRouter.forward): an injected
+    # fault here simulates the chosen replica dying at dispatch time —
+    # the router marks it unhealthy and re-routes the request to a
+    # surviving replica (CONTAINED: requests that have not streamed a
+    # byte re-route losslessly; in-flight requests on a genuinely
+    # crashed replica replay through that replica's own crash-recovery
+    # path).
+    "router_replica",
 )
 KINDS = ("error", "oom", "delay", "nan")
 

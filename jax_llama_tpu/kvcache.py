@@ -600,6 +600,7 @@ def stage_restore(
     slabs: Sequence[Dict[str, np.ndarray]],
     block_ids: Sequence[int],
     sentinel: int,
+    placements: Optional[Dict[str, object]] = None,
 ) -> Dict[str, jax.Array]:
     """Swap-in H2D: stack the slabs along the block axis and
     ``jax.device_put`` them into STAGING buffers.  The transfer is
@@ -612,12 +613,22 @@ def stage_restore(
     ``block_ids`` are the fresh HBM blocks the adoption scatter will
     land in, padded to a pow2 bucket with ``sentinel`` (out-of-range:
     the scatter drops pad rows) so the jit cache of
-    :func:`adopt_into_pool` stays O(log max-restore-depth)."""
+    :func:`adopt_into_pool` stays O(log max-restore-depth).
+
+    ``placements`` (serving-mesh pools;
+    ``parallel.serve_mesh.staging_shardings``) maps staged field names
+    to Shardings so each buffer lands PRE-SHARDED with the pool's own
+    layout — every tensor shard stages its KV-head slice of the slab
+    and the adoption scatter stays shard-local (no cross-shard reshard
+    on the adopt dispatch).  None keeps default placement."""
     n = len(slabs)
     nb = pow2_bucket(n)
     ids = np.full((nb,), sentinel, np.int32)
     ids[:n] = list(block_ids)
-    staged: Dict[str, jax.Array] = {"ids": jax.device_put(ids)}
+    placements = placements or {}
+    staged: Dict[str, jax.Array] = {
+        "ids": jax.device_put(ids, placements.get("ids"))
+    }
     for name in slabs[0]:
         arrs = [s[name] for s in slabs]
         axis = 0 if name.endswith("pos") else 2
@@ -631,7 +642,7 @@ def stage_restore(
         # audit: host-upload(slab staging H2D, deliberately OFF the
         # pool's dependency chain — the async transfer decode chunks
         # never queue behind; one per restored pool field)
-        staged[name] = jax.device_put(stacked)
+        staged[name] = jax.device_put(stacked, placements.get(name))
     return staged
 
 

@@ -206,6 +206,21 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "counter", "Cumulative swap-in wall time (ms)"),
     "swap_failures_total": _reg(
         "counter", "Swap-ins failed cleanly (request-scoped)"),
+    # -- scale-out serving (serve_mesh.py / router.py) ----------------------
+    "kv_export_blocks_total": _reg(
+        "counter", "Prefix blocks exported to peer replicas "
+                   "(disaggregation handoff, prefill side)"),
+    "kv_import_blocks_total": _reg(
+        "counter", "Prefix blocks landed from peer replicas "
+                   "(disaggregation handoff, decode side)"),
+    "serve_mesh_data": _reg(
+        "gauge", "Serving-mesh row shards (data*fsdp axes; 1 off-mesh)"),
+    "serve_mesh_tensor": _reg(
+        "gauge", "Serving-mesh tensor shards (KV-head sharding; 1 "
+                 "off-mesh)"),
+    "replica_id": _reg(
+        "gauge", "This server's replica index behind a ReplicaRouter "
+                 "(-1 standalone)"),
     # -- chunked decode host boundary --------------------------------------
     "decode_chunk_size": _reg(
         "gauge", "Effective K of the most recent chunk dispatch"),
@@ -382,7 +397,7 @@ class _Span:
 class _Timeline:
     __slots__ = (
         "request_id", "rids", "prompt_tokens", "created", "spans",
-        "outcome", "error",
+        "outcome", "error", "route",
     )
 
     def __init__(self, request_id: str, rid: int, prompt_tokens: int,
@@ -394,6 +409,10 @@ class _Timeline:
         self.spans: List[_Span] = []
         self.outcome: Optional[str] = None
         self.error: Optional[str] = None
+        # Routing decision (ReplicaRouter via the X-Routed-By header):
+        # which replica/policy served this request — shown by
+        # /debug/requests/<id> next to the spans it annotates.
+        self.route: Optional[str] = None
 
 
 class Observability:
@@ -548,6 +567,21 @@ class Observability:
                 existing.error = None
                 self._by_rid[rid] = existing
                 self._timelines.move_to_end(request_id)
+
+    def set_route(self, request_id: str, route: str) -> None:
+        """Record a ReplicaRouter's decision on the request's timeline
+        (called by the server after ``bind`` when the POST carried an
+        ``X-Routed-By`` header) AND drop an instant event into the
+        annotation ring, so the decision shows both in
+        ``/debug/requests/<id>`` and on the trace."""
+        with self._lock:
+            tl = self._timelines.get(request_id)
+            if tl is not None:
+                tl.route = route
+            self.events.append({
+                "t_ms": round(self._now_ms(), 3), "name": "routed",
+                "fields": {"request_id": request_id, "via": route},
+            })
 
     def begin_span(self, rid: int, state: str,
                    note: Optional[str] = None) -> None:
@@ -790,6 +824,7 @@ class Observability:
                 "prompt_tokens": tl.prompt_tokens,
                 "outcome": tl.outcome,
                 "error": tl.error,
+                "route": tl.route,
                 "spans": [self._span_json(sp) for sp in tl.spans],
                 "dispatch_spans": [
                     dict(d) for d in self.dispatches if d["seq"] in seqs

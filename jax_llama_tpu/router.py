@@ -1,0 +1,714 @@
+"""Data-parallel replica routing: N serving replicas behind one door.
+
+The scale-out serving subsystem's outermost layer (ROADMAP item 2; the
+in-replica mesh sharding lives in ``parallel/serve_mesh.py``): a
+:class:`ReplicaRouter` fronts N **independent** serving replicas — each
+an ``LLMServer`` with its own ``ContinuousBatcher``, KV pool, radix
+prefix index and (optionally) its own mesh slice — and routes each POST
+to one of them:
+
+  * **least-loaded** (default): the healthy replica with the fewest
+    router-tracked in-flight requests (ties rotate by routed count), so
+    a long-generation pileup on one replica never queues new arrivals
+    behind it.
+  * **affinity**: sticky sessions by prompt-prefix key — a revisited
+    session routes to the replica already holding its radix chain, so
+    multi-turn chats keep their prefix-cache hits (and host-tier slabs)
+    local instead of re-prefilling cold on a random replica.  New
+    sessions fall back to least-loaded; a dead replica's sessions
+    re-pin wherever their next turn lands.
+
+**Health / quarantine.**  A poller thread scrapes each replica's
+``/healthz`` (the server's own ok/draining/degraded verdict — a replica
+in drain or with a dead loop stops receiving new work while its
+in-flight requests finish); a forward-time connection failure (or an
+injected ``router_replica`` fault) marks the replica unhealthy
+immediately.  Requests that have not yet streamed a byte RE-ROUTE to a
+surviving replica losslessly; requests in flight on a genuinely crashed
+replica are that replica's own crash-recovery problem (rebuild + replay
+— the PR-1 machinery), not the router's: the router never duplicates a
+request it may have half-delivered.
+
+**Prefill/decode disaggregation (skeleton).**  :func:`handoff_prefix`
+moves a session's cached prefix blocks between two batchers through the
+existing host-tier primitives (``export_prefix`` D2H slab fetch on the
+prefill side, ``import_prefix`` stage+adopt+publish on the decode
+side), so an admission can prefill on one replica and decode on
+another that receives its KV as a plain prefix hit.  The router counts
+handoffs; scheduling WHEN to disaggregate (prefill-heavy vs
+decode-heavy replica pools) is the open half — both batcher calls must
+run on their owning serving-loop threads, so a live-traffic router
+drives them through the replicas' control paths, not directly.
+
+HTTP surface (the router speaks the same protocol as a single server,
+so clients need no changes):
+
+    POST /generate, /chat    routed + proxied (streaming NDJSON relays
+                             line-by-line); the response carries
+                             X-Replica-Id, and the replica's request
+                             timeline records the routing decision
+                             (X-Routed-By -> /debug/requests/<id>)
+    GET  /healthz            aggregate: ok = any replica routable, plus
+                             a ``replicas`` section (per-replica
+                             health/occupancy/mesh snapshot)
+    GET  /metrics            router gauges + per-replica labeled series
+    GET  /debug/*            tried against each healthy replica until
+                             one answers non-404 (request timelines
+                             live on the replica that served them)
+
+Thread discipline: handler threads (forward) and the health poller
+share the replica table and counters — every access goes under
+``_lock`` (registered in analysis/lockcheck.py).  The router holds no
+jax state at all; it is pure host-side HTTP."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .faults import FaultInjector, InjectedFault
+from .obs import StructuredLogger
+
+POLICIES = ("least-loaded", "affinity")
+
+
+class _ClientDisconnect(Exception):
+    """The CLIENT's socket died while relaying — the replica is fine.
+    Distinct from replica-side OSErrors so a disconnecting client never
+    marks a healthy replica unhealthy; ``relayed`` records whether any
+    bytes reached the client before the drop."""
+
+    def __init__(self, relayed: bool):
+        super().__init__("client disconnected")
+        self.relayed = relayed
+
+# Hop-by-hop / recomputed headers never relayed from a replica reply.
+_SKIP_HEADERS = frozenset({
+    "connection", "transfer-encoding", "content-length", "server",
+    "date",
+})
+
+# Prompt-prefix length (tokens or characters) the affinity key hashes:
+# long enough to separate sessions with a shared system prompt short
+# of one block, short enough that appending turns to a chat keeps the
+# key (and therefore the replica holding the chain) stable.
+_AFFINITY_PREFIX = 64
+
+
+@dataclass
+class _Replica:
+    """Router-side view of one serving replica."""
+
+    index: int
+    host: str
+    port: int
+    server: Any = None            # in-process LLMServer (caller-owned)
+    healthy: bool = True
+    inflight: int = 0
+    routed_total: int = 0
+    failures_total: int = 0
+    last_health: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def snapshot(self) -> Dict[str, Any]:
+        h = self.last_health
+        return {
+            "index": self.index,
+            "address": self.address,
+            "healthy": self.healthy,
+            "inflight": self.inflight,
+            "routed_total": self.routed_total,
+            "failures_total": self.failures_total,
+            "draining": h.get("draining"),
+            "degraded": h.get("degraded"),
+            "overload_state": (h.get("overload") or {}).get("state"),
+            "replica": h.get("replica"),
+        }
+
+
+def _parse_address(addr: str) -> Tuple[str, int]:
+    """Accepts ``host:port`` or ``http://host:port`` (LLMServer's own
+    ``.address`` spelling)."""
+    if addr.startswith("http://"):
+        addr = addr[len("http://"):]
+    addr = addr.rstrip("/")
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class ReplicaRouter:
+    """HTTP front-end routing requests across serving replicas
+    (module docstring).  ``replicas`` mixes in-process ``LLMServer``
+    instances (must already be started; their lifecycle stays with the
+    caller) and ``"host:port"`` strings for out-of-process ones."""
+
+    def __init__(
+        self,
+        replicas: Sequence[Any],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        policy: str = "least-loaded",
+        health_interval_s: float = 0.5,  # <= 0: manual (tests) —
+        #                                  check_health_now() only
+        proxy_timeout_s: float = 300.0,
+        affinity_max_sessions: int = 4096,
+        fault_injector: Optional[FaultInjector] = None,
+        logger: Optional[StructuredLogger] = None,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown route policy {policy!r}; have {POLICIES}"
+            )
+        if not replicas:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        self.policy = policy
+        self.fault_injector = fault_injector
+        self.logger = logger
+        self.health_interval_s = float(health_interval_s)
+        self.proxy_timeout_s = float(proxy_timeout_s)
+        self.affinity_max_sessions = int(affinity_max_sessions)
+        self._lock = threading.Lock()
+        self._replicas: List[_Replica] = []
+        for i, rep in enumerate(replicas):
+            if isinstance(rep, str):
+                h, p = _parse_address(rep)
+                self._replicas.append(_Replica(index=i, host=h, port=p))
+            else:  # in-process LLMServer
+                h, p = _parse_address(rep.address)
+                self._replicas.append(
+                    _Replica(index=i, host=h, port=p, server=rep)
+                )
+        # Sticky-session map: affinity key -> replica index (bounded
+        # LRU — hits refresh recency, so long-lived active sessions
+        # are not the eviction victims; a dead replica's entries
+        # re-pin on next use).
+        self._affinity: "OrderedDict[bytes, int]" = OrderedDict()
+        self.routed_by_policy: Dict[str, int] = {
+            "least-loaded": 0, "affinity": 0, "reroute": 0,
+        }
+        self.reroutes_total = 0
+        self.replica_failures_total = 0
+        self.kv_handoffs_total = 0
+        self._closed = threading.Event()
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet test output
+                pass
+
+            def do_GET(self):
+                router._handle_get(self)
+
+            def do_POST(self):
+                router._handle_post(self)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="router-http",
+        )
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="router-health",
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        h, p = self._httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def start(self) -> "ReplicaRouter":
+        self._http_thread.start()
+        self._health_thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the router (replica lifecycles stay with the caller)."""
+        self._closed.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._health_thread.join(timeout=5)
+
+    def __enter__(self) -> "ReplicaRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _log(self, event: str, message: str = "", **fields) -> None:
+        if self.logger is not None:
+            self.logger.log(event, message, **fields)
+
+    # -- health --------------------------------------------------------------
+
+    def _probe(self, rep: _Replica) -> Tuple[bool, Dict[str, Any]]:
+        """One /healthz scrape; (routable, payload).  A 503 body still
+        parses (draining replicas report their state)."""
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=2.0
+        )
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            payload = json.loads(resp.read() or b"{}")
+            return bool(payload.get("ok")), payload
+        finally:
+            conn.close()
+
+    def _health_loop(self) -> None:
+        if self.health_interval_s <= 0:
+            # Manual mode (deterministic drills/tests): health moves
+            # only through check_health_now() and forward failures.
+            return
+        while not self._closed.is_set():
+            with self._lock:
+                reps = list(self._replicas)
+            for rep in reps:
+                try:
+                    ok, payload = self._probe(rep)
+                except (OSError, ValueError, http.client.HTTPException):
+                    ok, payload = False, {}
+                with self._lock:
+                    was = rep.healthy
+                    rep.healthy = ok
+                    if payload:
+                        rep.last_health = payload
+                if was != ok:
+                    self._log(
+                        "router_replica_health",
+                        replica=rep.index, healthy=ok,
+                    )
+            self._closed.wait(self.health_interval_s)
+
+    def check_health_now(self) -> None:
+        """Synchronous health sweep (tests / deterministic drills)."""
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
+            try:
+                ok, payload = self._probe(rep)
+            except (OSError, ValueError, http.client.HTTPException):
+                ok, payload = False, {}
+            with self._lock:
+                rep.healthy = ok
+                if payload:
+                    rep.last_health = payload
+
+    # -- routing -------------------------------------------------------------
+
+    def _affinity_key(self, payload: Dict[str, Any]) -> Optional[bytes]:
+        """Session key: the prompt's leading tokens/characters (chat
+        dialogs key on the first message — the system prompt — which is
+        exactly the shared radix prefix)."""
+        try:
+            if isinstance(payload.get("prompt"), list):
+                head = payload["prompt"][:_AFFINITY_PREFIX]
+                return b"p:" + json.dumps(head).encode()
+            if isinstance(payload.get("text"), str):
+                return b"t:" + payload["text"][:_AFFINITY_PREFIX].encode()
+            msgs = payload.get("messages")
+            if isinstance(msgs, list) and msgs:
+                first = msgs[0]
+                if isinstance(first, dict):
+                    return b"m:" + str(
+                        first.get("content", "")
+                    )[:_AFFINITY_PREFIX].encode()
+        except (TypeError, ValueError, UnicodeEncodeError):
+            return None
+        return None
+
+    def _pick_locked(
+        self, key: Optional[bytes], exclude: frozenset
+    ) -> Tuple[Optional[_Replica], str]:
+        """Choose a replica (caller holds ``_lock``): sticky key first
+        (affinity policy), else least-loaded among healthy replicas not
+        in ``exclude`` (prior failed attempts for this request)."""
+        candidates = [
+            r for r in self._replicas
+            if r.healthy and r.index not in exclude
+        ]
+        if not candidates:
+            return None, "none"
+        if self.policy == "affinity" and key is not None:
+            idx = self._affinity.get(key)
+            if idx is not None:
+                for r in candidates:
+                    if r.index == idx:
+                        self._affinity.move_to_end(key)  # LRU refresh
+                        return r, "affinity"
+        chosen = min(
+            candidates, key=lambda r: (r.inflight, r.routed_total)
+        )
+        if self.policy == "affinity" and key is not None:
+            while len(self._affinity) >= self.affinity_max_sessions:
+                self._affinity.popitem(last=False)  # evict coldest
+            self._affinity[key] = chosen.index
+        return chosen, "least-loaded"
+
+    # -- proxying ------------------------------------------------------------
+
+    def _handle_post(self, handler: BaseHTTPRequestHandler) -> None:
+        if handler.path not in ("/generate", "/chat"):
+            self._reply_json(handler, 404, {"error": "not found"})
+            return
+        try:
+            n = int(handler.headers.get("Content-Length") or 0)
+        except ValueError:
+            n = 0
+        body = handler.rfile.read(n) if n > 0 else b"{}"
+        try:
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict):
+                payload = {}
+        except ValueError:
+            payload = {}
+        key = self._affinity_key(payload)
+        fwd_headers = {
+            "Content-Type": "application/json",
+            "Content-Length": str(len(body)),
+        }
+        for h in ("X-Request-Id",):
+            if handler.headers.get(h):
+                fwd_headers[h] = handler.headers[h]
+
+        tried: set = set()
+        first_attempt = True
+        while True:
+            with self._lock:
+                rep, how = self._pick_locked(key, frozenset(tried))
+                if rep is not None:
+                    rep.inflight += 1
+                    rep.routed_total += 1
+                    if not first_attempt:
+                        how = "reroute"
+                    self.routed_by_policy[how] = (
+                        self.routed_by_policy.get(how, 0) + 1
+                    )
+            if rep is None:
+                self._reply_json(
+                    handler, 503,
+                    {"error": "no healthy replica"},
+                    headers={"Retry-After": "5"},
+                )
+                return
+            tried.add(rep.index)
+            fwd_headers["X-Routed-By"] = (
+                f"replica-{rep.index}/{how}"
+            )
+            try:
+                if self.fault_injector is not None:
+                    # Fires BEFORE any byte reaches the replica, so a
+                    # drill's failure is always at the reroutable stage.
+                    self.fault_injector.fire("router_replica")
+                self._relay(
+                    handler, rep, handler.path, body, fwd_headers
+                )
+                return
+            except _ClientDisconnect:
+                # The CLIENT vanished mid-relay — the replica is fine
+                # (it reaps the disconnect itself); nothing to reroute
+                # and no health mark.
+                return
+            except TimeoutError as e:
+                # Proxy READ timeout from a slow-but-alive replica
+                # (overload: streams defer headers until the first
+                # token).  The replica has ADMITTED the request — a
+                # re-submit would double the load exactly when
+                # capacity is scarce, and an unhealthy mark would
+                # serially quarantine loaded replicas (a retry-storm
+                # amplifier).  504 the client; health stays with the
+                # /healthz poller.
+                self._log(
+                    "router_replica_timeout", str(e), replica=rep.index,
+                )
+                if not getattr(e, "_relayed", False):
+                    self._reply_json(
+                        handler, 504,
+                        {"error": (
+                            f"replica {rep.index} did not respond "
+                            f"within {self.proxy_timeout_s:.0f}s"
+                        )},
+                        headers={"Retry-After": "5"},
+                    )
+                return
+            except (OSError, InjectedFault,
+                    http.client.HTTPException) as e:
+                relayed = getattr(e, "_relayed", False)
+                with self._lock:
+                    rep.healthy = False
+                    rep.failures_total += 1
+                    self.replica_failures_total += 1
+                self._log(
+                    "router_replica_failed", str(e),
+                    replica=rep.index, rerouting=not relayed,
+                )
+                if relayed:
+                    # Bytes already reached the client: the router
+                    # must NOT replay (a duplicate stream would
+                    # double-deliver tokens); the client sees the
+                    # truncated stream and retries with its own
+                    # request id.
+                    try:
+                        handler.wfile.flush()
+                    except OSError:
+                        pass
+                    return
+                with self._lock:
+                    self.reroutes_total += 1
+                first_attempt = False
+                continue  # re-route losslessly
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+
+    def _relay(
+        self, handler: BaseHTTPRequestHandler, rep: _Replica,
+        path: str, body: bytes, headers: Dict[str, str],
+    ) -> None:
+        """Forward one request and relay the reply (buffered when the
+        replica sent Content-Length, line-by-line for close-delimited
+        NDJSON streams).  Failure attribution: REPLICA-side errors
+        (connect/request/read) raise as-is, tagged ``_relayed`` once
+        any byte reached the client; CLIENT-side write errors raise
+        :class:`_ClientDisconnect` — the replica must not be marked
+        unhealthy because an impatient client hung up."""
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=self.proxy_timeout_s
+        )
+        relayed = False
+
+        def to_client(fn, *a):
+            nonlocal relayed
+            try:
+                out = fn(*a)
+                relayed = True
+                return out
+            except OSError:
+                raise _ClientDisconnect(relayed) from None
+
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            out_headers = [
+                (k, v) for k, v in resp.getheaders()
+                if k.lower() not in _SKIP_HEADERS
+            ]
+            out_headers.append(("X-Replica-Id", str(rep.index)))
+
+            def send_head(extra):
+                handler.send_response(resp.status)
+                for k, v in out_headers + extra:
+                    handler.send_header(k, v)
+                handler.end_headers()
+
+            if resp.length is not None:
+                data = resp.read()  # replica-side: raises plain OSError
+                to_client(
+                    send_head, [("Content-Length", str(len(data)))]
+                )
+                to_client(handler.wfile.write, data)
+                return
+            # Close-delimited NDJSON stream: relay line-by-line so the
+            # client sees tokens as the replica emits them.
+            to_client(send_head, [("Connection", "close")])
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                to_client(handler.wfile.write, line)
+                to_client(handler.wfile.flush)
+            return
+        except (OSError, http.client.HTTPException) as e:
+            e._relayed = relayed
+            raise
+        finally:
+            conn.close()
+
+    # -- GET surface ---------------------------------------------------------
+
+    def _handle_get(self, handler: BaseHTTPRequestHandler) -> None:
+        if handler.path == "/healthz":
+            h = self.health()
+            self._reply_json(handler, 200 if h["ok"] else 503, h)
+        elif handler.path == "/metrics":
+            body = self.metrics_text().encode()
+            handler.send_response(200)
+            handler.send_header(
+                "Content-Type", "text/plain; version=0.0.4"
+            )
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        elif handler.path.startswith("/debug/"):
+            # Timelines live on the replica that served the request:
+            # try each healthy replica until one answers non-404.
+            with self._lock:
+                reps = [r for r in self._replicas if r.healthy]
+            last = (404, {"error": "not found on any replica"})
+            for rep in reps:
+                try:
+                    conn = http.client.HTTPConnection(
+                        rep.host, rep.port, timeout=5.0
+                    )
+                    try:
+                        conn.request("GET", handler.path)
+                        resp = conn.getresponse()
+                        data = json.loads(resp.read() or b"{}")
+                    finally:
+                        conn.close()
+                except (OSError, ValueError,
+                        http.client.HTTPException):
+                    continue
+                if resp.status != 404:
+                    data["replica"] = rep.index
+                    self._reply_json(handler, resp.status, data)
+                    return
+            self._reply_json(handler, *last)
+        else:
+            self._reply_json(handler, 404, {"error": "not found"})
+
+    @staticmethod
+    def _reply_json(
+        handler: BaseHTTPRequestHandler, code: int,
+        obj: Dict[str, Any], headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        body = json.dumps(obj).encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    # -- observability -------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """Aggregate /healthz: ok while ANY replica is routable, with
+        the per-replica snapshots under ``replicas``."""
+        with self._lock:
+            snaps = [r.snapshot() for r in self._replicas]
+            affinity_sessions = len(self._affinity)
+            handoffs = self.kv_handoffs_total
+        return {
+            "ok": any(s["healthy"] for s in snaps),
+            "policy": self.policy,
+            "replicas": snaps,
+            "affinity_sessions": affinity_sessions,
+            "kv_handoffs_total": handoffs,
+        }
+
+    def metrics_text(self) -> str:
+        """Router Prometheus exposition: aggregate counters plus
+        per-replica labeled gauges (occupancy / inflight / routed /
+        health / mesh shape)."""
+        with self._lock:
+            snaps = [r.snapshot() for r in self._replicas]
+            by_policy = dict(self.routed_by_policy)
+            reroutes = self.reroutes_total
+            failures = self.replica_failures_total
+            handoffs = self.kv_handoffs_total
+            affinity_sessions = len(self._affinity)
+        lines: List[str] = []
+
+        def fam(name: str, kind: str, help_text: str) -> None:
+            lines.append(f"# HELP llm_router_{name} {help_text}")
+            lines.append(f"# TYPE llm_router_{name} {kind}")
+
+        fam("replicas", "gauge", "Replicas behind this router")
+        lines.append(f"llm_router_replicas {len(snaps)}")
+        fam("replicas_healthy", "gauge", "Replicas currently routable")
+        lines.append(
+            "llm_router_replicas_healthy "
+            f"{sum(s['healthy'] for s in snaps)}"
+        )
+        fam("routed_requests_total", "counter",
+            "Requests routed, by decision policy")
+        for pol, n in sorted(by_policy.items()):
+            lines.append(
+                f'llm_router_routed_requests_total{{policy="{pol}"}} {n}'
+            )
+        fam("reroutes_total", "counter",
+            "Requests re-routed off a failed replica")
+        lines.append(f"llm_router_reroutes_total {reroutes}")
+        fam("replica_failures_total", "counter",
+            "Forward-time replica failures observed")
+        lines.append(f"llm_router_replica_failures_total {failures}")
+        fam("kv_handoffs_total", "counter",
+            "Cross-replica prefix-KV handoffs brokered")
+        lines.append(f"llm_router_kv_handoffs_total {handoffs}")
+        fam("affinity_sessions", "gauge",
+            "Sticky sessions currently pinned")
+        lines.append(f"llm_router_affinity_sessions {affinity_sessions}")
+        fam("replica_healthy", "gauge", "Replica routable (per replica)")
+        fam("replica_inflight", "gauge",
+            "Router-tracked in-flight requests (per replica)")
+        fam("replica_routed_total", "counter",
+            "Requests routed to this replica")
+        fam("replica_active_slots", "gauge",
+            "Replica batcher slots holding a live request (last "
+            "health scrape)")
+        fam("replica_mesh_devices", "gauge",
+            "Devices in the replica's serving mesh (last health "
+            "scrape)")
+        for s in snaps:
+            lab = f'replica="{s["index"]}"'
+            lines.append(
+                f"llm_router_replica_healthy{{{lab}}} "
+                f"{int(bool(s['healthy']))}"
+            )
+            lines.append(
+                f"llm_router_replica_inflight{{{lab}}} {s['inflight']}"
+            )
+            lines.append(
+                f"llm_router_replica_routed_total{{{lab}}} "
+                f"{s['routed_total']}"
+            )
+            rep_info = s.get("replica") or {}
+            lines.append(
+                f"llm_router_replica_active_slots{{{lab}}} "
+                f"{rep_info.get('active_slots', 0) or 0}"
+            )
+            mesh = rep_info.get("serve_mesh") or {}
+            lines.append(
+                f"llm_router_replica_mesh_devices{{{lab}}} "
+                f"{mesh.get('devices', 1) or 1}"
+            )
+        return "\n".join(lines) + "\n"
+
+    def note_handoff(self, blocks: int) -> None:
+        if blocks > 0:
+            with self._lock:
+                self.kv_handoffs_total += 1
+
+
+def handoff_prefix(
+    src_batcher, dst_batcher, tokens: Sequence[int],
+    router: Optional[ReplicaRouter] = None,
+) -> int:
+    """Prefill/decode disaggregation handoff: move ``tokens``' cached
+    prefix blocks from ``src_batcher`` (which prefilled them) into
+    ``dst_batcher``'s pool + radix index, so the session's next
+    admission on the destination replica is a plain prefix hit —
+    ``export_prefix``'s D2H slab fetch feeding ``import_prefix``'s
+    stage/adopt/publish, the exact path the host-DRAM tier restores
+    through.  Both batcher calls MUST run on their owning serving-loop
+    threads (the batchers are thread-confined).  Returns the number of
+    blocks landed on the destination."""
+    keys, slabs = src_batcher.export_prefix(tokens)
+    if not slabs:
+        return 0
+    n = dst_batcher.import_prefix(keys, slabs)
+    if router is not None:
+        router.note_handoff(n)
+    return n

@@ -56,6 +56,32 @@ def main() -> None:
                          "finish; requests share a slot pool")
     ap.add_argument("--slots", type=int, default=4,
                     help="slot-pool size for --serve / --http")
+    ap.add_argument("--serve-mesh", default=None, metavar="DP,TP",
+                    help="serving-mesh geometry for --serve/--http: "
+                         "'dp,tp' shards each batcher replica's chunk "
+                         "programs over a data(dp) x tensor(tp) mesh — "
+                         "the KV block pool shards its KV-head axis "
+                         "over tp, per-slot state rows over dp "
+                         "(parallel/serve_mesh.py; tp must divide the "
+                         "model's KV heads, dp must divide --slots).  "
+                         "A bare 'tp' means '1,tp'.  Default: the "
+                         "--data/--fsdp/--tensor mesh")
+    ap.add_argument("--replicas", type=int, default=1, metavar="N",
+                    help="data-parallel serving replicas behind one "
+                         "HTTP door (--http only): N independent "
+                         "batcher+server replicas — each owning a "
+                         "mesh slice when the host has "
+                         "N x (dp*tp) devices, sharing the mesh "
+                         "otherwise — fronted by a ReplicaRouter "
+                         "(router.py) that exposes the same protocol "
+                         "on the --http port")
+    ap.add_argument("--route", default="least-loaded",
+                    choices=("least-loaded", "affinity"),
+                    help="replica routing policy: 'least-loaded' "
+                         "(fewest in-flight requests) or 'affinity' "
+                         "(sticky sessions by prompt prefix, so "
+                         "revisited chats land on the replica holding "
+                         "their radix prefix chain)")
     ap.add_argument("--decode-chunk", type=int, default=8,
                     help="fuse up to this many decode iterations per "
                          "jitted dispatch in --serve / --http "
@@ -302,6 +328,36 @@ def main() -> None:
         data=args.data, fsdp=args.fsdp, tensor=tensor,
         devices=jax.devices()[: args.data * args.fsdp * tensor],
     )
+    if args.replicas < 1:
+        raise SystemExit("--replicas must be >= 1")
+    if args.replicas > 1 and args.http is None:
+        raise SystemExit(
+            "--replicas > 1 needs the HTTP front-end (--http PORT): "
+            "the ReplicaRouter speaks HTTP to its replicas"
+        )
+    serve_spec = None
+    if args.serve_mesh is not None:
+        if args.http is None and not args.serve:
+            raise SystemExit(
+                "--serve-mesh applies to the serving modes "
+                "(--serve / --http PORT)"
+            )
+        from .parallel.serve_mesh import build_serve_mesh, parse_serve_mesh
+
+        try:
+            serve_spec = parse_serve_mesh(args.serve_mesh)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        if serve_spec.n_devices > n:
+            raise SystemExit(
+                f"--serve-mesh {args.serve_mesh} needs "
+                f"{serve_spec.n_devices} devices, host has {n}"
+            )
+        # Replica 0's mesh; _serve_router slices further replicas their
+        # own devices when the host has enough.
+        mesh = build_serve_mesh(
+            serve_spec, devices=jax.devices()[: serve_spec.n_devices]
+        )
 
     if args.byte_tokenizer:
         from .tokenizers import ByteTokenizer
@@ -324,6 +380,11 @@ def main() -> None:
         )
     if args.attn:
         config = config.replace(attn_impl=args.attn)
+    if serve_spec is not None:
+        # A clear refusal at startup beats a silently unplaced mesh.
+        from .parallel.serve_mesh import validate_serve_mesh
+
+        validate_serve_mesh(config, mesh, args.slots)
     if args.quantize:
         from .ops.quant import is_quantized, quantize_params
 
@@ -399,6 +460,12 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None,
         logger = StructuredLogger(
             json_mode=getattr(args, "log_json", False)
         )
+    if getattr(args, "replicas", 1) > 1:
+        _serve_router(
+            params, config, tokenizer, mesh, args,
+            _test_hook=_test_hook, logger=logger,
+        )
+        return
 
     stops = tuple(
         int(s) for s in getattr(tokenizer, "stop_tokens", [tokenizer.eos_id])
@@ -422,6 +489,15 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None,
         install_trace_hook(injector.fire)
         logger.log("faults_armed", spec=fault_spec)
     draft_params, draft_config = _load_draft(args, mesh)
+    if getattr(args, "serve_mesh", None) and draft_config is not None:
+        # main() validated the TARGET before the draft existed; an
+        # explicit --serve-mesh whose tensor axis cannot divide the
+        # draft's KV heads must refuse, not silently unplace.
+        from .parallel.serve_mesh import validate_serve_mesh
+
+        validate_serve_mesh(
+            config, mesh, args.slots, draft_config=draft_config
+        )
     # The observability sink (request timelines, dispatch spans, latency
     # histograms, SLO scoring) is constructed HERE so the CLI's SLO
     # deadlines reach it; the batcher adopts it into its captured ctor
@@ -558,6 +634,199 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None,
             # The trace-time hook is a module global: clear it so an
             # embedding process (or the test suite) does not keep firing
             # a dead drill's injector on later traces.
+            install_trace_hook(None)
+
+
+def _serve_router(params, config, tokenizer, mesh, args,
+                  _test_hook=None, logger=None) -> None:
+    """``--replicas N`` mode: N independent batcher+server replicas —
+    each owning its own device slice when the host has
+    ``N x mesh_devices`` devices, sharing replica 0's mesh otherwise —
+    behind one :class:`~jax_llama_tpu.router.ReplicaRouter` speaking
+    the standard protocol on the ``--http`` port.
+
+    ``_test_hook(router, servers)``, when given, runs once everything
+    is up and then the function returns instead of blocking."""
+    import os
+    import signal
+    import time
+
+    import jax
+
+    from .obs import Observability, StructuredLogger
+    from .parallel.partition import shard_params
+    from .parallel.serve_mesh import build_serve_mesh, parse_serve_mesh
+    from .router import ReplicaRouter
+    from .server import LLMServer
+    from .serving import ContinuousBatcher
+
+    if logger is None:
+        logger = StructuredLogger(
+            json_mode=getattr(args, "log_json", False)
+        )
+    stops = tuple(
+        int(s) for s in getattr(tokenizer, "stop_tokens", [tokenizer.eos_id])
+    )
+    fault_spec = (
+        getattr(args, "inject_faults", None) or os.environ.get("JLT_FAULTS")
+    )
+    injector = None
+    if fault_spec:
+        from .faults import FaultInjector, install_trace_hook
+
+        # ONE injector serves the router site and every replica's
+        # batcher sites, so site@N counters index process dispatches.
+        injector = FaultInjector(
+            fault_spec, seed=getattr(args, "fault_seed", 0)
+        )
+        install_trace_hook(injector.fire)
+        logger.log("faults_armed", spec=fault_spec)
+    draft_params, draft_config = _load_draft(args, mesh)
+
+    # Per-replica meshes: slice fresh devices per replica when the host
+    # has enough, otherwise every replica shares replica 0's mesh (the
+    # CPU dev-box case — still N independent pools/queues, just
+    # time-sharing the devices).
+    spec = (
+        parse_serve_mesh(args.serve_mesh)
+        if getattr(args, "serve_mesh", None) else None
+    )
+    if spec is not None:
+        # Startup-time refusal with the DRAFT model in hand too — the
+        # main() check ran before the draft was loaded, and a draft
+        # whose KV heads the tensor axis cannot divide would otherwise
+        # silently fall back to unplaced.
+        from .parallel.serve_mesh import validate_serve_mesh
+
+        validate_serve_mesh(
+            config, mesh, args.slots, draft_config=draft_config
+        )
+    devs = jax.devices()
+    meshes, rep_params, rep_draft = [], [], []
+    per = spec.n_devices if spec is not None else 0
+    for i in range(args.replicas):
+        if spec is not None and len(devs) >= (i + 1) * per:
+            m = build_serve_mesh(spec, devices=devs[i * per:(i + 1) * per])
+            meshes.append(m)
+            rep_params.append(
+                params if i == 0 else shard_params(params, m, config)
+            )
+            # The draft rides the same per-replica device slice — a
+            # draft committed to replica 0's devices would either fail
+            # jit's device check or pay a cross-device transfer every
+            # speculative dispatch on the other replicas.
+            rep_draft.append(
+                draft_params if draft_params is None or i == 0
+                else shard_params(draft_params, m, draft_config)
+            )
+        else:
+            meshes.append(mesh)
+            rep_params.append(params)
+            rep_draft.append(draft_params)
+    if spec is not None and len(devs) < args.replicas * per:
+        logger.log(
+            "serve_mesh_shared",
+            f"host has {len(devs)} devices < replicas x mesh "
+            f"({args.replicas} x {per}); replicas time-share one mesh",
+        )
+
+    servers = []
+    try:
+        for i in range(args.replicas):
+            obs = Observability(
+                slo_ttft_ms=getattr(args, "slo_ttft_ms", 0.0) or None,
+                slo_itl_ms=getattr(args, "slo_itl_ms", 0.0) or None,
+            )
+            cb = ContinuousBatcher(
+                rep_params[i], config, n_slots=args.slots,
+                max_len=config.max_seq_len, stop_tokens=stops,
+                temperature=args.temperature, top_p=args.top_p,
+                seed=args.seed + i, mesh=meshes[i],
+                logprobs=getattr(args, "logprobs", False),
+                prefix_cache=not getattr(args, "no_prefix_cache", False),
+                fault_injector=injector,
+                decode_chunk=getattr(args, "decode_chunk", 8),
+                draft_params=rep_draft[i], draft_config=draft_config,
+                n_draft=getattr(args, "n_draft", 4),
+                spec_rounds=getattr(args, "spec_rounds", 8),
+                prefill_budget=getattr(args, "prefill_budget", 512),
+                prefix_index=getattr(args, "prefix_index", "radix"),
+                host_kv_blocks=getattr(args, "host_kv_blocks", 0),
+                obs=obs,
+            )
+            srv = LLMServer(
+                cb, tokenizer=tokenizer, host=args.host, port=0,
+                replica_id=i,
+                max_recoveries=getattr(args, "max_recoveries", 3),
+                recovery_window_s=getattr(args, "recovery_window_s", 60.0),
+                watchdog_deadline_s=(
+                    getattr(args, "watchdog_s", 60.0) or None
+                ),
+                drain_timeout_s=getattr(args, "drain_timeout_s", 30.0),
+                logger=logger,
+                max_queue=getattr(args, "max_queue", 256),
+                priority_classes=(
+                    getattr(args, "priority_classes", "on") == "on"
+                ),
+            )
+            servers.append(srv.start())
+        router = ReplicaRouter(
+            servers, host=args.host, port=args.http,
+            policy=getattr(args, "route", "least-loaded"),
+            fault_injector=injector, logger=logger,
+        ).start()
+        try:
+            logger.log(
+                "serving_replicas", address=router.address,
+                replicas=args.replicas,
+                policy=getattr(args, "route", "least-loaded"),
+                meshes=[str(dict(m.shape)) if m is not None else None
+                        for m in meshes],
+            )
+            if _test_hook is not None:
+                _test_hook(router, servers)
+                return
+            state = {"signaled": False}
+
+            def _on_signal(signum, frame):
+                state["signaled"] = True
+                signal.signal(signal.SIGINT, signal.default_int_handler)
+
+            previous = []
+            try:
+                for sig in (signal.SIGTERM, signal.SIGINT):
+                    previous.append((sig, signal.signal(sig, _on_signal)))
+            except ValueError:
+                previous = []
+            try:
+                while not state["signaled"]:
+                    time.sleep(0.2)
+                drain_s = getattr(args, "drain_timeout_s", 30.0)
+                logger.log("drain_begin", "all replicas draining",
+                           timeout_s=drain_s)
+                for srv in servers:
+                    srv.begin_drain()
+                for srv in servers:
+                    srv.wait_drained(drain_s + 10)
+                logger.log("drained", "shutting down")
+            except KeyboardInterrupt:
+                for srv in servers:
+                    srv.begin_drain(timeout_s=0.0)
+                logger.log("hard_shutdown", "second interrupt")
+            finally:
+                for sig, old in previous:
+                    try:
+                        signal.signal(sig, old)
+                    except (ValueError, TypeError):
+                        pass
+        finally:
+            router.stop()
+    finally:
+        for srv in servers:
+            srv.stop()
+        if injector is not None:
+            from .faults import install_trace_hook
+
             install_trace_hook(None)
 
 

@@ -294,6 +294,7 @@ from urllib.parse import parse_qs, unquote, urlsplit
 from .degrade import DegradeManager
 from .obs import Observability, StructuredLogger, metric_meta
 from .overload import PRIORITIES, RUNG_INDEX, OverloadController
+from .parallel import serve_mesh as smesh
 from .serving import ContinuousBatcher, _round_up
 
 # Injection-site -> degradable-feature attribution for dispatch
@@ -368,6 +369,10 @@ class _Pending:
     # this (survives crash-recovery resubmits, so the gauge reflects
     # what the CLIENT waited, recovery included).
     submitted_at: Optional[float] = None
+    # ReplicaRouter decision (the X-Routed-By request header, e.g.
+    # "replica-1/least-loaded"): recorded on the request's timeline at
+    # submit so /debug/requests/<id> shows which replica served it.
+    route: Optional[str] = None
     # End-to-end request id: the client's X-Request-Id header when
     # supplied, a generated hex id otherwise.  Echoed in every reply
     # (blocking body, each stream line, error bodies) and the key of
@@ -439,8 +444,14 @@ class LLMServer:
         brownout_cooldown_s: float = 10.0,
         brownout_batch_max_new: int = 64,
         brownout_demote_blocks: int = 32,
+        replica_id: Optional[int] = None,
     ):
         self.batcher = batcher
+        # Replica index behind a ReplicaRouter (router.py); None when
+        # standalone.  Purely observational: /healthz gains a
+        # ``replica`` section and /metrics a ``replica_id`` gauge so a
+        # fleet scrape can tell the instances apart.
+        self.replica_id = replica_id
         # Structured logging (obs.StructuredLogger; run.py --log-json):
         # lifecycle events — recoveries, quarantines, per-request
         # failures — go through one formatter carrying request_id /
@@ -800,6 +811,9 @@ class LLMServer:
                     # longer than the old always-drained inbox, and
                     # the client's clock started here.
                     received_at=now, submitted_at=now,
+                    route=(
+                        self.headers.get("X-Routed-By") or ""
+                    ).strip()[:64] or None,
                 )
                 if t is not None:
                     pending.deadline = now + t
@@ -1241,6 +1255,10 @@ class LLMServer:
         # resolves (replays re-bind their fresh rid into the same
         # timeline — see _rebuild_and_replay).
         self.obs.bind(rid, p.ext_id)
+        if p.route is not None:
+            # Router decision onto the timeline + annotation ring —
+            # /debug/requests/<id> shows which replica served it.
+            self.obs.set_route(p.ext_id, p.route)
         if p.submitted_at is None:  # replays keep the original stamp
             p.submitted_at = time.monotonic()
         # Snapshot the replay state (crash recovery resubmits from it):
@@ -1552,6 +1570,40 @@ class LLMServer:
                 "restored_waiting": len(self.batcher._restored_ready),
             },
             "overload": self.overload.health(),
+            # Scale-out serving (serve_mesh.py / router.py): the mesh
+            # this replica's batcher runs on and its occupancy — what
+            # the ReplicaRouter's least-loaded policy and its
+            # aggregate /healthz ``replicas`` section read.
+            "replica": {
+                "id": self.replica_id,
+                # audit: racy-read(point-in-time /healthz snapshot of
+                # loop-owned batcher occupancy; len()/sum reads are
+                # GIL-atomic, a scrape may be one step stale)
+                # The sharding actually ACTIVE: meshes outside the
+                # placement envelope report 1/1 + placed=False, so a
+                # fleet scrape sees the degraded (unplaced) state
+                # instead of the mesh the batcher was merely handed.
+                "serve_mesh": smesh.mesh_shape(
+                    getattr(self.batcher, "mesh", None)
+                    if getattr(self.batcher, "_mesh_placed", False)
+                    else None
+                ),
+                "serve_mesh_placed": bool(
+                    getattr(self.batcher, "_mesh_placed", False)
+                ),
+                "active_slots": sum(
+                    s is not None for s in self.batcher.slots.values()
+                ),
+                "n_slots": self.batcher.n_slots,
+                "queued": (
+                    self._inbox.qsize() + len(self._active)
+                    + self.overload.queued_total()
+                ),
+                "kv_handoff_blocks": (
+                    getattr(self.batcher, "kv_export_blocks_total", 0)
+                    + getattr(self.batcher, "kv_import_blocks_total", 0)
+                ),
+            },
             "features": features,
         }
 
@@ -1853,6 +1905,11 @@ class LLMServer:
             "ttft_ms_ewma": (
                 round(self.ttft_ms_ewma, 3)
                 if self.ttft_ms_ewma is not None else 0.0
+            ),
+            # Scale-out serving: which replica this is (-1 standalone);
+            # the serve_mesh_* shape gauges ride batcher.stats().
+            "replica_id": (
+                self.replica_id if self.replica_id is not None else -1
             ),
         })
         lines = []

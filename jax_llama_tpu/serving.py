@@ -143,6 +143,32 @@ TPU-native mechanics:
     (fault site ``kv_swap``) fails only the restoring request with
     its blocks unpinned; the index/tier rebuild empty on crash
     recovery and replayed requests re-prefill cold, token-identically.
+  * **Serving-mesh sharding** (``parallel/serve_mesh.py``; run.py
+    ``--serve-mesh dp,tp``).  On a data x tensor serving mesh inside
+    the placement envelope (tensor divides KV heads, data*fsdp
+    divides ``n_slots``, no seq/stage axes) the batcher places its
+    state SHARDED at construction — the KV pool(s) split their
+    KV-head axis over ``tensor`` (the paged kernel's own shard_map
+    layout), the per-slot device twins split rows over the batch
+    axes — and every chunk program re-constrains its outputs to the
+    same specs, so each donated leaf aliases shard-locally from the
+    first dispatch (no per-dispatch GSPMD reshard, no silent
+    donation copy; proven per program by the lowering auditor's mesh
+    pass).  Host boundary under sharding: the packed per-chunk fetch
+    is replicated-out (one [1-2, B, K] block regardless of mesh
+    size — ``np.asarray`` gathers the addressable shards), dirty-row
+    ``_scatter_rows`` uploads are small host arrays GSPMD scatters to
+    the row shards, and host-tier swap slabs stage PRE-SHARDED with
+    the pool's layout (``kvcache.stage_restore`` placements) so the
+    adoption scatter is shard-local.  The radix prefix index stays
+    host-global: block ids are global, only the KV-head slice
+    differs per shard.  Sharded chunk output is token-identical to
+    single-chip (logprobs to cross-shard-reduction tolerance),
+    pinned by tests/test_serve_mesh.py.  Data parallelism ACROSS
+    batchers — replica routing, health-driven re-route, and the
+    prefill/decode disaggregation handoff (``export_prefix`` /
+    ``import_prefix``: the host-tier fetch/adopt primitives pointed
+    across replicas) — lives in ``router.py``.
 """
 
 from __future__ import annotations
@@ -186,6 +212,7 @@ from .models.llama import (
 from .ops.attention import NEG_INF
 from .ops.sampling import stop_token_hits
 from .parallel.mesh import use_mesh
+from .parallel import serve_mesh as smesh
 from .spec_decode import (
     accepted_emit_counts,
     draft_categorical,
@@ -509,14 +536,15 @@ def _decode_step_core(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "config", "mesh", "all_greedy", "allow_kernel", "with_logprobs"
+        "config", "mesh", "all_greedy", "allow_kernel", "with_logprobs",
+        "placed",
     ),
     donate_argnames=("pool",),
 )
 def _paged_decode_step(
     params, pool, table, n_alloc, fill, tau, pos, active, keys,
     temperature, top_p, top_k, *, config, all_greedy=False, mesh=None,
-    allow_kernel=True, with_logprobs=False,
+    allow_kernel=True, with_logprobs=False, placed=False,
 ):
     """One [n_slots, 1] decode step over the paged pool.
 
@@ -546,12 +574,16 @@ def _paged_decode_step(
         use_kernel = allow_kernel and _kernel_eligible(
             pool.block_size, mesh, config.kv_heads, tau.shape[0]
         )
-        return _decode_step_core(
+        nxt, lp, keys, pool = _decode_step_core(
             params, pool, table, n_alloc, fill, tau, pos, active, keys,
             temperature, top_p, top_k, config=config,
             all_greedy=all_greedy, use_kernel=use_kernel,
             with_logprobs=with_logprobs,
         )
+        if placed:
+            keys, = smesh.constrain_rows(keys)
+            pool = smesh.constrain_pool(pool)
+        return nxt, lp, keys, pool
 
 
 # "No token emitted this chunk column" marker in the [B, K] token block
@@ -564,7 +596,7 @@ _CHUNK_PAD = -2
     jax.jit,
     static_argnames=(
         "config", "n_iter", "mesh", "all_greedy", "allow_kernel",
-        "with_logprobs",
+        "with_logprobs", "placed",
     ),
     donate_argnames=(
         "pool", "fill", "tau", "tau_lp", "pos", "active", "remaining",
@@ -575,7 +607,7 @@ def _paged_decode_chunk(
     params, pool, table, n_alloc, fill, tau, tau_lp, pos, active,
     remaining, stops, keys, temperature, top_p, top_k, *,
     config, n_iter, all_greedy=False, mesh=None, allow_kernel=True,
-    with_logprobs=False,
+    with_logprobs=False, placed=False,
 ):
     """``n_iter`` fused decode iterations in ONE jitted program — the
     chunked-decode hot path.  Each ``lax.scan`` iteration replays the
@@ -623,6 +655,7 @@ def _paged_decode_chunk(
             active, remaining, stops, keys, temperature, top_p, top_k,
             config=config, n_iter=n_iter, all_greedy=all_greedy,
             use_kernel=use_kernel, with_logprobs=with_logprobs,
+            placed=placed,
         )
 
 
@@ -630,6 +663,7 @@ def _chunk_scan(
     params, pool, table, n_alloc, fill, tau, tau_lp, pos, active,
     remaining, stops, keys, temperature, top_p, top_k, *,
     config, n_iter, all_greedy, use_kernel, with_logprobs,
+    placed=False,
 ):
     """The shared K-iteration fused decode scan — the body of
     ``_paged_decode_chunk`` AND the decode half of ``_fused_chunk`` (the
@@ -676,6 +710,20 @@ def _chunk_scan(
         length=n_iter,
     )
     pool, tau, tau_lp, fill, pos, active, remaining, keys = carry
+    # Serving-mesh placement (parallel/serve_mesh.py): pin the carried
+    # state and pool outputs to their canonical shardings so the
+    # donated inputs (placed the same way at construction) alias
+    # shard-locally instead of resharding per dispatch.  ``placed``
+    # is the CTOR's placement decision threaded through as a static
+    # arg — every program a batcher dispatches constrains (or not)
+    # consistently, so pool sharding can never ping-pong between an
+    # insert and a chunk dispatch.  Trace-time no-op when False.
+    if placed:
+        (tau, tau_lp, fill, pos, active, remaining,
+         keys) = smesh.constrain_rows(
+            tau, tau_lp, fill, pos, active, remaining, keys
+        )
+        pool = smesh.constrain_pool(pool)
     toks = jnp.swapaxes(toks, 0, 1)  # [B, K]
     if with_logprobs:
         # One packed transfer: fp32 logprobs ride bitcast to int32
@@ -696,7 +744,7 @@ def _chunk_scan(
     jax.jit,
     static_argnames=(
         "config", "n_iter", "pf_chunk", "all_greedy", "mesh",
-        "allow_kernel", "with_logprobs",
+        "allow_kernel", "with_logprobs", "placed",
     ),
     donate_argnames=(
         "pool", "fill", "tau", "tau_lp", "pos", "active", "remaining",
@@ -708,7 +756,7 @@ def _fused_chunk(
     remaining, stops, keys, temperature, top_p, top_k,
     pf_row, pf_toks, pf_len, pf_base, pf_off, pf_key, *,
     config, n_iter, pf_chunk, all_greedy=False, mesh=None,
-    allow_kernel=True, with_logprobs=False,
+    allow_kernel=True, with_logprobs=False, placed=False,
 ):
     """The fused prefill-decode program: ONE jitted dispatch that
     advances up to ``pf_chunk`` prompt tokens of the single in-flight
@@ -821,6 +869,7 @@ def _fused_chunk(
             active, remaining, stops, keys, temperature, top_p, top_k,
             config=config, n_iter=n_iter, all_greedy=all_greedy,
             use_kernel=use_kernel, with_logprobs=with_logprobs,
+            placed=placed,
         )
         return out + (pf_off,)
 
@@ -851,13 +900,16 @@ def _token_logprob(logits: jnp.ndarray, tok: jnp.ndarray) -> jnp.ndarray:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "mesh", "prefill_chunk", "with_logprobs"),
+    static_argnames=(
+        "config", "mesh", "prefill_chunk", "with_logprobs", "placed",
+    ),
     donate_argnames=("pool",),
 )
 def _paged_insert(
     params, pool, block_ids, prompt_tokens, prompt_mask, keys,
     temperature, top_p, top_k, *,
     config, prefill_chunk=None, mesh=None, with_logprobs=False,
+    placed=False,
 ):
     """Prefill a batch of k admitted requests and land their KV in their
     reserved blocks.
@@ -955,18 +1007,28 @@ def _paged_insert(
                     to_blocks(sub.v_scale), mode="drop"
                 ),
             )
+        # Serving-mesh placement: the donated pool leaves the insert
+        # with the same canonical sharding it arrived with (``placed``
+        # is the ctor's decision — the SAME predicate every other
+        # program uses, so insert and chunk dispatches can never
+        # disagree about the pool's sharding).
+        if placed:
+            pool = smesh.constrain_pool(pool)
         return tau, tau_lp, plen, keys, pool
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "mesh", "prefill_chunk", "with_logprobs"),
+    static_argnames=(
+        "config", "mesh", "prefill_chunk", "with_logprobs", "placed",
+    ),
     donate_argnames=("pool",),
 )
 def _paged_suffix_insert(
     params, pool, table_row, n_alloc_row, fill0, suffix_tokens,
     suffix_mask, keys, temperature, top_p, top_k, *,
     config, prefill_chunk=None, mesh=None, with_logprobs=False,
+    placed=False,
 ):
     """Prefill k requests' prompt SUFFIXES over the paged pool — the
     prefix-cache admission path: the leading ``fill0[i]`` positions of
@@ -1029,6 +1091,9 @@ def _paged_suffix_insert(
         # Non-finite guard (see _paged_decode_step): -1 sentinel rows are
         # failed by the host at the next emit boundary.
         tau = jnp.where(finite_rows(logits_last), tau, -1)
+        # Serving-mesh placement: see _paged_insert's epilogue.
+        if placed:
+            pool = smesh.constrain_pool(pool)
         return tau, lp, keys, pool
 
 
@@ -1299,7 +1364,7 @@ def _spec_round_core(
     jax.jit,
     static_argnames=(
         "t_config", "d_config", "n_draft", "all_greedy", "use_kernel",
-        "mesh", "with_logprobs",
+        "mesh", "with_logprobs", "placed",
     ),
     donate_argnames=("t_pool", "d_pool"),
 )
@@ -1307,25 +1372,30 @@ def _spec_round(
     t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau, pos,
     active, keys, temperature, top_p, top_k, *,
     t_config, d_config, n_draft, all_greedy, use_kernel, mesh=None,
-    with_logprobs=False,
+    with_logprobs=False, placed=False,
 ):
     """One jitted speculative round — the classic one-dispatch-per-round
     program (``spec_rounds=1``); a thin jit wrapper over
     ``_spec_round_core`` (see its docstring for the full contract)."""
-    return _spec_round_core(
+    outs, acc, lps, keys, t_pool, d_pool = _spec_round_core(
         t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau,
         pos, active, keys, temperature, top_p, top_k,
         t_config=t_config, d_config=d_config, n_draft=n_draft,
         all_greedy=all_greedy, use_kernel=use_kernel, mesh=mesh,
         with_logprobs=with_logprobs,
     )
+    with use_mesh(mesh):
+        if placed:
+            t_pool = smesh.constrain_pool(t_pool)
+            d_pool = smesh.constrain_pool(d_pool)
+    return outs, acc, lps, keys, t_pool, d_pool
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "t_config", "d_config", "n_draft", "n_rounds", "all_greedy",
-        "use_kernel", "mesh", "with_logprobs",
+        "use_kernel", "mesh", "with_logprobs", "placed",
     ),
     donate_argnames=(
         "t_pool", "d_pool", "fill", "tau", "tau_lp", "pos", "active",
@@ -1336,7 +1406,7 @@ def _spec_rounds_chunk(
     t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau,
     tau_lp, pos, active, remaining, stops, keys, temperature, top_p,
     top_k, *, t_config, d_config, n_draft, n_rounds, all_greedy,
-    use_kernel, mesh=None, with_logprobs=False,
+    use_kernel, mesh=None, with_logprobs=False, placed=False,
 ):
     """``n_rounds`` fused speculative rounds in ONE jitted program — the
     speculative twin of ``_paged_decode_chunk``.  Each ``lax.scan``
@@ -1462,6 +1532,16 @@ def _spec_rounds_chunk(
         )
         (t_pool, d_pool, tau, tau_lp, fill, pos, active, remaining,
          keys) = carry
+        # Serving-mesh placement: see _chunk_scan's epilogue (the
+        # ctor's placement decision already required BOTH pools inside
+        # the envelope — the draft pool shards its own KV-head axis).
+        if placed:
+            (tau, tau_lp, fill, pos, active, remaining,
+             keys) = smesh.constrain_rows(
+                tau, tau_lp, fill, pos, active, remaining, keys
+            )
+            t_pool = smesh.constrain_pool(t_pool)
+            d_pool = smesh.constrain_pool(d_pool)
         toks = jnp.moveaxis(toks, 0, 1)   # [B, R, G+1]
         accs = jnp.swapaxes(accs, 0, 1)   # [B, R]
         if with_logprobs:
@@ -1771,6 +1851,24 @@ class ContinuousBatcher:
             init_pool(self.draft_config, self.n_blocks, self.block_size)
             if self.spec else None
         )
+        # Serving-mesh placement (parallel/serve_mesh.py): on a
+        # data x tensor serving mesh inside the placement envelope, the
+        # KV pool(s) shard their KV-head axis over `tensor` and the
+        # per-slot device twins shard rows over the batch axes, AT
+        # CONSTRUCTION — matching the output constraints the chunk
+        # programs apply, so every donated leaf aliases shard-locally
+        # from the first dispatch (no per-dispatch GSPMD reshard, no
+        # silent donation copy).  Meshes outside the envelope (seq or
+        # stage axes, non-dividing tensor/rows) keep legacy placement
+        # — GSPMD still serves them through propagation.
+        self._mesh_placed = smesh.placement_ok(
+            config, mesh, n_slots,
+            draft_config=draft_config if self.spec else None,
+        )
+        if self._mesh_placed:
+            self.pool = smesh.shard_pool(self.pool, mesh)
+            if self.draft_pool is not None:
+                self.draft_pool = smesh.shard_pool(self.draft_pool, mesh)
         self.free_blocks: List[int] = list(range(self.n_blocks))
         # Prefix cache (vLLM-style, r5): full prompt blocks are keyed by
         # a position-invariant chain hash of their tokens; admission
@@ -1857,6 +1955,10 @@ class ContinuousBatcher:
         self.swap_ins_total = 0
         self.swap_in_ms_total = 0.0
         self.swap_failures_total = 0
+        # Disaggregation handoff (export_prefix / import_prefix):
+        # prefix blocks shipped to / landed from peer replicas.
+        self.kv_export_blocks_total = 0
+        self.kv_import_blocks_total = 0
         # Host-side numpy mirrors of the per-slot decode state — the
         # AUTHORITATIVE copy for all host bookkeeping (admission
         # capacity, slot frees, replay).  The chunked decode path keeps
@@ -1868,17 +1970,23 @@ class ContinuousBatcher:
         # token block per chunk.  Only the CLASSIC speculative path
         # (spec_rounds=1) still uploads the mirrors per round.
         B, MB = n_slots, self.blocks_per_slot
+        # Row placer: the mesh-sharded upload for [B, ...] per-slot
+        # device arrays (plain jnp.asarray without placement).
+        self._rows = (
+            functools.partial(smesh.place_rows, mesh)
+            if self._mesh_placed else jnp.asarray
+        )
         self.table = np.full((B, MB), self.n_blocks, np.int32)
         self.n_alloc = np.zeros((B,), np.int32)
         self.fill = np.zeros((B,), np.int32)
-        self.tau = jnp.zeros((B,), jnp.int32)
+        self.tau = self._rows(jnp.zeros((B,), jnp.int32))
         # Model logprob of each slot's pending tau (valid while active).
         # The numpy mirror serves the speculative emit scan; the chunked
         # path carries the device twin through the chunk program.
         self.tau_lp = np.zeros((B,), np.float32)
         self.pos = np.zeros((B,), np.int32)
         self.active = np.zeros((B,), bool)
-        self.keys = jnp.zeros((B, 2), jnp.uint32)
+        self.keys = self._rows(jnp.zeros((B, 2), jnp.uint32))
         self.temp_arr = np.zeros((B,), np.float32)
         self.top_p_arr = np.ones((B,), np.float32)
         self.top_k_arr = np.zeros((B,), np.int32)
@@ -1916,18 +2024,19 @@ class ContinuousBatcher:
         # (the spec round program has no prefill lane).
         self.prefill_budget = max(0, int(prefill_budget))
         self._pf: Optional[_Prefill] = None
-        # Device-resident twins (chunked path only).
-        self.d_table = jnp.asarray(self.table)
-        self.d_n_alloc = jnp.asarray(self.n_alloc)
-        self.d_fill = jnp.asarray(self.fill)
-        self.d_pos = jnp.asarray(self.pos)
-        self.d_active = jnp.asarray(self.active)
-        self.d_temps = jnp.asarray(self.temp_arr)
-        self.d_top_ps = jnp.asarray(self.top_p_arr)
-        self.d_top_ks = jnp.asarray(self.top_k_arr)
-        self.d_remaining = jnp.asarray(self.remaining)
-        self.d_stops = jnp.asarray(self.stop_tab)
-        self.d_tau_lp = jnp.zeros((B,), jnp.float32)
+        # Device-resident twins (chunked path only); row-sharded on a
+        # placed serving mesh (see _mesh_placed above).
+        self.d_table = self._rows(self.table)
+        self.d_n_alloc = self._rows(self.n_alloc)
+        self.d_fill = self._rows(self.fill)
+        self.d_pos = self._rows(self.pos)
+        self.d_active = self._rows(self.active)
+        self.d_temps = self._rows(self.temp_arr)
+        self.d_top_ps = self._rows(self.top_p_arr)
+        self.d_top_ks = self._rows(self.top_k_arr)
+        self.d_remaining = self._rows(self.remaining)
+        self.d_stops = self._rows(self.stop_tab)
+        self.d_tau_lp = self._rows(jnp.zeros((B,), jnp.float32))
         # Rows whose mirrors changed since the last device sync
         # (admission / free / cancel); flushed in one _scatter_rows
         # dispatch before the next chunk.
@@ -2232,6 +2341,21 @@ class ContinuousBatcher:
             "swap_out_blocks_total": self.swap_out_blocks_total,
             "swap_in_ms_total": round(self.swap_in_ms_total, 3),
             "swap_failures_total": self.swap_failures_total,
+            # Disaggregation handoff ledger + serving-mesh shape (1/1
+            # off-mesh AND on unplaced meshes — the gauge reports the
+            # sharding actually ACTIVE, not the mesh the batcher was
+            # handed; the router's aggregate view labels these per
+            # replica).
+            "kv_export_blocks_total": self.kv_export_blocks_total,
+            "kv_import_blocks_total": self.kv_import_blocks_total,
+            "serve_mesh_data": (
+                smesh.mesh_shape(self.mesh)["data"]
+                if self._mesh_placed else 1
+            ),
+            "serve_mesh_tensor": (
+                smesh.mesh_shape(self.mesh)["tensor"]
+                if self._mesh_placed else 1
+            ),
             "nonfinite_rows_total": self.nonfinite_rows_total,
             # Chunked-decode observability: the effective K of the most
             # recent chunk dispatch, dispatch count, and the host-
@@ -2403,7 +2527,7 @@ class ContinuousBatcher:
             # Stop-table width grew (pow2-bucketed): rebuild the device
             # twin wholesale before the row scatter — admission-time
             # only, and the array is [B, S] ints.
-            self.d_stops = jnp.asarray(self.stop_tab)
+            self.d_stops = self._rows(self.stop_tab)
         rows = sorted(self._dirty_rows)
         self._dirty_rows.clear()
         R = len(rows)
@@ -2521,7 +2645,7 @@ class ContinuousBatcher:
                 self.d_temps, self.d_top_ps, self.d_top_ks,
                 config=self.config, n_iter=K, all_greedy=all_greedy,
                 mesh=self.mesh, allow_kernel=self.use_pallas_kernel,
-                with_logprobs=self.logprobs,
+                with_logprobs=self.logprobs, placed=self._mesh_placed,
             )
         else:
             # The prefilling request samples inside the program, so the
@@ -2539,7 +2663,7 @@ class ContinuousBatcher:
                 config=self.config, n_iter=K, pf_chunk=pf.chunk,
                 all_greedy=all_greedy, mesh=self.mesh,
                 allow_kernel=self.use_pallas_kernel,
-                with_logprobs=self.logprobs,
+                with_logprobs=self.logprobs, placed=self._mesh_placed,
             )
             self.prefill_chunks_total += 1
             pf.off += pf.chunk
@@ -2776,7 +2900,7 @@ class ContinuousBatcher:
             t_config=self.config, d_config=self.draft_config,
             n_draft=self.n_draft, n_rounds=R, all_greedy=all_greedy,
             use_kernel=self._spec_kernel_ok(), mesh=self.mesh,
-            with_logprobs=self.logprobs,
+            with_logprobs=self.logprobs, placed=self._mesh_placed,
         )
         # THE one device->host sync of the chunk: tokens, acceptance
         # counts and (bitcast) logprobs in a single packed array.
@@ -2940,7 +3064,7 @@ class ContinuousBatcher:
             t_config=self.config, d_config=self.draft_config,
             n_draft=self.n_draft, all_greedy=all_greedy,
             use_kernel=self._spec_kernel_ok(), mesh=self.mesh,
-            with_logprobs=self.logprobs,
+            with_logprobs=self.logprobs, placed=self._mesh_placed,
         )
         tf_obs = time.monotonic()
         # audit: host-fetch(classic spec path: per-round outs fetch; counted)
@@ -3138,6 +3262,105 @@ class ContinuousBatcher:
             return
         self._invalidate_evicted(blocks)
         self.free_blocks.extend(blocks)
+
+    # -- prefill/decode disaggregation handoff ------------------------------
+
+    def export_prefix(
+        self, tokens: Sequence[int]
+    ) -> Tuple[List[bytes], List[Dict[str, Any]]]:
+        """Disaggregation handoff, PREFILL side: the longest
+        HBM-resident cached chain prefix of ``tokens`` fetched as host
+        slabs (``kvcache.fetch_slab``; the draft pool's twins ride
+        along under speculative serving).  A prefill replica serves a
+        request once (publishing its chain), exports here, and a
+        decode replica ``import_prefix``-es the slabs so the session's
+        next turn admits there as a plain prefix hit — the same
+        fetch/adopt primitives the host-DRAM tier uses, pointed across
+        replicas instead of across memory tiers (router.py owns the
+        orchestration).  Returns ``(chain_keys, slabs)``; empty when
+        the prefix cache is off or nothing is resident.
+
+        Must run on the thread that owns this batcher (the D2H fetch
+        is admission-class traffic, like demotion — never on the
+        decode hot path)."""
+        if not self.prefix_cache_enabled:
+            return [], []
+        keys = self._chain_keys(tokens, self.block_size)
+        match = self._match_prefix(keys)
+        slabs: List[Dict[str, Any]] = []
+        for blk in match.blocks:
+            slab = fetch_slab(self.pool, blk)
+            if self.spec:
+                slab.update(fetch_slab(self.draft_pool, blk, prefix="d_"))
+            slabs.append(slab)
+        self.kv_export_blocks_total += len(slabs)
+        return keys[: len(match.blocks)], slabs
+
+    def import_prefix(
+        self, keys: Sequence[bytes], slabs: Sequence[Dict[str, Any]]
+    ) -> int:
+        """Disaggregation handoff, DECODE side: land exported slabs in
+        this batcher's pool (alloc + ``kvcache.stage_restore`` +
+        ``adopt_into_pool`` — the host-tier swap-in path with the slabs
+        arriving from a peer instead of this replica's own tier) and
+        publish the chain, so the next admission of those tokens is a
+        prefix hit.  Blocks already resident here are skipped;
+        truncates to pool capacity.  Synchronous (admission-class, on
+        the owning thread); returns the number of blocks landed."""
+        if not self.prefix_cache_enabled or not slabs:
+            return 0
+        keys = list(keys)[: len(slabs)]
+        have = self._store.match(keys).blocks
+        todo = list(slabs)[len(have):len(keys)]
+        if not todo:
+            return 0
+        # Claim the matched resident blocks BEFORE allocating — the
+        # same discipline every admission path follows: idle matched
+        # blocks are exactly what _alloc_blocks evicts first, and an
+        # evicted-then-republished id would bind the old chain key to
+        # another chain's KV (silent wrong-token corruption).
+        self._claim_blocks(have)
+        try:
+            cap = self._capacity()
+            if len(todo) > cap:
+                todo = todo[:cap]
+            if not todo:
+                return 0
+            fresh = self._alloc_blocks(len(todo))
+            staged = stage_restore(
+                todo, fresh, self.n_blocks,
+                placements=(
+                    smesh.staging_shardings(self.mesh, list(todo[0]))
+                    if self._mesh_placed else None
+                ),
+            )
+            # audit: host-fetch(blocking handoff import: synchronous
+            # admission-class landing of peer slabs — nothing is
+            # decoding on behalf of this not-yet-admitted session)
+            jax.block_until_ready(list(staged.values()))
+            self.pool = adopt_into_pool(self.pool, staged)
+            if self.spec:
+                self.draft_pool = adopt_into_pool(
+                    self.draft_pool, staged, prefix="d_"
+                )
+            self._store.publish(
+                keys[: len(have) + len(todo)], have + fresh
+            )
+            # A node mid-swap-in (restoring) refuses the published
+            # copy: its fresh block stays unkeyed — free it instead
+            # of leaking.
+            adopted = [b for b in fresh if self._store.is_keyed(b)]
+            self._store.retain(adopted)
+            self._invalidate_and_free(
+                [b for b in fresh if b not in adopted]
+            )
+            self.kv_import_blocks_total += len(adopted)
+            return len(adopted)
+        finally:
+            # Matched blocks return to the idle LRU (nobody is using
+            # them yet — the claim only protected them from this
+            # call's own allocation).
+            self._unclaim_blocks(have)
 
     @staticmethod
     def _chain_keys(tokens: Sequence[int], block_size: int) -> List[bytes]:
@@ -3395,6 +3618,7 @@ class ContinuousBatcher:
             jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
             config=self.config, prefill_chunk=self.prefill_chunk,
             mesh=self.mesh, with_logprobs=self.logprobs,
+            placed=self._mesh_placed,
         )
         if self.spec:
             # Draft pool: the shared blocks hold the DRAFT model's KV
@@ -3411,6 +3635,7 @@ class ContinuousBatcher:
                 jnp.zeros((kb,), jnp.int32),
                 config=self.draft_config,
                 prefill_chunk=self.prefill_chunk, mesh=self.mesh,
+                placed=self._mesh_placed,
             )
         # Dispatch span (async submit — wall covers dispatch time only,
         # the suffix path's known undercount); linked into each
@@ -3537,7 +3762,12 @@ class ContinuousBatcher:
             self._fault("kv_swap")
             fresh = self._alloc_blocks(len(match.restore))
             staged = stage_restore(
-                [n.host for n in match.restore], fresh, self.n_blocks
+                [n.host for n in match.restore], fresh, self.n_blocks,
+                placements=(
+                    smesh.staging_shardings(
+                        self.mesh, list(match.restore[0].host)
+                    ) if self._mesh_placed else None
+                ),
             )
         except InjectedFault as e:
             self._store.unpin_restoring(match.restore)
@@ -3971,6 +4201,7 @@ class ContinuousBatcher:
                 jnp.asarray(top_ks),
                 config=self.config, prefill_chunk=self.prefill_chunk,
                 mesh=self.mesh, with_logprobs=self.logprobs,
+                placed=self._mesh_placed,
             )
             if self.spec:
                 # Prefill the draft pool over the same reserved blocks
@@ -3987,6 +4218,7 @@ class ContinuousBatcher:
                     jnp.zeros((kb,), jnp.int32),
                     config=self.draft_config,
                     prefill_chunk=self.prefill_chunk, mesh=self.mesh,
+                    placed=self._mesh_placed,
                 )
             slot_ids = [next(slot_iter) for _ in range(k)]
             # audit: host-upload(slot-index upload, once per admission)

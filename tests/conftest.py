@@ -97,6 +97,15 @@ def pytest_configure(config):
         "abstract-trace layer is also marked slow — select just them "
         "with pytest -m analysis or make lint-invariants)",
     )
+    config.addinivalue_line(
+        "markers",
+        "mesh_serving: scale-out serving tests (mesh-sharded chunk "
+        "programs on the forced 8-device CPU host mesh, sharded KV "
+        "pool placement, the ReplicaRouter + disaggregation handoff "
+        "— parallel/serve_mesh.py + router.py; the core parity pins "
+        "run in tier-1, the broad matrices are also marked slow — "
+        "select with pytest -m mesh_serving or make mesh-serve)",
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -130,6 +139,36 @@ def skip_if_xla_partition_id_skew(exc: BaseException) -> None:
             "jax/XLA, pre-existing at the seed of this image"
         )
     raise exc
+
+
+def mesh_guarded(fn, *args, **kwargs):
+    """Run a mesh-dispatching callable, converting THE known jaxlib
+    PartitionId/SPMD skew into a clean skip (every other exception
+    propagates) — the serve-mesh tests' wrapper around their first
+    sharded dispatch, extending ``skip_if_xla_partition_id_skew`` to
+    call sites that do not want a try/except at every dispatch."""
+    try:
+        return fn(*args, **kwargs)
+    except Exception as e:  # noqa: BLE001 - skew detection re-raises
+        skip_if_xla_partition_id_skew(e)
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    """The multi-device CPU fleet for mesh_serving tests: conftest
+    already forces ``--xla_force_host_platform_device_count=8`` before
+    jax import (top of this file), so this fixture only asserts the
+    environment delivered them (a stray XLA_FLAGS override would
+    otherwise fail every mesh test with an opaque mesh-size error)."""
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip(
+            f"need 8 forced host devices for serving-mesh tests, "
+            f"have {len(devs)} (XLA_FLAGS overridden?)"
+        )
+    return devs
 
 
 def xfail_if_remat_ulp_skew(a: np.ndarray, b: np.ndarray, label) -> bool:

@@ -547,6 +547,33 @@ class TestLoweringTracePackage:
         clear_examples()
         assert check_traces() == []
 
+    def test_mesh_contracts_trace_clean(self):
+        # The serving-mesh pass: every contract with a mesh_build
+        # lowers its SHARDED variant (donor attributes present for all
+        # donated leaves) and runs it once proving sharding stability
+        # (donated inputs leave with the sharding they entered with).
+        from jax_llama_tpu.analysis.lowering import check_mesh_traces
+
+        clear_examples()
+        assert check_mesh_traces() == []
+
+
+def test_mesh_contract_registry_consistent():
+    """Cheap (tier-1) registry hygiene for the mesh pass: the two
+    chunk programs carry mesh variants, every mesh_aliases key is a
+    declared donated arg, and alias positions are unique."""
+    from jax_llama_tpu.analysis.contracts import REGISTRY
+
+    with_mesh = {
+        n: c for n, c in REGISTRY.items() if c.mesh_build is not None
+    }
+    assert {"_paged_decode_chunk", "_fused_chunk"} <= set(with_mesh)
+    for name, c in with_mesh.items():
+        assert c.mesh_aliases, name
+        assert set(c.mesh_aliases) <= set(c.donated), name
+        positions = list(c.mesh_aliases.values())
+        assert len(positions) == len(set(positions)), name
+
 
 # ---------------------------------------------------------------------------
 # CLI

@@ -6,6 +6,7 @@ forward, which is itself parity-tested against torch in test_model.py)."""
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from jax_llama_tpu import config as cfg_lib
 from jax_llama_tpu.engine import GenerationConfig, generate, prompt_positions
@@ -125,9 +126,17 @@ def test_generate_from_str_roundtrip():
     assert outs == outs2
 
 
+@pytest.mark.slow
 def test_auto_impl_decode_matches_full_forward():
     """attn_impl='auto' mixes flash prefill (T>8) with the append-free xla
-    decode path (T==1); chunked decode must still match the full forward."""
+    decode path (T==1); chunked decode must still match the full forward.
+
+    Slow tier (PR-10 budget rebalance: tier-1 measured at its 870 s
+    ceiling): the auto-impl composition stays pinned tier-1 by
+    test_flash_attention.py (flash ≡ xla numerics), the chunked-prefill
+    identity below, and the serving fused suite (flash prefill chunks
+    under attn auto vs the classic path); this full-forward cross-check
+    runs in the unfiltered suite and `make chaos`-class targets."""
     import numpy as np
     from jax_llama_tpu import get_config, init_params
     from jax_llama_tpu.models import forward

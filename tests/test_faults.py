@@ -743,3 +743,122 @@ def test_run_cli_inject_faults_requires_http(tmp_path, monkeypatch):
     monkeypatch.setenv("JLT_FAULTS", "step@0:error")
     with pytest.raises(SystemExit, match="JLT_FAULTS"):
         run_cli.main()
+
+
+# ---------------------------------------------------------------------------
+# Replica-router fault site (router.py; scale-out serving)
+# ---------------------------------------------------------------------------
+
+def test_router_replica_fault_reroutes_losslessly(model, reference):
+    """Fault site ``router_replica``: the chosen replica "dies" at
+    dispatch time (before any byte reaches it) — the router marks it
+    unhealthy and re-routes the request to the survivor with NO token
+    loss; the health poller restores the replica (it is actually fine)
+    on its next sweep."""
+    from jax_llama_tpu.router import ReplicaRouter
+
+    params, config = model
+    servers = [
+        LLMServer(
+            ContinuousBatcher(params, config, n_slots=2, max_len=64),
+            replica_id=i,
+        ).start()
+        for i in range(2)
+    ]
+    inj = FaultInjector("router_replica@0:error")
+    # Manual health mode: the drill asserts the IMMEDIATE unhealthy
+    # mark, then drives recovery deterministically — a background
+    # sweep would restore the (actually fine) replica under us.
+    router = ReplicaRouter(
+        servers, policy="least-loaded", fault_injector=inj,
+        health_interval_s=0,
+    ).start()
+    try:
+        st, body = _post(
+            router.address,
+            {"prompt": PROMPTS[0], "max_new_tokens": MAX_NEW},
+        )
+        assert st == 200
+        assert body["tokens"] == reference[0]
+        assert inj.injected["router_replica"] == 1
+        h = router.health()
+        assert sum(r["healthy"] for r in h["replicas"]) == 1
+        m = router.metrics_text()
+        assert "llm_router_reroutes_total 1" in m
+        assert "llm_router_replica_failures_total 1" in m
+        assert 'policy="reroute"' in m
+        # The "failed" replica is actually healthy: the next health
+        # sweep restores it to the routable set.
+        router.check_health_now()
+        assert all(
+            r["healthy"] for r in router.health()["replicas"]
+        )
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_all_replicas_down_503_with_retry_after(model):
+    """Every replica unroutable -> clean 503 + Retry-After from the
+    router itself (never a hang, never a connection error)."""
+    from jax_llama_tpu.router import ReplicaRouter
+
+    params, config = model
+    srv = LLMServer(
+        ContinuousBatcher(params, config, n_slots=2, max_len=64),
+    ).start()
+    router = ReplicaRouter([srv], policy="least-loaded").start()
+    try:
+        srv.begin_drain(timeout_s=60.0)
+        router.check_health_now()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(router.address,
+                  {"prompt": PROMPTS[0], "max_new_tokens": 2})
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+    finally:
+        router.stop()
+        srv.stop()
+
+
+def test_router_inflight_crash_replays_via_replica_recovery(
+    model, reference
+):
+    """A mid-decode crash on the SERVING replica is handled by that
+    replica's own crash-recovery (rebuild + token-identical replay) —
+    the routed client sees the exact fault-free tokens, and the router
+    never duplicates the request."""
+    from jax_llama_tpu.router import ReplicaRouter
+
+    params, config = model
+    inj = FaultInjector("step@2:error")
+    crashy = LLMServer(
+        ContinuousBatcher(
+            params, config, n_slots=2, max_len=64, fault_injector=inj,
+        ),
+        replica_id=0,
+    ).start()
+    steady = LLMServer(
+        ContinuousBatcher(params, config, n_slots=2, max_len=64),
+        replica_id=1,
+    ).start()
+    router = ReplicaRouter(
+        [crashy, steady], policy="least-loaded",
+    ).start()
+    try:
+        # Idle tie-break routes the first request to replica 0 — the
+        # one armed to crash at its 3rd dispatch.
+        st, body = _post(
+            router.address,
+            {"prompt": PROMPTS[0], "max_new_tokens": MAX_NEW},
+        )
+        assert st == 200
+        assert body["tokens"] == reference[0]
+        assert crashy.recoveries_total == 1
+        assert steady.recoveries_total == 0
+        assert inj.injected["step"] == 1
+    finally:
+        router.stop()
+        crashy.stop()
+        steady.stop()

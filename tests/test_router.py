@@ -1,0 +1,262 @@
+"""ReplicaRouter: routed N-replica serving must be protocol- and
+token-identical to a single server, with health-driven re-routing,
+policy behavior, the aggregate observability surface, and the
+disaggregation handoff counter."""
+
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.router import ReplicaRouter, handoff_prefix
+from jax_llama_tpu.server import LLMServer
+from jax_llama_tpu.serving import ContinuousBatcher
+from jax_llama_tpu.tokenizers.bytes import ByteTokenizer
+
+pytestmark = pytest.mark.mesh_serving
+
+CFG = dict(
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    multiple_of=32, max_seq_len=128, dtype="float32",
+    param_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = get_config("tiny", **CFG)
+    params = init_params(jax.random.PRNGKey(0), config)
+    return params, config
+
+
+def _post(url, payload, path="/generate", timeout=300):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _get(url, path, timeout=60):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _mk_server(model, tok, **kw):
+    params, config = model
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64,
+        stop_tokens=tuple(tok.stop_tokens),
+    )
+    return LLMServer(cb, tokenizer=tok, **kw)
+
+
+@pytest.fixture(scope="module")
+def fleet(model):
+    """Two started replicas + a least-loaded router, shared by the
+    read-only tests (server startup/teardown is ~2 s a pair and tier-1
+    has no headroom); tests that mutate fleet health (drain) build
+    their own."""
+    tok = ByteTokenizer()
+    servers = [
+        _mk_server(model, tok, replica_id=i).start() for i in range(2)
+    ]
+    router = ReplicaRouter(servers, policy="least-loaded").start()
+    try:
+        yield router, servers, tok
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def _oracle(model, tok, prompts, max_new=8, seeds=None):
+    params, config = model
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64,
+        stop_tokens=tuple(tok.stop_tokens),
+    )
+    rids = [
+        cb.submit(
+            tok.encode(p, bos=True),
+            max_new_tokens=max_new,
+            **({"seed": seeds[i]} if seeds else {}),
+        )
+        for i, p in enumerate(prompts)
+    ]
+    done = cb.run_to_completion()
+    return [done[r] for r in rids]
+
+
+def test_routed_2_replicas_token_identical(model, fleet):
+    """ACCEPTANCE PIN: 2-replica routed serving ≡ 1-replica,
+    token-identical per request — blocking and streaming."""
+    router, servers, tok = fleet
+    prompts = ["hello tpu", "paged kv", "radix tree"]
+    want = _oracle(model, tok, prompts)
+    replicas_seen = set()
+    for i, p in enumerate(prompts):
+        st, body, hdrs = _post(
+            router.address, {"text": p, "max_new_tokens": 8}
+        )
+        assert st == 200
+        assert body["tokens"] == want[i], p
+        replicas_seen.add(hdrs.get("X-Replica-Id"))
+    # least-loaded on idle replicas alternates — both replicas served.
+    assert len(replicas_seen) == 2
+    # Streaming through the router: same tokens, line-by-line NDJSON.
+    req = urllib.request.Request(
+        router.address + "/generate",
+        data=json.dumps(
+            {"text": prompts[0], "max_new_tokens": 8, "stream": True}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        assert r.status == 200
+        lines = [json.loads(ln) for ln in r.read().splitlines() if ln]
+    toks = [ln["token"] for ln in lines if "token" in ln]
+    assert toks == want[0]
+    assert any(ln.get("done") for ln in lines)
+
+
+def test_unhealthy_replica_drains_and_reroutes(model):
+    """A draining replica (ok=false on /healthz) stops receiving new
+    requests (own fleet — draining the shared one would poison the
+    module's other tests)."""
+    tok = ByteTokenizer()
+    servers = [
+        _mk_server(model, tok, replica_id=i).start() for i in range(2)
+    ]
+    router = ReplicaRouter(
+        servers, policy="least-loaded", health_interval_s=0,
+    ).start()
+    try:
+        want = _oracle(model, tok, ["hello tpu"])[0]
+        servers[0].begin_drain(timeout_s=60.0)
+        router.check_health_now()
+        h = router.health()
+        assert [r["healthy"] for r in h["replicas"]] == [False, True]
+        assert h["ok"]
+        for _ in range(2):
+            st, body, hdrs = _post(
+                router.address,
+                {"text": "hello tpu", "max_new_tokens": 8},
+            )
+            assert st == 200 and body["tokens"] == want
+            assert hdrs.get("X-Replica-Id") == "1"
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_affinity_pins_sessions(model, fleet):
+    """Affinity policy: the same session (prompt prefix) lands on the
+    same replica; distinct sessions spread by load.  Rides a SECOND
+    router over the shared fleet's replicas (routers are independent
+    front-ends; reusing the started servers keeps this in tier-1's
+    budget)."""
+    _, servers, tok = fleet
+    router = ReplicaRouter(servers, policy="affinity").start()
+    try:
+        seen = []
+        for _ in range(3):
+            _, _, hdrs = _post(
+                router.address,
+                {"text": "session one says hi", "max_new_tokens": 4},
+            )
+            seen.append(hdrs.get("X-Replica-Id"))
+        assert len(set(seen)) == 1  # pinned
+        _, _, hdrs2 = _post(
+            router.address,
+            {"text": "a different session", "max_new_tokens": 4},
+        )
+        # New session fell back to least-loaded -> the OTHER replica.
+        assert hdrs2.get("X-Replica-Id") != seen[0]
+        assert router.health()["affinity_sessions"] == 2
+    finally:
+        router.stop()  # fleet servers stay up for the module
+
+
+def test_router_observability_surface(model, fleet):
+    """Aggregate /healthz (replicas section), /metrics (labeled
+    per-replica series), /debug passthrough with the routing decision
+    on the request timeline, and replica-side serve-mesh gauges."""
+    router, servers, tok = fleet
+    st, body, hdrs = _post(
+        router.address, {"text": "hello tpu", "max_new_tokens": 4}
+    )
+    assert st == 200
+    rep = hdrs["X-Replica-Id"]
+    h = router.health()
+    assert h["ok"] and h["policy"] == "least-loaded"
+    assert [r["index"] for r in h["replicas"]] == [0, 1]
+    assert all(
+        r["replica"]["serve_mesh"]["devices"] >= 1
+        for r in h["replicas"] if r["replica"]
+    )
+    st, text = _get(router.address, "/metrics")
+    assert st == 200
+    assert "llm_router_replicas 2" in text
+    assert 'llm_router_replica_healthy{replica="0"} 1' in text
+    assert 'llm_router_routed_requests_total{policy="least-loaded"}' \
+        in text
+    # Replica-side: mesh-shape gauges + replica_id in ITS /metrics.
+    st, rtext = _get(servers[int(rep)].address, "/metrics")
+    assert "llm_serve_mesh_tensor 1" in rtext
+    assert f"llm_replica_id {rep}" in rtext
+    # /debug passthrough resolves the timeline on whichever replica
+    # served it, and the timeline records the routing decision.
+    st, tl = _get(
+        router.address, "/debug/requests/" + body["request_id"]
+    )
+    assert st == 200
+    tl = json.loads(tl)
+    assert tl["route"] == f"replica-{rep}/least-loaded"
+    assert tl["replica"] == int(rep)
+    # Replica /healthz carries its replica section.
+    st, rh = _get(servers[0].address, "/healthz")
+    assert json.loads(rh)["replica"]["id"] == 0
+
+
+def test_handoff_counter_via_router(model, fleet):
+    """handoff_prefix wires the existing export/import path and the
+    router counts it."""
+    router, servers, tok = fleet
+    params, config = model
+    prompt = list(np.random.RandomState(3).randint(1, 128, 40))
+
+    def mk():
+        return ContinuousBatcher(
+            params, config, n_slots=2, max_len=64, block_size=16,
+        )
+
+    src, dst = mk(), mk()
+    r = src.submit(prompt, max_new_tokens=4)
+    src.run_to_completion()[r]
+    n = handoff_prefix(src, dst, prompt, router=router)
+    assert n > 0
+    # The destination now matches the chain as a plain prefix hit
+    # (full token-identity of the subsequent serve is pinned by
+    # test_serve_mesh.test_kv_handoff_token_identity).
+    keys = dst._chain_keys(prompt, dst.block_size)
+    assert len(dst._match_prefix(keys).blocks) == n
+    assert router.health()["kv_handoffs_total"] == 1
+    assert "llm_router_kv_handoffs_total 1" in router.metrics_text()
+
+
+def test_router_input_validation(model, fleet):
+    import urllib.error
+
+    router, servers, tok = fleet
+    with pytest.raises(ValueError):
+        ReplicaRouter([], policy="least-loaded")
+    with pytest.raises(ValueError):
+        ReplicaRouter(servers, policy="round-robin")
+    with pytest.raises(urllib.error.HTTPError):
+        _get(router.address, "/nope")
