@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve
+.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve bench-compare
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -95,6 +95,19 @@ lint-invariants:
 	else \
 		echo "ruff not installed; skipping ruff check (pip install ruff)"; \
 	fi
+
+# Machine-check the bench trajectory: diff headline keys between two
+# BENCH_*/MULTICHIP_* records and exit non-zero past tolerance
+# (bench.py --compare; override OLD/NEW/TOL, e.g.
+# `make bench-compare OLD=BENCH_r05.json NEW=BENCH_r07.json`).
+# Heterogeneous rounds that share no headline keys warn instead of
+# failing — the gate bites on same-shaped rounds (the next TPU round
+# vs r05's chip numbers).
+OLD ?= BENCH_r05.json
+NEW ?= BENCH_r06.json
+TOL ?= 5
+bench-compare:
+	env JAX_PLATFORMS=cpu python bench.py --compare $(OLD) $(NEW) --tolerance $(TOL)
 
 # The full lint gate (alias kept separate so CI can grow style/type
 # layers here without slowing the invariant auditor).

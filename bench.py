@@ -30,6 +30,148 @@ V5E_HBM_BYTES_PER_S = 819e9     # HBM bandwidth
 V5E_BF16_FLOPS = 197e12         # MXU bf16 peak
 
 
+# ---------------------------------------------------------------------------
+# Regression gate: diff headline keys between two trajectory records
+# ---------------------------------------------------------------------------
+
+# Key-name direction classes for the --compare gate.  Throughput-ish
+# keys regress DOWN, latency-ish keys regress UP; keys matching
+# neither are reported but never gate (a mis-guessed direction must
+# not fail CI).
+_HIGHER_BETTER = (
+    "per_s", "tok", "tflops", "gbps", "rate", "util", "goodput",
+    "ceiling", "attain", "hit", "value", "vs_baseline",
+)
+_LOWER_BETTER = ("ms", "latency", "stall", "wait_", "overhead", "_s")
+
+
+def _headline_keys(record: dict) -> dict:
+    """Numeric headline keys of a BENCH_*/MULTICHIP_* record.
+
+    Covers both record styles: proper numeric leaves of the JSON
+    (dotted paths), and the older records whose bench stdout lives as
+    a TRUNCATED string under "tail" — there, every '"key": number'
+    fragment is recovered by regex (last occurrence wins).  Driver
+    bookkeeping (rc / n / n_devices) never gates."""
+    import re as _re
+
+    skip = {"rc", "n", "n_devices", "devices"}
+    out: dict = {}
+
+    def walk(d, prefix=""):
+        if isinstance(d, dict):
+            for k, v in d.items():
+                walk(v, f"{prefix}.{k}" if prefix else str(k))
+        elif isinstance(d, str):
+            for m in _re.finditer(
+                r'"([A-Za-z0-9_]+)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)',
+                d,
+            ):
+                if m.group(1) not in skip:
+                    out[m.group(1)] = float(m.group(2))
+        elif isinstance(d, (int, float)) and not isinstance(d, bool):
+            if prefix.split(".")[-1] not in skip:
+                out[prefix] = float(d)
+
+    walk(record)
+    return out
+
+
+def compare_records(
+    old: dict, new: dict, tolerance_pct: float = 5.0,
+) -> dict:
+    """Diff shared headline keys; a REGRESSION is a classified key
+    moving in its worse direction by more than ``tolerance_pct``."""
+    a, b = _headline_keys(old), _headline_keys(new)
+    shared = sorted(set(a) & set(b))
+    regressions, improvements, unclassified = [], [], []
+    for k in shared:
+        if a[k] == 0:
+            continue
+        rel = (b[k] - a[k]) / abs(a[k]) * 100.0
+        low = k.lower()
+        higher_better = any(t in low for t in _HIGHER_BETTER)
+        lower_better = (
+            not higher_better
+            and any(t in low for t in _LOWER_BETTER)
+        )
+        entry = {
+            "key": k, "old": a[k], "new": b[k],
+            "delta_pct": round(rel, 2),
+        }
+        if higher_better and rel < -tolerance_pct:
+            regressions.append(entry)
+        elif lower_better and rel > tolerance_pct:
+            regressions.append(entry)
+        elif (higher_better or lower_better) and abs(rel) > tolerance_pct:
+            improvements.append(entry)
+        elif not (higher_better or lower_better) and abs(rel) > tolerance_pct:
+            unclassified.append(entry)
+    return {
+        "shared_keys": len(shared),
+        "tolerance_pct": tolerance_pct,
+        "regressions": regressions,
+        "improvements": improvements,
+        "unclassified_changes": unclassified,
+        "ok": not regressions,
+    }
+
+
+def compare_main() -> None:
+    """``python bench.py --compare OLD.json [NEW.json]
+    [--tolerance PCT]``: machine-check the bench trajectory — exits
+    non-zero when a shared headline key regressed past tolerance.
+    With NEW omitted, the newest record of OLD's family
+    (BENCH_*/MULTICHIP_*) in OLD's directory stands in."""
+    import glob as _glob
+    import os as _os
+    import sys as _sys
+
+    argv = argv_rest = _sys.argv[1:]
+    tol = 5.0
+    if "--tolerance" in argv:
+        i = argv.index("--tolerance")
+        tol = float(argv[i + 1])
+        # Drop the flag AND its value before the positional scan — a
+        # bare "10" must not be mistaken for NEW.json.
+        argv_rest = argv[:i] + argv[i + 2:]
+    files = [
+        a for a in argv_rest[argv_rest.index("--compare") + 1:]
+        if not a.startswith("--")
+    ][:2]
+    if not files:
+        raise SystemExit("--compare needs OLD.json [NEW.json]")
+    old_path = files[0]
+    if len(files) == 2:
+        new_path = files[1]
+    else:
+        base = _os.path.basename(old_path)
+        fam = base.split("_r")[0]
+        cands = sorted(
+            p for p in _glob.glob(_os.path.join(
+                _os.path.dirname(old_path) or ".", f"{fam}_r*.json"
+            )) if _os.path.abspath(p) != _os.path.abspath(old_path)
+        )
+        if not cands:
+            raise SystemExit(f"no other {fam}_r*.json next to {old_path}")
+        new_path = cands[-1]
+    with open(old_path) as f:
+        old = json.load(f)
+    with open(new_path) as f:
+        new = json.load(f)
+    result = compare_records(old, new, tolerance_pct=tol)
+    result["old"], result["new"] = old_path, new_path
+    print(json.dumps(result, indent=1))
+    if result["shared_keys"] == 0:
+        # Heterogeneous rounds (a CPU controller round vs a chip
+        # round) share nothing — say so loudly but do not fail: the
+        # gate is for same-shaped rounds.
+        print("bench-compare: WARNING: no shared headline keys",
+              file=_sys.stderr)
+    if not result["ok"]:
+        raise SystemExit(3)
+
+
 def load_harness(params, config, *, n_slots=8, max_len=1024,
                  block_size=128, duration_s=6.0, max_requests=400,
                  interactive_frac=0.5, seed=0,
@@ -1753,7 +1895,9 @@ def main() -> None:
 if __name__ == "__main__":
     import sys
 
-    if "--load-harness" in sys.argv[1:]:
+    if "--compare" in sys.argv[1:]:
+        compare_main()
+    elif "--load-harness" in sys.argv[1:]:
         load_harness_main()
     elif "--multichip-serving" in sys.argv[1:]:
         record = None

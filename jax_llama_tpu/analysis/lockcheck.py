@@ -86,11 +86,20 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
         module="obs", cls="Observability", lock="_lock",
         fields=frozenset({
             "_seq", "dispatches", "events", "_timelines", "_by_rid",
-            "hist", "_slo_window",
+            "hist", "hist_dispatch", "_slo_window", "_util",
+            "compiles", "compiles_total", "compiles_by_program",
             "requests_finished_total", "requests_failed_total",
             "requests_cancelled_total", "requests_slo_ok_total",
             "goodput_tokens_total",
         }),
+    ),
+    # Static cost-model cache (obs.py): serving-loop threads of
+    # DIFFERENT batchers share the one module-level instance
+    # (serving._COST_MODELS) — lookups and inserts go under its lock;
+    # the cost analysis itself deliberately runs outside it.
+    LockGuard(
+        module="obs", cls="CostModelCache", lock="_lock",
+        fields=frozenset({"_cache"}),
     ),
     LockGuard(
         module="degrade", cls="DegradeManager", lock="_lock",
@@ -98,7 +107,7 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
     ),
     LockGuard(
         module="server", cls="LLMServer", lock="_profiler_lock",
-        fields=frozenset({"_profiler_dir"}),
+        fields=frozenset({"_profiler_dir", "_profiler_last_dir"}),
     ),
     # Overload controller (overload.py): HTTP handler threads call
     # admit() while the serving loop pushes/pops/ticks — every access
@@ -119,14 +128,15 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
     ),
     # Replica router (router.py): HTTP handler threads (forward /
     # metrics / healthz) and the health-poller thread share the replica
-    # table, sticky-session map, and routing counters — every access
+    # table, sticky-session map, routing counters, the router-local
+    # trace ring, and the request-id routing record — every access
     # goes under the one lock.  The router holds no jax state.
     LockGuard(
         module="router", cls="ReplicaRouter", lock="_lock",
         fields=frozenset({
             "_replicas", "_affinity", "routed_by_policy",
             "reroutes_total", "replica_failures_total",
-            "kv_handoffs_total",
+            "kv_handoffs_total", "_trace", "_routes",
         }),
     ),
 )

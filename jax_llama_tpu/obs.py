@@ -21,9 +21,33 @@ questions (and ROADMAP item 5's online chunk controller) need:
     dispatch answers "whose tokens did I emit".
   * **Latency histograms** (:class:`Histogram`).  Prometheus cumulative-
     bucket histograms for TTFT, inter-token latency, queue wait,
-    prefill-chunk latency, swap-in latency, and dispatch wall time —
-    the distributions the flat EWMA hid.  Rendered straight into the
+    prefill-chunk latency, swap-in latency, jit compile time, and
+    dispatch wall time — the distributions the flat EWMA hid.
+    ``dispatch_ms`` is a LABELED family: one series per dispatch kind
+    (``{kind="decode"|"fused"|"spec"|"insert"|"suffix_insert"|
+    "adopt"}``), so a spec-round regression no longer hides inside a
+    lumped all-kinds distribution.  Rendered straight into the
     ``/metrics`` text exposition (``_bucket``/``_sum``/``_count``).
+  * **Device-time attribution** (:class:`CostModelCache` + the
+    ``mxu_utilization`` / ``hbm_utilization`` / ``host_overhead_ratio``
+    gauges).  Each jitted serving program's static cost (FLOPs + bytes
+    accessed, from ``jit(...).lower(...).cost_analysis()`` at the LIVE
+    geometry, cached per jit-cache key — trace-time work only, never a
+    steady-state dispatch) rides its dispatch record; per-kind sliding
+    windows turn measured dispatch wall time into live roofline
+    utilization and a wall-vs-device-estimate host-overhead ratio —
+    the ~20-26x device-vs-wall gap BENCH_r05 measured offline, now a
+    scrapeable gauge.  Peaks default to the v5e single-chip numbers
+    bench.py rooflines against (197 bf16 TFLOPs, 819 GB/s HBM);
+    run.py ``--peak-tflops`` / ``--peak-hbm-gbps`` repin them.
+  * **Jit-cache observability**.  A ``jax.monitoring`` listener turns
+    every backend compile into a ``compile_ms`` observation, a span in
+    the trace (its own ``jit compiles`` track), and a per-program
+    counter (:meth:`Observability.record_compile`; serving.py names
+    the program via :func:`attribute_compiles` around each dispatch),
+    and ``/metrics`` exposes per-program jit-cache entry counts — a
+    bucketing bug that blows the jit cache is a visible counter, not a
+    mystery stall.
   * **SLO accounting**.  With ``slo_ttft_ms`` / ``slo_itl_ms``
     configured (run.py ``--slo-ttft-ms`` / ``--slo-itl-ms``), every
     finished request is scored against both deadlines:
@@ -68,6 +92,21 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .degrade import FEATURES
 from .faults import SITES
 
+# Dispatch kinds serving.py records — each owns a labeled dispatch_ms
+# histogram series and a device-time attribution window.
+# record_dispatch VALIDATES against this set: a typo'd kind would
+# otherwise mint a phantom metrics series nobody scrapes.
+DISPATCH_KINDS = frozenset({
+    "decode", "fused", "spec", "insert", "suffix_insert", "adopt",
+})
+
+# Default hardware peaks for the utilization gauges: the public TPU
+# v5e single-chip numbers bench.py's rooflines use (BENCH_r05's
+# denominators).  run.py --peak-tflops / --peak-hbm-gbps repin them
+# for other chips; 0 disables the corresponding gauge.
+DEFAULT_PEAK_FLOPS = 197e12        # bf16 MXU peak (FLOP/s)
+DEFAULT_PEAK_BYTES_PER_S = 819e9   # HBM bandwidth (B/s)
+
 # ---------------------------------------------------------------------------
 # Histograms (Prometheus cumulative buckets)
 # ---------------------------------------------------------------------------
@@ -88,13 +127,19 @@ class Histogram:
     so a concurrent ``/metrics`` render can never see a bucket updated
     ahead of ``_count``.  ``expose(prefix)`` renders the standard
     ``_bucket{le=...}`` / ``_sum`` / ``_count`` family with its
-    ``# HELP`` / ``# TYPE`` header.  Bucket counts are stored
-    NON-cumulative and summed at exposition (observe stays O(log B))."""
+    ``# HELP`` / ``# TYPE`` header.  ``labels`` names one series of a
+    LABELED family (e.g. ``{"kind": "decode"}``): the labels render
+    into every sample line and ``expose(header=False)`` suppresses the
+    family header so sibling series share one ``# TYPE``.  Bucket
+    counts are stored NON-cumulative and summed at exposition
+    (observe stays O(log B))."""
 
     def __init__(self, name: str, help_text: str,
-                 buckets: Sequence[float] = DEFAULT_BUCKETS_MS):
+                 buckets: Sequence[float] = DEFAULT_BUCKETS_MS,
+                 labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.help = help_text
+        self.labels = dict(labels) if labels else {}
         self.buckets: Tuple[float, ...] = tuple(float(b) for b in buckets)
         if list(self.buckets) != sorted(set(self.buckets)):
             raise ValueError(f"histogram buckets must ascend: {buckets}")
@@ -118,13 +163,20 @@ class Histogram:
         out.append(("+Inf", acc + self.counts[-1]))
         return out
 
-    def expose(self, prefix: str = "") -> List[str]:
+    def expose(self, prefix: str = "", header: bool = True) -> List[str]:
         n = prefix + self.name
-        lines = [f"# HELP {n} {self.help}", f"# TYPE {n} histogram"]
+        lines = (
+            [f"# HELP {n} {self.help}", f"# TYPE {n} histogram"]
+            if header else []
+        )
+        base = "".join(
+            f'{k}="{v}",' for k, v in sorted(self.labels.items())
+        )
         for le, c in self.cumulative():
-            lines.append(f'{n}_bucket{{le="{le}"}} {c}')
-        lines.append(f"{n}_sum {round(self.sum, 3)}")
-        lines.append(f"{n}_count {self.count}")
+            lines.append(f'{n}_bucket{{{base}le="{le}"}} {c}')
+        lab = "{" + base.rstrip(",") + "}" if base else ""
+        lines.append(f"{n}_sum{lab} {round(self.sum, 3)}")
+        lines.append(f"{n}_count{lab} {self.count}")
         return lines
 
 
@@ -146,10 +198,20 @@ HISTOGRAMS = {
     "swap_in_ms": (
         "Host-tier swap-in latency per restored admission (ms: staging "
         "H2D start to pool adoption)"),
+    "compile_ms": (
+        "Backend compile time per jit-cache miss (ms; fed by the "
+        "jax.monitoring listener — a busy series here means the jit "
+        "cache is being blown, see jit_cache_entries)"),
     "dispatch_ms": (
         "Wall time per jitted serving dispatch incl. its packed fetch "
-        "(ms; one K-iteration or R-round chunk each)"),
+        "(ms; one K-iteration or R-round chunk each; LABELED by "
+        "dispatch kind)"),
 }
+
+# Families rendered as one labeled series per dispatch kind rather
+# than a single lumped series (Observability keeps one Histogram per
+# kind, created lazily on first dispatch of that kind).
+LABELED_HISTOGRAMS = frozenset({"dispatch_ms"})
 
 
 # ---------------------------------------------------------------------------
@@ -305,6 +367,30 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "slo_attainment": _reg(
         "gauge", "Fraction of recent requests meeting every configured "
                  "SLO (window 256)"),
+    # -- device-time attribution / jit-cache observability -------------------
+    "compiles_total": _reg(
+        "counter", "Backend jit compiles observed (cache misses; see "
+                   "the compile_ms histogram and "
+                   "program_compiles_total)"),
+    "mxu_utilization": _reg(
+        "gauge", "Modeled-FLOPs / wall-time fraction of the MXU peak "
+                 "over the recent dispatch window (per dispatch kind)"),
+    "hbm_utilization": _reg(
+        "gauge", "Modeled bytes-accessed / wall-time fraction of the "
+                 "HBM peak over the recent dispatch window (per "
+                 "dispatch kind)"),
+    "host_overhead_ratio": _reg(
+        "gauge", "Dispatch wall time over the static-cost device-time "
+                 "estimate (per dispatch kind; ~1 = device-bound, "
+                 ">>1 = host overhead — the BENCH_r05 device-vs-wall "
+                 "gap, live)"),
+    "program_compiles_total": _reg(
+        "counter", "Backend jit compiles attributed to each serving "
+                   "program (per program)"),
+    "jit_cache_entries": _reg(
+        "gauge", "Live jit-cache entries per registered serving "
+                 "program (a runaway series here is a bucketing bug "
+                 "re-specializing a program per request)"),
     # -- overload control (overload.py) --------------------------------------
     "overload_rung": _reg(
         "gauge", "Brownout-ladder rung (0=normal 1=elevated "
@@ -366,6 +452,120 @@ def metric_meta(name: str) -> Optional[Tuple[str, str]]:
     falls back to the legacy heuristic and SAYS SO in the HELP line,
     which the /metrics parse test treats as a failure."""
     return METRICS.get(name)
+
+
+# ---------------------------------------------------------------------------
+# Static cost models + compile attribution
+# ---------------------------------------------------------------------------
+
+class CostModelCache:
+    """Process-wide cache of static per-program cost models.
+
+    ``get(program, key, lower)`` returns ``(flops, bytes_accessed)``
+    from ``lower().cost_analysis()`` — ``lower`` is a thunk closing
+    over the EXACT live dispatch args, so the model is computed at the
+    live geometry.  The analysis runs once per ``(program, key)``
+    (``key`` mirrors the jit-cache key: geometry + the static args
+    that force a retrace) and is pure trace-time host work — it never
+    dispatches to the device, so attribution adds zero steady-state
+    device work.  A failed analysis (e.g. an exotic sharded lowering)
+    caches ``None`` so it is never retried per dispatch.
+
+    Thread-safe (``_lock``): batchers on different serving-loop
+    threads share the one module-level instance; the analysis itself
+    runs OUTSIDE the lock (two racing first-dispatches both lower —
+    idempotent — rather than one blocking on the other's trace)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache: Dict[Tuple, Optional[Tuple[float, float]]] = {}
+
+    def get(self, program: str, key: Tuple,
+            lower) -> Optional[Tuple[float, float]]:
+        k = (program,) + tuple(key)
+        with self._lock:
+            if k in self._cache:
+                return self._cache[k]
+        cost: Optional[Tuple[float, float]] = None
+        try:
+            ca = lower().cost_analysis()
+            if isinstance(ca, (list, tuple)):  # per-device variant
+                ca = ca[0] if ca else None
+            if isinstance(ca, dict):
+                cost = (
+                    float(ca.get("flops", 0.0) or 0.0),
+                    float(ca.get("bytes accessed", 0.0) or 0.0),
+                )
+        except Exception:
+            cost = None
+        with self._lock:
+            self._cache[k] = cost
+        return cost
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """{program: {keys, flops/bytes of the most recent key}} for
+        the /debug surface and tests."""
+        with self._lock:
+            items = list(self._cache.items())
+        out: Dict[str, Dict[str, Any]] = {}
+        for k, cost in items:
+            ent = out.setdefault(k[0], {"keys": 0, "modeled": 0})
+            ent["keys"] += 1
+            if cost is not None:
+                ent["modeled"] += 1
+                ent["flops"], ent["bytes_accessed"] = cost
+        return out
+
+
+# Compile attribution: serving.py names the program it is about to
+# dispatch (thread-local — each serving loop owns one batcher), and
+# the process-wide jax.monitoring listener books any backend compile
+# that fires during the call onto that program's Observability sink.
+# Compiles outside an attributed dispatch (e.g. bench warmups on the
+# main thread) are deliberately ignored: there is no sink to misfeed.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compile_attr = threading.local()
+_listener_state = {"installed": False}
+_listener_lock = threading.Lock()
+
+
+def attribute_compiles(sink: "Observability", program: str) -> None:
+    """Point this thread's compile events at ``sink`` as ``program``
+    (two attribute writes — cheap enough for every dispatch)."""
+    _compile_attr.sink = sink
+    _compile_attr.program = program
+
+
+def _compile_listener(event: str, duration_secs: float, **kw) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    sink = getattr(_compile_attr, "sink", None)
+    if sink is None:
+        return
+    try:
+        sink.record_compile(
+            getattr(_compile_attr, "program", "unknown"),
+            duration_secs * 1000.0,
+        )
+    except Exception:
+        pass  # a metrics sink must never break a compile
+
+
+def install_compile_listener() -> bool:
+    """Register the process-wide compile listener (idempotent; lazy
+    jax import keeps this module importable without jax)."""
+    with _listener_lock:
+        if _listener_state["installed"]:
+            return True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                _compile_listener
+            )
+        except Exception:
+            return False
+        _listener_state["installed"] = True
+        return True
 
 
 # ---------------------------------------------------------------------------
@@ -435,6 +635,9 @@ class Observability:
         max_timelines: int = 1024,
         max_events: int = 256,
         slo_window: int = 256,
+        peak_flops: float = DEFAULT_PEAK_FLOPS,
+        peak_bytes_per_s: float = DEFAULT_PEAK_BYTES_PER_S,
+        util_window: int = 64,
         clock=time.monotonic,
     ):
         self.slo_ttft_ms = (
@@ -443,6 +646,11 @@ class Observability:
         self.slo_itl_ms = float(slo_itl_ms) if slo_itl_ms else None
         self._clock = clock
         self.t0 = clock()
+        # Wall-clock anchor captured at the SAME instant as the
+        # monotonic t0: the fleet-merge (router /debug/trace) shifts
+        # each replica's relative timestamps into a common frame via
+        # the difference of these anchors (clock-offset normalization).
+        self.t0_unix = time.time()
         self._lock = threading.Lock()
         self._seq = 0
         self.dispatches: "deque[Dict[str, Any]]" = deque(maxlen=ring)
@@ -450,6 +658,21 @@ class Observability:
         self._max_timelines = int(max_timelines)
         self._timelines: "OrderedDict[str, _Timeline]" = OrderedDict()
         self._by_rid: Dict[int, _Timeline] = {}
+        # Device-time attribution: hardware peaks (0 disables the
+        # corresponding gauge) and a per-kind sliding window of
+        # (flops, bytes, wall_ms, device_est_ms) from dispatches that
+        # carried a cost model.
+        self.peak_flops = float(peak_flops or 0.0)
+        self.peak_bytes_per_s = float(peak_bytes_per_s or 0.0)
+        self._util_window = int(util_window)
+        self._util: Dict[str, "deque[Tuple[float, float, float, float]]"]
+        self._util = {}
+        # Jit-cache observability: compile spans (bounded ring, a
+        # trace track of their own) + per-program counters, fed by the
+        # process-wide jax.monitoring listener via record_compile.
+        self.compiles: "deque[Dict[str, Any]]" = deque(maxlen=max_events)
+        self.compiles_total = 0
+        self.compiles_by_program: Dict[str, int] = {}
         # Optional dispatch-record sink (overload.py's throughput
         # EWMAs feed off it).  Called OUTSIDE self._lock with the
         # already-built record dict — the sink takes its own lock, and
@@ -459,7 +682,11 @@ class Observability:
         self.hist: Dict[str, Histogram] = {
             name: Histogram(name, help_text)
             for name, help_text in HISTOGRAMS.items()
+            if name not in LABELED_HISTOGRAMS
         }
+        # Per-kind dispatch_ms series (one Histogram per dispatch
+        # kind, created lazily under the lock on first dispatch).
+        self.hist_dispatch: Dict[str, Histogram] = {}
         # Outcome / SLO accounting.
         self.requests_finished_total = 0
         self.requests_failed_total = 0
@@ -653,12 +880,24 @@ class Observability:
         fetch_ms: float = 0.0,
         swap_inflight: int = 0,
         rids: Sequence[int] = (),
+        program: Optional[str] = None,
+        flops: Optional[float] = None,
+        bytes_accessed: Optional[float] = None,
     ) -> int:
         """Record one jitted serving dispatch and link it into the
         CURRENT span of every request that rode it.  Returns the
         dispatch's ring-global seq number.  ``wall_ms`` covers dispatch
         submit through the packed fetch (what the host actually waited);
-        ``fetch_ms`` isolates the ``np.asarray`` device sync."""
+        ``fetch_ms`` isolates the ``np.asarray`` device sync.
+        ``program`` names the jitted program; ``flops`` /
+        ``bytes_accessed`` are its static cost model (CostModelCache) —
+        when present the record carries a roofline device-time estimate
+        and feeds the per-kind utilization window."""
+        if kind not in DISPATCH_KINDS:
+            raise ValueError(
+                f"unknown dispatch kind {kind!r}; have "
+                f"{sorted(DISPATCH_KINDS)}"
+            )
         t = self._now_ms()
         rec = {
             "seq": -1, "kind": kind, "k": int(k),
@@ -670,12 +909,46 @@ class Observability:
             "swap_inflight": int(swap_inflight),
             "rids": list(rids),
         }
+        if program is not None:
+            rec["program"] = program
+        est_ms = None
+        if flops is not None and bytes_accessed is not None:
+            est = 0.0
+            if self.peak_flops > 0:
+                est = max(est, float(flops) / self.peak_flops * 1000.0)
+            if self.peak_bytes_per_s > 0:
+                est = max(
+                    est,
+                    float(bytes_accessed) / self.peak_bytes_per_s
+                    * 1000.0,
+                )
+            if est > 0:
+                est_ms = est
+                rec["flops"] = float(flops)
+                rec["bytes_accessed"] = float(bytes_accessed)
+                rec["device_est_ms"] = round(est, 6)
         with self._lock:
             seq = self._seq
             self._seq += 1
             rec["seq"] = seq
             self.dispatches.append(rec)
-            self.hist["dispatch_ms"].observe(wall_ms)
+            h = self.hist_dispatch.get(kind)
+            if h is None:
+                h = self.hist_dispatch[kind] = Histogram(
+                    "dispatch_ms", HISTOGRAMS["dispatch_ms"],
+                    labels={"kind": kind},
+                )
+            h.observe(wall_ms)
+            if est_ms is not None:
+                dq = self._util.get(kind)
+                if dq is None:
+                    dq = self._util[kind] = deque(
+                        maxlen=self._util_window
+                    )
+                dq.append(
+                    (float(flops), float(bytes_accessed), wall_ms,
+                     est_ms)
+                )
             if prefill_tokens > 0 or kind in ("insert", "suffix_insert"):
                 self.hist["prefill_chunk_ms"].observe(wall_ms)
             for rid in rids:
@@ -695,6 +968,24 @@ class Observability:
         if self.on_dispatch is not None:
             self.on_dispatch(rec)
         return seq
+
+    def record_compile(self, program: str, dur_ms: float) -> None:
+        """One backend jit compile landed (fed by the jax.monitoring
+        listener; ``program`` is whatever serving.py last attributed
+        on the compiling thread).  Becomes a compile_ms observation, a
+        span on the trace's ``jit compiles`` track, and a per-program
+        counter."""
+        with self._lock:
+            t = self._now_ms()
+            self.hist["compile_ms"].observe(dur_ms)
+            self.compiles.append({
+                "program": program, "t_ms": round(t, 3),
+                "dur_ms": round(dur_ms, 3),
+            })
+            self.compiles_total += 1
+            self.compiles_by_program[program] = (
+                self.compiles_by_program.get(program, 0) + 1
+            )
 
     def record_swap_in(self, ms: float, blocks: int) -> None:
         """A host-tier swap-in landed (staging start -> adoption)."""
@@ -766,6 +1057,7 @@ class Observability:
                 "requests_finished_total": self.requests_finished_total,
                 "requests_failed_total": self.requests_failed_total,
                 "requests_cancelled_total": self.requests_cancelled_total,
+                "compiles_total": self.compiles_total,
                 "slo_ttft_ms": self.slo_ttft_ms or 0.0,
                 "slo_itl_ms": self.slo_itl_ms or 0.0,
                 "requests_slo_ok_total": self.requests_slo_ok_total,
@@ -780,7 +1072,61 @@ class Observability:
             lines: List[str] = []
             for h in self.hist.values():
                 lines.extend(h.expose(prefix))
+            # The labeled dispatch_ms family: one HELP/TYPE header,
+            # then every kind's series (header even when no dispatch
+            # has landed yet, so the family is always discoverable).
+            n = prefix + "dispatch_ms"
+            lines.append(f"# HELP {n} {HISTOGRAMS['dispatch_ms']}")
+            lines.append(f"# TYPE {n} histogram")
+            for kind in sorted(self.hist_dispatch):
+                lines.extend(
+                    self.hist_dispatch[kind].expose(prefix, header=False)
+                )
             return lines
+
+    def utilization_metrics(
+        self,
+    ) -> List[Tuple[str, Dict[str, str], float]]:
+        """Labeled device-time attribution samples for /metrics:
+        ``(family, labels, value)`` triples — per-kind
+        mxu_utilization / hbm_utilization / host_overhead_ratio over
+        the recent dispatch window, plus per-program compile counters.
+        Families are registered in METRICS; the server renders one
+        HELP/TYPE header per family."""
+        out: List[Tuple[str, Dict[str, str], float]] = []
+        with self._lock:
+            windows = {
+                kind: list(dq) for kind, dq in self._util.items() if dq
+            }
+            compiles = sorted(self.compiles_by_program.items())
+        for kind in sorted(windows):
+            dq = windows[kind]
+            wall_ms = sum(w for _, _, w, _ in dq)
+            if wall_ms <= 0:
+                continue
+            wall_s = wall_ms / 1000.0
+            lab = {"kind": kind}
+            if self.peak_flops > 0:
+                fl = sum(f for f, _, _, _ in dq)
+                out.append((
+                    "mxu_utilization", lab,
+                    round(fl / wall_s / self.peak_flops, 6),
+                ))
+            if self.peak_bytes_per_s > 0:
+                by = sum(b for _, b, _, _ in dq)
+                out.append((
+                    "hbm_utilization", lab,
+                    round(by / wall_s / self.peak_bytes_per_s, 6),
+                ))
+            est_ms = sum(e for _, _, _, e in dq)
+            if est_ms > 0:
+                out.append((
+                    "host_overhead_ratio", lab,
+                    round(wall_ms / est_ms, 3),
+                ))
+        for prog, n in compiles:
+            out.append(("program_compiles_total", {"program": prog}, n))
+        return out
 
     # -- debug JSON ------------------------------------------------------------
 
@@ -871,6 +1217,7 @@ class Observability:
         with self._lock:
             dispatches = list(self.dispatches)
             events = list(self.events)
+            compiles = list(self.compiles)
             now_ms = self._now_ms()
             timelines = [
                 (tl.request_id, tl.outcome, [
@@ -882,6 +1229,8 @@ class Observability:
         ev: List[Dict[str, Any]] = [
             {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
              "args": {"name": "dispatches"}},
+            {"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+             "args": {"name": "jit compiles"}},
         ]
         for d in dispatches:
             if horizon is not None and d["start_ms"] < horizon:
@@ -895,8 +1244,20 @@ class Observability:
                     k: d[k] for k in (
                         "seq", "occupancy", "prefill_tokens",
                         "fetch_ms", "swap_inflight", "rids",
-                    )
+                        "program", "device_est_ms",
+                    ) if k in d
                 },
+            })
+        for c in compiles:
+            end = c["t_ms"]
+            if horizon is not None and end < horizon:
+                continue
+            ev.append({
+                "name": f"compile {c['program']}",
+                "cat": "compile", "ph": "X", "pid": 1, "tid": 0,
+                "ts": round((end - c["dur_ms"]) * 1000.0, 1),
+                "dur": max(1, round(c["dur_ms"] * 1000.0)),
+                "args": {"program": c["program"]},
             })
         tid = 2
         for request_id, outcome, spans in timelines:
@@ -935,7 +1296,14 @@ class Observability:
                 "ts": round(e["t_ms"] * 1000.0, 1),
                 "args": dict(e["fields"]),
             })
-        return {"traceEvents": ev, "displayTimeUnit": "ms"}
+        # t0_unix_s: the wall-clock instant ts==0 corresponds to —
+        # the router's fleet merge uses it to shift every replica's
+        # relative timestamps into one frame (Perfetto ignores
+        # unknown top-level keys).
+        return {
+            "traceEvents": ev, "displayTimeUnit": "ms",
+            "t0_unix_s": round(self.t0_unix, 6),
+        }
 
 
 # ---------------------------------------------------------------------------

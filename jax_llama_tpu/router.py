@@ -52,24 +52,53 @@ so clients need no changes):
                              a ``replicas`` section (per-replica
                              health/occupancy/mesh snapshot)
     GET  /metrics            router gauges + per-replica labeled series
-    GET  /debug/*            tried against each healthy replica until
-                             one answers non-404 (request timelines
-                             live on the replica that served them)
+    GET  /debug/trace        FLEET-MERGED Perfetto trace (schema below)
+    GET  /debug/requests     index aggregated across ALL healthy
+                             replicas, each entry tagged ``replica``
+    GET  /debug/requests/<id>  resolved through the ROUTING RECORD
+                             first (the bounded request-id -> replica
+                             map the relay fills from each reply's
+                             X-Request-Id), then healthy-replica
+                             fan-out — never first-to-answer guessing
+    GET  /debug/*            (everything else) tried against each
+                             healthy replica until one answers non-404
+
+Fleet-merged tracing (``GET /debug/trace[?window_s=S]``): ONE
+Chrome/Perfetto ``trace_event`` document containing
+
+  * the router's own span track (pid 0, process_name ``router``):
+    ``route`` (decision; args replica/policy/request_id), ``forward``
+    (relay wall time; timeout/client-disconnect flagged), ``reroute``
+    (a failed replica's lossless re-route) and ``handoff``
+    (cross-replica prefix-KV moves, args request_id/blocks) spans,
+    recorded in a bounded ring under ``_lock``;
+  * every healthy replica's own ``/debug/trace`` export re-tagged to
+    pid ``1+index`` (process_name ``replica-<index>``) with its
+    timestamps shifted into the router's frame via the ``t0_unix_s``
+    wall-clock anchor each Observability ring publishes (clock-offset
+    normalization — replica monotonic clocks share no epoch);
+  * handoff linkage: the router's ``handoff`` span and both replicas'
+    ``prefix_export`` / ``prefix_import`` instants carry the same
+    external request id, so a prefill-on-A / decode-on-B session
+    reads as one timeline across three tracks.
 
 Thread discipline: handler threads (forward) and the health poller
-share the replica table and counters — every access goes under
-``_lock`` (registered in analysis/lockcheck.py).  The router holds no
-jax state at all; it is pure host-side HTTP."""
+share the replica table, counters, routing record, and trace ring —
+every access goes under ``_lock`` (registered in
+analysis/lockcheck.py).  The router holds no jax state at all; it is
+pure host-side HTTP."""
 
 from __future__ import annotations
 
 import http.client
 import json
 import threading
-from collections import OrderedDict
+import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs, unquote, urlsplit
 
 from .faults import FaultInjector, InjectedFault
 from .obs import StructuredLogger
@@ -197,6 +226,19 @@ class ReplicaRouter:
         self.reroutes_total = 0
         self.replica_failures_total = 0
         self.kv_handoffs_total = 0
+        # Router-local trace ring (fleet-merged /debug/trace): bounded
+        # span dicts, appended under _lock by handler threads.  The
+        # monotonic/wall anchors are captured at the same instant —
+        # the same clock-offset contract obs.Observability publishes.
+        self._t0 = time.monotonic()
+        self.t0_unix = time.time()
+        self._trace: "deque[Dict[str, Any]]" = deque(maxlen=1024)
+        # Routing record: external request id -> replica index
+        # (bounded LRU, filled by the relay from each reply's
+        # X-Request-Id header) — /debug/requests/<id> consults it
+        # before any fan-out.
+        self._routes: "OrderedDict[str, int]" = OrderedDict()
+        self.route_record_max = 4096
         self._closed = threading.Event()
         router = self
 
@@ -248,6 +290,35 @@ class ReplicaRouter:
     def _log(self, event: str, message: str = "", **fields) -> None:
         if self.logger is not None:
             self.logger.log(event, message, **fields)
+
+    # -- router-local tracing / routing record -------------------------------
+
+    def _now_ms(self) -> float:
+        return (time.monotonic() - self._t0) * 1000.0
+
+    def _span(self, name: str, t0_ms: float, **args) -> None:
+        """Close a router span started at ``t0_ms`` (None-valued args
+        drop, so absent request ids don't litter the trace)."""
+        dur = max(0.0, self._now_ms() - t0_ms)
+        rec = {
+            "name": name, "t0_ms": round(t0_ms, 3),
+            "dur_ms": round(dur, 3),
+            "args": {k: v for k, v in args.items() if v is not None},
+        }
+        with self._lock:
+            self._trace.append(rec)
+
+    def _note_route(self, request_id: Optional[str],
+                    index: int) -> None:
+        """Record which replica served ``request_id`` (bounded LRU) —
+        the /debug/requests/<id> resolution path."""
+        if not request_id:
+            return
+        with self._lock:
+            self._routes[request_id] = index
+            self._routes.move_to_end(request_id)
+            while len(self._routes) > self.route_record_max:
+                self._routes.popitem(last=False)
 
     # -- health --------------------------------------------------------------
 
@@ -383,7 +454,9 @@ class ReplicaRouter:
 
         tried: set = set()
         first_attempt = True
+        client_rid = handler.headers.get("X-Request-Id") or None
         while True:
+            t_pick = self._now_ms()
             with self._lock:
                 rep, how = self._pick_locked(key, frozenset(tried))
                 if rep is not None:
@@ -405,19 +478,38 @@ class ReplicaRouter:
             fwd_headers["X-Routed-By"] = (
                 f"replica-{rep.index}/{how}"
             )
+            # Route-decision span: closes immediately (the pick is a
+            # lock-held min()); the forward span that follows carries
+            # the relay wall time, so decision and transfer read as
+            # two causally ordered slices on the router track.
+            self._span(
+                "route", t_pick, replica=rep.index, policy=how,
+                path=handler.path, request_id=client_rid,
+            )
+            t_fwd = self._now_ms()
             try:
                 if self.fault_injector is not None:
                     # Fires BEFORE any byte reaches the replica, so a
                     # drill's failure is always at the reroutable stage.
                     self.fault_injector.fire("router_replica")
-                self._relay(
+                rid_seen = self._relay(
                     handler, rep, handler.path, body, fwd_headers
+                )
+                self._span(
+                    "forward", t_fwd, replica=rep.index,
+                    path=handler.path,
+                    request_id=rid_seen or client_rid,
                 )
                 return
             except _ClientDisconnect:
                 # The CLIENT vanished mid-relay — the replica is fine
                 # (it reaps the disconnect itself); nothing to reroute
                 # and no health mark.
+                self._span(
+                    "forward", t_fwd, replica=rep.index,
+                    path=handler.path, request_id=client_rid,
+                    client_disconnect=True,
+                )
                 return
             except TimeoutError as e:
                 # Proxy READ timeout from a slow-but-alive replica
@@ -430,6 +522,11 @@ class ReplicaRouter:
                 # /healthz poller.
                 self._log(
                     "router_replica_timeout", str(e), replica=rep.index,
+                )
+                self._span(
+                    "forward", t_fwd, replica=rep.index,
+                    path=handler.path, request_id=client_rid,
+                    timeout=True,
                 )
                 if not getattr(e, "_relayed", False):
                     self._reply_json(
@@ -451,6 +548,11 @@ class ReplicaRouter:
                 self._log(
                     "router_replica_failed", str(e),
                     replica=rep.index, rerouting=not relayed,
+                )
+                self._span(
+                    "reroute", t_fwd, replica=rep.index,
+                    path=handler.path, request_id=client_rid,
+                    error=str(e), relayed=relayed,
                 )
                 if relayed:
                     # Bytes already reached the client: the router
@@ -474,14 +576,17 @@ class ReplicaRouter:
     def _relay(
         self, handler: BaseHTTPRequestHandler, rep: _Replica,
         path: str, body: bytes, headers: Dict[str, str],
-    ) -> None:
+    ) -> Optional[str]:
         """Forward one request and relay the reply (buffered when the
         replica sent Content-Length, line-by-line for close-delimited
-        NDJSON streams).  Failure attribution: REPLICA-side errors
-        (connect/request/read) raise as-is, tagged ``_relayed`` once
-        any byte reached the client; CLIENT-side write errors raise
-        :class:`_ClientDisconnect` — the replica must not be marked
-        unhealthy because an impatient client hung up."""
+        NDJSON streams).  Returns the reply's ``X-Request-Id`` (the
+        end-to-end id — replica-minted when the client sent none),
+        recorded into the routing record so ``/debug/requests/<id>``
+        resolves without fan-out.  Failure attribution: REPLICA-side
+        errors (connect/request/read) raise as-is, tagged ``_relayed``
+        once any byte reached the client; CLIENT-side write errors
+        raise :class:`_ClientDisconnect` — the replica must not be
+        marked unhealthy because an impatient client hung up."""
         conn = http.client.HTTPConnection(
             rep.host, rep.port, timeout=self.proxy_timeout_s
         )
@@ -499,6 +604,8 @@ class ReplicaRouter:
         try:
             conn.request("POST", path, body=body, headers=headers)
             resp = conn.getresponse()
+            rid_seen = resp.getheader("X-Request-Id")
+            self._note_route(rid_seen, rep.index)
             out_headers = [
                 (k, v) for k, v in resp.getheaders()
                 if k.lower() not in _SKIP_HEADERS
@@ -517,7 +624,7 @@ class ReplicaRouter:
                     send_head, [("Content-Length", str(len(data)))]
                 )
                 to_client(handler.wfile.write, data)
-                return
+                return rid_seen
             # Close-delimited NDJSON stream: relay line-by-line so the
             # client sees tokens as the replica emits them.
             to_client(send_head, [("Connection", "close")])
@@ -527,7 +634,7 @@ class ReplicaRouter:
                     break
                 to_client(handler.wfile.write, line)
                 to_client(handler.wfile.flush)
-            return
+            return rid_seen
         except (OSError, http.client.HTTPException) as e:
             e._relayed = relayed
             raise
@@ -537,10 +644,12 @@ class ReplicaRouter:
     # -- GET surface ---------------------------------------------------------
 
     def _handle_get(self, handler: BaseHTTPRequestHandler) -> None:
-        if handler.path == "/healthz":
+        parts = urlsplit(handler.path)
+        route, query = parts.path, parse_qs(parts.query)
+        if route == "/healthz":
             h = self.health()
             self._reply_json(handler, 200 if h["ok"] else 503, h)
-        elif handler.path == "/metrics":
+        elif route == "/metrics":
             body = self.metrics_text().encode()
             handler.send_response(200)
             handler.send_header(
@@ -549,33 +658,124 @@ class ReplicaRouter:
             handler.send_header("Content-Length", str(len(body)))
             handler.end_headers()
             handler.wfile.write(body)
-        elif handler.path.startswith("/debug/"):
-            # Timelines live on the replica that served the request:
-            # try each healthy replica until one answers non-404.
-            with self._lock:
-                reps = [r for r in self._replicas if r.healthy]
-            last = (404, {"error": "not found on any replica"})
-            for rep in reps:
+        elif route == "/debug/trace":
+            window_ms = None
+            if "window_s" in query:
                 try:
-                    conn = http.client.HTTPConnection(
-                        rep.host, rep.port, timeout=5.0
+                    window_ms = float(query["window_s"][0]) * 1000.0
+                except ValueError:
+                    self._reply_json(
+                        handler, 400, {"error": "bad window_s"}
                     )
-                    try:
-                        conn.request("GET", handler.path)
-                        resp = conn.getresponse()
-                        data = json.loads(resp.read() or b"{}")
-                    finally:
-                        conn.close()
-                except (OSError, ValueError,
-                        http.client.HTTPException):
-                    continue
-                if resp.status != 404:
-                    data["replica"] = rep.index
-                    self._reply_json(handler, resp.status, data)
                     return
-            self._reply_json(handler, *last)
+            self._reply_json(
+                handler, 200, self.fleet_trace_json(window_ms)
+            )
+        elif route == "/debug/requests":
+            self._reply_json(
+                handler, *self._fleet_requests_index(handler.path)
+            )
+        elif route.startswith("/debug/requests/"):
+            rid = unquote(route[len("/debug/requests/"):])
+            self._reply_json(
+                handler, *self._fleet_request_lookup(rid, handler.path)
+            )
+        elif route.startswith("/debug/"):
+            # Everything else (dispatch rings, profiler summaries...)
+            # lives on whichever replica produced it: try each healthy
+            # replica until one answers non-404.
+            code, data = self._first_non_404(handler.path)
+            self._reply_json(handler, code, data)
         else:
             self._reply_json(handler, 404, {"error": "not found"})
+
+    def _get_replica_json(
+        self, rep: _Replica, path: str, timeout: float = 2.0,
+    ) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """One replica GET; None on connection/parse failure.  The
+        default timeout matches the health probe's: the fleet /debug
+        endpoints fetch replicas SEQUENTIALLY, so each hung-but-
+        marked-healthy replica costs at most one probe interval, not
+        a proxy-class stall per replica."""
+        try:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=timeout
+            )
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                data = json.loads(resp.read() or b"{}")
+            finally:
+                conn.close()
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        if not isinstance(data, dict):
+            return None
+        return resp.status, data
+
+    def _first_non_404(self, path: str) -> Tuple[int, Dict[str, Any]]:
+        with self._lock:
+            reps = [r for r in self._replicas if r.healthy]
+        for rep in reps:
+            got = self._get_replica_json(rep, path)
+            if got is None:
+                continue
+            status, data = got
+            if status != 404:
+                data["replica"] = rep.index
+                return status, data
+        return 404, {"error": "not found on any replica"}
+
+    def _fleet_requests_index(
+        self, path: str,
+    ) -> Tuple[int, Dict[str, Any]]:
+        """GET /debug/requests aggregated across ALL healthy replicas
+        (first-to-answer would show one replica's slice of the fleet
+        and 404-hide the rest); every entry carries its replica id."""
+        with self._lock:
+            reps = [r for r in self._replicas if r.healthy]
+        merged: List[Dict[str, Any]] = []
+        replicas_answered: List[int] = []
+        for rep in reps:
+            got = self._get_replica_json(rep, path)
+            if got is None or got[0] != 200:
+                continue
+            replicas_answered.append(rep.index)
+            for entry in got[1].get("requests", []):
+                if isinstance(entry, dict):
+                    entry["replica"] = rep.index
+                    merged.append(entry)
+        return 200, {
+            "requests": merged, "replicas": replicas_answered,
+        }
+
+    def _fleet_request_lookup(
+        self, request_id: str, path: str,
+    ) -> Tuple[int, Dict[str, Any]]:
+        """GET /debug/requests/<id>: the ROUTING RECORD names the
+        replica that served the id, so that replica answers first;
+        healthy-replica fan-out only covers ids the bounded record has
+        already evicted (or pre-router traffic)."""
+        with self._lock:
+            routed = self._routes.get(request_id)
+            reps = list(self._replicas)
+        ordered = (
+            [r for r in reps if r.index == routed]
+            + [r for r in reps if r.index != routed and r.healthy]
+        )
+        for rep in ordered:
+            got = self._get_replica_json(rep, path)
+            if got is None:
+                continue
+            status, data = got
+            if status != 404:
+                data["replica"] = rep.index
+                data["routed_replica"] = routed
+                return status, data
+        return 404, {
+            "error": f"request id {request_id!r} unknown fleet-wide",
+            "routed_replica": routed,
+        }
 
     @staticmethod
     def _reply_json(
@@ -592,6 +792,80 @@ class ReplicaRouter:
         handler.wfile.write(body)
 
     # -- observability -------------------------------------------------------
+
+    def fleet_trace_json(
+        self, window_ms: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """The fleet-merged Perfetto document (module docstring): the
+        router's span track plus every healthy replica's
+        ``/debug/trace`` export, replica timestamps shifted into the
+        router's frame through the ``t0_unix_s`` anchors and re-tagged
+        to per-replica pids.  Snapshot under the lock, fetch and build
+        outside it — replica HTTP round-trips must never hold the
+        routing lock."""
+        with self._lock:
+            reps = [
+                (r.index, r.host, r.port)
+                for r in self._replicas if r.healthy
+            ]
+            spans = list(self._trace)
+            now = self._now_ms()
+        horizon = None if window_ms is None else now - window_ms
+        ev: List[Dict[str, Any]] = [
+            {"ph": "M", "pid": 0, "name": "process_name",
+             "args": {"name": "router"}},
+            {"ph": "M", "pid": 0, "tid": 1, "name": "thread_name",
+             "args": {"name": "routing"}},
+        ]
+        for s in spans:
+            if horizon is not None and s["t0_ms"] + s["dur_ms"] < horizon:
+                continue
+            ev.append({
+                "name": s["name"], "cat": "router", "ph": "X",
+                "pid": 0, "tid": 1,
+                "ts": round(s["t0_ms"] * 1000.0, 1),
+                "dur": max(1, round(s["dur_ms"] * 1000.0)),
+                "args": dict(s["args"]),
+            })
+        suffix = (
+            "" if window_ms is None
+            else f"?window_s={window_ms / 1000.0:g}"
+        )
+        merged_replicas: List[int] = []
+        for index, host, port in reps:
+            got = self._get_replica_json(
+                _Replica(index=index, host=host, port=port),
+                "/debug/trace" + suffix,
+            )
+            if got is None or got[0] != 200:
+                continue
+            doc = got[1]
+            merged_replicas.append(index)
+            pid = 1 + index
+            # Clock-offset normalization: replica ts are relative to
+            # ITS Observability t0; the wall anchors captured at both
+            # t0 instants give the shift into the router's frame.
+            off_us = (
+                float(doc.get("t0_unix_s", self.t0_unix))
+                - self.t0_unix
+            ) * 1e6
+            ev.append({
+                "ph": "M", "pid": pid, "name": "process_name",
+                "args": {"name": f"replica-{index}"},
+            })
+            for e in doc.get("traceEvents", []):
+                if not isinstance(e, dict):
+                    continue
+                e = dict(e)
+                e["pid"] = pid
+                if "ts" in e:
+                    e["ts"] = round(e["ts"] + off_us, 1)
+                ev.append(e)
+        return {
+            "traceEvents": ev, "displayTimeUnit": "ms",
+            "t0_unix_s": round(self.t0_unix, 6),
+            "replicas": merged_replicas,
+        }
 
     def health(self) -> Dict[str, Any]:
         """Aggregate /healthz: ok while ANY replica is routable, with
@@ -686,15 +960,42 @@ class ReplicaRouter:
             )
         return "\n".join(lines) + "\n"
 
-    def note_handoff(self, blocks: int) -> None:
-        if blocks > 0:
-            with self._lock:
-                self.kv_handoffs_total += 1
+    def note_handoff(
+        self, blocks: int, request_id: Optional[str] = None,
+        src: Optional[int] = None, dst: Optional[int] = None,
+    ) -> None:
+        """Count a brokered prefix handoff and drop a ``handoff`` span
+        on the router track carrying the external request id — the
+        link that ties the source replica's ``prefix_export`` and the
+        destination's ``prefix_import`` instants into one timeline in
+        the merged trace.  When the destination is known the routing
+        record re-pins the id there (route-follow: the session's next
+        /debug lookup lands where its KV now lives)."""
+        if blocks <= 0:
+            return
+        t = self._now_ms()
+        with self._lock:
+            self.kv_handoffs_total += 1
+            self._trace.append({
+                "name": "handoff", "t0_ms": round(t, 3),
+                "dur_ms": 0.0,
+                "args": {
+                    k: v for k, v in (
+                        ("request_id", request_id), ("src", src),
+                        ("dst", dst), ("blocks", blocks),
+                    ) if v is not None
+                },
+            })
+        if dst is not None:
+            self._note_route(request_id, dst)
 
 
 def handoff_prefix(
     src_batcher, dst_batcher, tokens: Sequence[int],
     router: Optional[ReplicaRouter] = None,
+    request_id: Optional[str] = None,
+    src: Optional[int] = None,
+    dst: Optional[int] = None,
 ) -> int:
     """Prefill/decode disaggregation handoff: move ``tokens``' cached
     prefix blocks from ``src_batcher`` (which prefilled them) into
@@ -703,12 +1004,16 @@ def handoff_prefix(
     ``export_prefix``'s D2H slab fetch feeding ``import_prefix``'s
     stage/adopt/publish, the exact path the host-DRAM tier restores
     through.  Both batcher calls MUST run on their owning serving-loop
-    threads (the batchers are thread-confined).  Returns the number of
-    blocks landed on the destination."""
-    keys, slabs = src_batcher.export_prefix(tokens)
+    threads (the batchers are thread-confined).  ``request_id`` (the
+    session's external id) threads through both batchers' trace
+    annotations and the router's handoff span, so the fleet-merged
+    trace shows the move as ONE linked timeline; ``src``/``dst`` are
+    the replica indices when the caller knows them.  Returns the
+    number of blocks landed on the destination."""
+    keys, slabs = src_batcher.export_prefix(tokens, request_id=request_id)
     if not slabs:
         return 0
-    n = dst_batcher.import_prefix(keys, slabs)
+    n = dst_batcher.import_prefix(keys, slabs, request_id=request_id)
     if router is not None:
-        router.note_handoff(n)
+        router.note_handoff(n, request_id=request_id, src=src, dst=dst)
     return n

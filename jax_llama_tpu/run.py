@@ -259,6 +259,24 @@ def main() -> None:
                     help="idle KV blocks proactively demoted to the "
                          "host tier on entering brownout-1 and deeper "
                          "(no-op without --host-kv-blocks)")
+    ap.add_argument("--peak-tflops", type=float, default=197.0,
+                    help="accelerator MXU peak in TFLOP/s for the "
+                         "/metrics llm_mxu_utilization and "
+                         "llm_host_overhead_ratio gauges (default: "
+                         "the v5e bf16 peak bench.py rooflines "
+                         "against); 0 disables the FLOPs-side gauges")
+    ap.add_argument("--peak-hbm-gbps", type=float, default=819.0,
+                    help="accelerator HBM bandwidth in GB/s for the "
+                         "/metrics llm_hbm_utilization gauge "
+                         "(default: the v5e peak); 0 disables it")
+    ap.add_argument("--no-cost-models", action="store_true",
+                    help="skip the per-program static cost models "
+                         "(jit lowering cost_analysis at the live "
+                         "geometry): the utilization / host-overhead "
+                         "gauges go dark but first-dispatch trace "
+                         "time drops — for compile-bound drills; "
+                         "live serving keeps them ON (the analysis "
+                         "is trace-time only, never per-dispatch)")
     ap.add_argument("--log-json", action="store_true",
                     help="structured JSON logging: one JSON object per "
                          "operational log line (event / request_id / "
@@ -506,6 +524,8 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None,
     obs = Observability(
         slo_ttft_ms=getattr(args, "slo_ttft_ms", 0.0) or None,
         slo_itl_ms=getattr(args, "slo_itl_ms", 0.0) or None,
+        peak_flops=getattr(args, "peak_tflops", 197.0) * 1e12,
+        peak_bytes_per_s=getattr(args, "peak_hbm_gbps", 819.0) * 1e9,
     )
     cb = ContinuousBatcher(
         params, config, n_slots=args.slots,
@@ -523,6 +543,7 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None,
         prefix_index=getattr(args, "prefix_index", "radix"),
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         obs=obs,
+        cost_models=not getattr(args, "no_cost_models", False),
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).
@@ -736,6 +757,10 @@ def _serve_router(params, config, tokenizer, mesh, args,
             obs = Observability(
                 slo_ttft_ms=getattr(args, "slo_ttft_ms", 0.0) or None,
                 slo_itl_ms=getattr(args, "slo_itl_ms", 0.0) or None,
+                peak_flops=getattr(args, "peak_tflops", 197.0) * 1e12,
+                peak_bytes_per_s=(
+                    getattr(args, "peak_hbm_gbps", 819.0) * 1e9
+                ),
             )
             cb = ContinuousBatcher(
                 rep_params[i], config, n_slots=args.slots,
@@ -753,6 +778,7 @@ def _serve_router(params, config, tokenizer, mesh, args,
                 prefix_index=getattr(args, "prefix_index", "radix"),
                 host_kv_blocks=getattr(args, "host_kv_blocks", 0),
                 obs=obs,
+                cost_models=not getattr(args, "no_cost_models", False),
             )
             srv = LLMServer(
                 cb, tokenizer=tokenizer, host=args.host, port=0,

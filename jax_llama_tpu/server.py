@@ -149,7 +149,25 @@ heuristic is gone)::
     llm_<family>_count C                  # == the +Inf bucket
 
     families: ttft_ms, itl_ms, queue_wait_ms, prefill_chunk_ms,
-              swap_in_ms, dispatch_ms  (all milliseconds)
+              swap_in_ms, compile_ms  (all milliseconds), and
+    llm_dispatch_ms{kind="decode"|"fused"|"spec"|"insert"|
+    "suffix_insert"|"adopt"} — one labeled series PER DISPATCH KIND
+    (every sample line carries the kind label; sum the series for the
+    old lumped view).
+
+Device-time attribution (obs.py cost models; batcher
+``cost_models=True``, run.py default ON, ``--no-cost-models`` off):
+each dispatch kind's recent window exposes
+``llm_mxu_utilization{kind=...}`` / ``llm_hbm_utilization{kind=...}``
+(modeled FLOPs / bytes over wall time, against ``--peak-tflops`` /
+``--peak-hbm-gbps``) and ``llm_host_overhead_ratio{kind=...}`` (wall
+over the roofline device-time estimate — ~1 device-bound, >>1 host
+overhead).  Jit-cache observability:
+``llm_jit_cache_entries{program=...}`` (live executable-cache entries
+per registered serving program), ``llm_compiles_total`` +
+``llm_program_compiles_total{program=...}`` and the ``compile_ms``
+histogram (every backend compile, attributed to the program whose
+dispatch triggered it via the jax.monitoring listener).
 
 SLO accounting (run.py ``--slo-ttft-ms`` / ``--slo-itl-ms``; a 0/unset
 dimension always passes): ``llm_slo_ttft_attainment`` /
@@ -194,9 +212,25 @@ outcome).  ``GET /debug/dispatches?n=128`` returns the dispatch ring::
 (``{"traceEvents": [...]}``) — load in chrome://tracing or
 https://ui.perfetto.dev: dispatches on one track, request lifecycles on
 per-request tracks, fault/quarantine/kv-tier annotations as instant
-events.  ``POST /debug/profiler`` ``{"action": "start", "log_dir": D}``
-/ ``{"action": "stop"}`` brackets a ``jax.profiler`` xplane session
-around live traffic (the device-side complement).
+events, jit compiles on their own track, and the document carries a
+``t0_unix_s`` wall-clock anchor — the router's fleet-merged
+``/debug/trace`` uses it to shift this replica's timestamps into one
+frame (clock-offset normalization; see router.py for the merged
+schema).  ``POST /debug/profiler`` ``{"action": "start", "log_dir":
+D}`` / ``{"action": "stop"}`` brackets a ``jax.profiler`` xplane
+session around live traffic (the device-side complement);
+``GET /debug/profile/summary[?log_dir=D]`` then parses the completed
+capture into per-program attribution::
+
+    {"xplane": path, "log_dir": D,
+     "programs": {"<program>": {"device_ms": F, "host_ms": F}, ...},
+     "total_device_ms": F, "total_host_ms": F}
+
+(404 with no completed session, 409 while one is active, 501 without
+the xplane protos).  Dispatch records (/debug/dispatches) gain
+``program`` and — with cost models on — ``flops`` /
+``bytes_accessed`` / ``device_est_ms`` (the roofline estimate the
+host_overhead_ratio gauge divides by).
 
 Every reply carries the end-to-end request id: blocking bodies and
 error bodies (400/413/500/503/504) as ``"request_id"``, plus an
@@ -273,6 +307,8 @@ Endpoints:
   GET  /debug/dispatches        recent dispatch-span ring.
   GET  /debug/trace             Chrome/Perfetto trace_event JSON.
   POST /debug/profiler          jax.profiler session start/stop.
+  GET  /debug/profile/summary   per-program xplane attribution
+                                (schema above).
 """
 
 from __future__ import annotations
@@ -295,6 +331,7 @@ from .degrade import DegradeManager
 from .obs import Observability, StructuredLogger, metric_meta
 from .overload import PRIORITIES, RUNG_INDEX, OverloadController
 from .parallel import serve_mesh as smesh
+from . import serving as serving_mod
 from .serving import ContinuousBatcher, _round_up
 
 # Injection-site -> degradable-feature attribution for dispatch
@@ -520,7 +557,11 @@ class LLMServer:
         # On-demand jax.profiler session (POST /debug/profiler): the
         # log_dir of the active trace, None when idle; the lock
         # serializes handler threads racing start/stop.
+        # _profiler_last_dir remembers the most recently COMPLETED
+        # session so GET /debug/profile/summary can attribute it
+        # without the client re-supplying the path.
         self._profiler_dir: Optional[str] = None
+        self._profiler_last_dir: Optional[str] = None
         self._profiler_lock = threading.Lock()
         self._base_ctor = (
             batcher.params, batcher.config, dict(batcher._ctor_kwargs)
@@ -640,6 +681,10 @@ class LLMServer:
                             return
                     self._reply_json(
                         200, server.obs.trace_json(window_ms)
+                    )
+                elif route == "/debug/profile/summary":
+                    self._reply_json(
+                        *server._profile_summary(query)
                     )
                 else:
                     self._reply_json(404, {"error": "not found"})
@@ -1656,10 +1701,47 @@ class LLMServer:
                     # restart.  Keeping it lets the client retry stop.
                     return 500, {"error": f"profiler stop failed: {e}"}
                 self._profiler_dir = None
+                self._profiler_last_dir = log_dir
             self.obs.annotate("profiler_stop", log_dir=log_dir)
             self._log("profiler_stop", log_dir=log_dir)
             return 200, {"ok": True, "log_dir": log_dir}
         return 400, {"error": 'action must be "start" or "stop"'}
+
+    def _profile_summary(self, query: Dict[str, List[str]]):
+        """GET /debug/profile/summary[?log_dir=DIR] — parse the most
+        recently completed profiler session's xplane capture into
+        per-program device/host-ms attribution
+        (``utils.profiling.summarize_xplane``).  Pure file parsing on
+        the handler thread: zero device work, and the serving loop is
+        never touched.  Returns ``(status_code, body)``."""
+        log_dir = (query.get("log_dir") or [None])[0]
+        with self._profiler_lock:
+            active = self._profiler_dir
+            if log_dir is None:
+                log_dir = self._profiler_last_dir
+        if log_dir is None:
+            return 404, {"error": (
+                "no completed profiler session; bracket traffic with "
+                'POST /debug/profiler {"action": "start"/"stop"} '
+                "first, or pass ?log_dir="
+            )}
+        if active is not None and log_dir == active:
+            return 409, {"error": (
+                f"profiler session into {log_dir!r} still active; "
+                "stop it before summarizing"
+            )}
+        try:
+            from .utils.profiling import summarize_xplane
+
+            summary = summarize_xplane(log_dir)
+        except ImportError as e:
+            return 501, {"error": f"xplane protos unavailable: {e}"}
+        except FileNotFoundError as e:
+            return 404, {"error": str(e)}
+        except Exception as e:  # surface a parse failure, never crash
+            return 500, {"error": f"xplane parse failed: {e}"}
+        summary["log_dir"] = log_dir
+        return 200, summary
 
     def _loop(self) -> None:
         # The finally-drain guarantees no client blocks forever: whether
@@ -1932,4 +2014,27 @@ class LLMServer:
         # Histogram families (ttft/itl/queue-wait/prefill/swap/dispatch)
         # render their own HELP/TYPE + _bucket/_sum/_count series.
         lines.extend(self.obs.expose_histograms("llm_"))
+        # Labeled families: per-kind device-time attribution gauges and
+        # per-program compile counters (obs.utilization_metrics), plus
+        # the live jit-cache entry count per registered serving program
+        # (scrape-time reads of jax's own per-function caches — no
+        # shared mutable state).  One HELP/TYPE header per family, even
+        # while a family has no samples yet, so dashboards can discover
+        # them before traffic.
+        labeled = list(self.obs.utilization_metrics())
+        for prog, n in sorted(serving_mod.jit_cache_entries().items()):
+            labeled.append(("jit_cache_entries", {"program": prog}, n))
+        for family in ("mxu_utilization", "hbm_utilization",
+                       "host_overhead_ratio", "program_compiles_total",
+                       "jit_cache_entries"):
+            kind, help_text = metric_meta(family)
+            lines.append(f"# HELP llm_{family} {help_text}")
+            lines.append(f"# TYPE llm_{family} {kind}")
+            for fam, labels, v in labeled:
+                if fam != family:
+                    continue
+                lab = ",".join(
+                    f'{k}="{val}"' for k, val in sorted(labels.items())
+                )
+                lines.append(f"llm_{family}{{{lab}}} {v}")
         return "\n".join(lines) + "\n"

@@ -176,6 +176,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import hashlib
+import itertools
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -193,12 +194,14 @@ from .faults import FaultInjector, InjectedFault
 from .kvcache import (
     MatchResult,
     adopt_into_pool,
+    adopt_lower,
     fetch_slab,
     make_prefix_store,
     restore_ready,
     stage_restore,
 )
-from .obs import Observability
+from . import obs as _obs_mod
+from .obs import CostModelCache, Observability
 from .models.llama import (
     FLASH_MIN_SEQ,
     KVCache,
@@ -1565,6 +1568,54 @@ def _spec_rounds_chunk(
 
 
 # ---------------------------------------------------------------------------
+# Jit-cache observability: the registered serving programs
+# ---------------------------------------------------------------------------
+
+# Every jitted program the serving stack dispatches (the same ten the
+# analysis lowering contracts audit), by name — the source for the
+# per-program ``jit_cache_entries`` gauge (/metrics) and the cost-model
+# hooks below.  ``_cache_size()`` is jax's own per-function executable
+# cache; a runaway entry count here is a bucketing bug re-specializing
+# a program per request (the stall that used to be invisible).
+def _programs() -> Dict[str, Any]:
+    from .kvcache import _adopt_jit
+    return {
+        "_paged_decode_step": _paged_decode_step,
+        "_paged_decode_chunk": _paged_decode_chunk,
+        "_fused_chunk": _fused_chunk,
+        "_spec_round": _spec_round,
+        "_spec_rounds_chunk": _spec_rounds_chunk,
+        "_paged_insert": _paged_insert,
+        "_paged_suffix_insert": _paged_suffix_insert,
+        "_scatter_rows": _scatter_rows,
+        "_release_blocks": _release_blocks,
+        "_adopt_jit": _adopt_jit,
+    }
+
+
+def jit_cache_entries() -> Dict[str, int]:
+    """Live jit-cache entry count per registered program (-1 when the
+    jax version hides the cache) — scrape-time host work only."""
+    out: Dict[str, int] = {}
+    for name, fn in _programs().items():
+        try:
+            out[name] = int(fn._cache_size())
+        except Exception:
+            out[name] = -1
+    return out
+
+
+# Process-wide static cost models (obs.CostModelCache): one entry per
+# (program, geometry, static args) — written at trace time by the
+# dispatch hooks below, read per dispatch as a dict hit.
+_COST_MODELS = CostModelCache()
+
+# Batcher-incarnation counter for the cost-model geometry key (see
+# ContinuousBatcher.__init__).
+_COST_GEOM_SEQ = itertools.count()
+
+
+# ---------------------------------------------------------------------------
 # Host-side batcher
 # ---------------------------------------------------------------------------
 
@@ -1759,6 +1810,7 @@ class ContinuousBatcher:
         prefix_index: str = "radix",
         host_kv_blocks: int = 0,
         obs: Optional[Observability] = None,
+        cost_models: bool = False,
     ):
         # Raw construction arguments, captured before any derivation so
         # ``rebuild()`` (crash recovery) reproduces this batcher exactly
@@ -1776,7 +1828,19 @@ class ContinuousBatcher:
             decode_chunk=decode_chunk, spec_rounds=spec_rounds,
             prefill_budget=prefill_budget, prefix_index=prefix_index,
             host_kv_blocks=host_kv_blocks, obs=obs,
+            cost_models=cost_models,
         )
+        # Device-time attribution (obs.py): static per-program cost
+        # models from jit lowering's cost_analysis at the live
+        # geometry.  OFF by default — computing a model costs one
+        # extra trace per (program, jit-cache key), which live serving
+        # amortizes over hours but a compile-bound test matrix cannot
+        # (tier-1 sits at its time ceiling); run.py turns it on for
+        # real serving.  Compile ATTRIBUTION (the jax.monitoring
+        # listener) is always on: it is two thread-local writes per
+        # dispatch.
+        self.cost_models = bool(cost_models)
+        _obs_mod.install_compile_listener()
         # Observability sink (obs.py): request span timelines, dispatch
         # spans, latency histograms, SLO accounting.  Always on — pure
         # host-side bookkeeping at boundaries the loop already crosses,
@@ -1845,6 +1909,17 @@ class ContinuousBatcher:
         self.top_k = 0 if top_k is None else int(top_k)
         self.prefill_chunk = prefill_chunk
         self.seed = seed
+        # Cost-model cache key prefix: the geometry half of the
+        # jit-cache key (per-dispatch statics like K append to it).
+        # A process-unique incarnation token keys per-batcher without
+        # requiring config to hash — id(config) would be unsound (a
+        # GC'd config's address can be reused by a new model with the
+        # same geometry, silently serving stale FLOPs/bytes).  Each
+        # rebuild re-lowers once per program — trace-time only.
+        self._cost_geom = (
+            next(_COST_GEOM_SEQ), self.n_slots, self.n_blocks,
+            self.block_size, bool(logprobs), mesh is not None,
+        )
 
         self.pool = init_pool(self.config, self.n_blocks, self.block_size)
         self.draft_pool = (
@@ -2114,6 +2189,26 @@ class ContinuousBatcher:
         of either the hook or the dispatch itself is attributable)."""
         self.last_dispatch_features = tuple(features)
         self.last_step_features.update(features)
+
+    def _dispatch_cost(
+        self, program: str, key: Tuple, lower,
+    ) -> Tuple[Optional[float], Optional[float]]:
+        """Per-dispatch attribution hook, called right before a jitted
+        program runs: (1) names ``program`` as this thread's compile
+        attribution (so a jit-cache miss during the call books its
+        backend-compile duration onto our obs sink), and (2) when cost
+        models are enabled, returns the program's static
+        (flops, bytes_accessed) at the live geometry — computed ONCE
+        per (program, geometry, key) via ``lower().cost_analysis()``
+        (``lower`` closes over the exact dispatch args), a dict hit on
+        every later dispatch.  Never a device dispatch or host sync
+        either way."""
+        _obs_mod.attribute_compiles(self.obs, program)
+        if not self.cost_models:
+            return None, None
+        cost = _COST_MODELS.get(program, self._cost_geom + tuple(key),
+                                lower)
+        return (None, None) if cost is None else cost
 
     def _take_nan(self) -> bool:
         """Consume an armed ``nan`` fault (the non-finite guard's test
@@ -2540,6 +2635,22 @@ class ContinuousBatcher:
             out[:R] = a[rows]
             return jnp.asarray(out)
 
+        state = (
+            self.d_table, self.d_n_alloc, self.d_fill, self.d_pos,
+            self.d_active, self.d_temps, self.d_top_ps, self.d_top_ks,
+            self.d_remaining, self.d_stops,
+        )
+        self._dispatch_cost(
+            "_scatter_rows", (Rb, self.d_stops.shape),
+            lambda: _scatter_rows.lower(
+                state,
+                jax.ShapeDtypeStruct(idx.shape, idx.dtype),
+                tuple(
+                    jax.ShapeDtypeStruct((Rb,) + a.shape[1:], a.dtype)
+                    for a in state
+                ),
+            ),
+        )
         (self.d_table, self.d_n_alloc, self.d_fill, self.d_pos,
          self.d_active, self.d_temps, self.d_top_ps, self.d_top_ks,
          self.d_remaining, self.d_stops) = _scatter_rows(
@@ -2628,13 +2739,57 @@ class ContinuousBatcher:
         # BEFORE the dispatch — recorded after the packed fetch, so the
         # span covers submit through sync (pure host bookkeeping; the
         # 1-fetch/0-upload contract is unchanged).
-        t0_obs = time.monotonic()
         obs_rids = [
             s.request_id for s in self.slots.values() if s is not None
         ]
         pf_adv = 0 if pf is None else min(pf.chunk, pf.remaining_tokens)
         pf_done_rid: Optional[int] = None
         all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
+        if pf is not None:
+            # The prefilling request samples inside the program, so the
+            # greedy specialization must account for its policy too.
+            all_greedy = all_greedy and pf.req.temperature <= 0.0
+        # Compile attribution + static cost model (obs.py): named
+        # BEFORE the dispatch so a jit-cache miss books onto the right
+        # program; the lower thunk closes over the exact live args
+        # (trace-time only — a dict hit once cached).
+        if pf is None:
+            prog = "_paged_decode_chunk"
+            cost_fl, cost_by = self._dispatch_cost(
+                prog, (K, all_greedy),
+                lambda: _paged_decode_chunk.lower(
+                    self.params, self.pool, self.d_table,
+                    self.d_n_alloc, self.d_fill, self.tau,
+                    self.d_tau_lp, self.d_pos, self.d_active,
+                    self.d_remaining, self.d_stops, self.keys,
+                    self.d_temps, self.d_top_ps, self.d_top_ks,
+                    config=self.config, n_iter=K,
+                    all_greedy=all_greedy, mesh=self.mesh,
+                    allow_kernel=self.use_pallas_kernel,
+                    with_logprobs=self.logprobs,
+                    placed=self._mesh_placed,
+                ),
+            )
+        else:
+            prog = "_fused_chunk"
+            cost_fl, cost_by = self._dispatch_cost(
+                prog, (K, pf.chunk, all_greedy),
+                lambda: _fused_chunk.lower(
+                    self.params, self.pool, self.d_table,
+                    self.d_n_alloc, self.d_fill, self.tau,
+                    self.d_tau_lp, self.d_pos, self.d_active,
+                    self.d_remaining, self.d_stops, self.keys,
+                    self.d_temps, self.d_top_ps, self.d_top_ks,
+                    pf.d_row, pf.d_toks, pf.d_len, pf.d_base, pf.d_off,
+                    pf.d_key,
+                    config=self.config, n_iter=K, pf_chunk=pf.chunk,
+                    all_greedy=all_greedy, mesh=self.mesh,
+                    allow_kernel=self.use_pallas_kernel,
+                    with_logprobs=self.logprobs,
+                    placed=self._mesh_placed,
+                ),
+            )
+        t0_obs = time.monotonic()
         if pf is None:
             (packed, self.tau, self.d_tau_lp, self.d_fill, self.d_pos,
              self.d_active, self.d_remaining, self.keys,
@@ -2648,9 +2803,6 @@ class ContinuousBatcher:
                 with_logprobs=self.logprobs, placed=self._mesh_placed,
             )
         else:
-            # The prefilling request samples inside the program, so the
-            # greedy specialization must account for its policy too.
-            all_greedy = all_greedy and pf.req.temperature <= 0.0
             (packed, self.tau, self.d_tau_lp, self.d_fill, self.d_pos,
              self.d_active, self.d_remaining, self.keys, self.pool,
              pf.d_off) = _fused_chunk(
@@ -2700,6 +2852,7 @@ class ContinuousBatcher:
             wall_ms=(now_obs - t0_obs) * 1000.0,
             fetch_ms=(now_obs - tf_obs) * 1000.0,
             swap_inflight=len(self._restoring), rids=obs_rids,
+            program=prog, flops=cost_fl, bytes_accessed=cost_by,
         )
         if pf_done_rid is not None:
             # The prefill's last chunk linked into the prefilling span
@@ -2884,11 +3037,26 @@ class ContinuousBatcher:
         self.spec_dispatches_total += 1
         self.decode_chunk_last = R
         self.spec_rounds_last = R
-        t0_obs = time.monotonic()
         obs_rids = [
             s.request_id for s in self.slots.values() if s is not None
         ]
         all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
+        cost_fl, cost_by = self._dispatch_cost(
+            "_spec_rounds_chunk", (R, all_greedy),
+            lambda: _spec_rounds_chunk.lower(
+                self.params, self.draft_params, self.pool,
+                self.draft_pool, self.d_table, self.d_n_alloc,
+                self.d_fill, self.tau, self.d_tau_lp, self.d_pos,
+                self.d_active, self.d_remaining, self.d_stops,
+                self.keys, self.d_temps, self.d_top_ps, self.d_top_ks,
+                t_config=self.config, d_config=self.draft_config,
+                n_draft=self.n_draft, n_rounds=R,
+                all_greedy=all_greedy,
+                use_kernel=self._spec_kernel_ok(), mesh=self.mesh,
+                with_logprobs=self.logprobs, placed=self._mesh_placed,
+            ),
+        )
+        t0_obs = time.monotonic()
         (packed, self.tau, self.d_tau_lp, self.d_fill, self.d_pos,
          self.d_active, self.d_remaining, self.keys, self.pool,
          self.draft_pool) = _spec_rounds_chunk(
@@ -2916,6 +3084,8 @@ class ContinuousBatcher:
             wall_ms=(now_obs - t0_obs) * 1000.0,
             fetch_ms=(now_obs - tf_obs) * 1000.0,
             swap_inflight=len(self._restoring), rids=obs_rids,
+            program="_spec_rounds_chunk", flops=cost_fl,
+            bytes_accessed=cost_by,
         )
         G = self.n_draft
         toks = arr[:, :, : G + 1]
@@ -3049,11 +3219,32 @@ class ContinuousBatcher:
         """Speculative remainder of a step: draft + verify, emit the
         accepted prefix (appended to ``out``, with per-token logprobs
         when ``logprobs=True``), rewind fills past rejected slots."""
-        t0_obs = time.monotonic()
         obs_rids = [
             s.request_id for s in self.slots.values() if s is not None
         ]
         all_greedy = bool(np.all(self.temp_arr[self.active] == 0.0))
+
+        def _sds(a):
+            # Aval-only stand-ins for the mirrors the classic path
+            # uploads per round: lowering needs shapes/dtypes, never
+            # the bytes — the cost hook must not add uploads.
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        cost_fl, cost_by = self._dispatch_cost(
+            "_spec_round", (all_greedy,),
+            lambda: _spec_round.lower(
+                self.params, self.draft_params, self.pool,
+                self.draft_pool, _sds(self.table), _sds(self.n_alloc),
+                _sds(self.fill), self.tau, _sds(self.pos),
+                _sds(self.active), self.keys, _sds(self.temp_arr),
+                _sds(self.top_p_arr), _sds(self.top_k_arr),
+                t_config=self.config, d_config=self.draft_config,
+                n_draft=self.n_draft, all_greedy=all_greedy,
+                use_kernel=self._spec_kernel_ok(), mesh=self.mesh,
+                with_logprobs=self.logprobs, placed=self._mesh_placed,
+            ),
+        )
+        t0_obs = time.monotonic()
         outs, acc, lps, self.keys, self.pool, self.draft_pool = _spec_round(
             self.params, self.draft_params, self.pool, self.draft_pool,
             jnp.array(self.table), jnp.array(self.n_alloc),
@@ -3086,6 +3277,8 @@ class ContinuousBatcher:
             wall_ms=(now_obs - t0_obs) * 1000.0,
             fetch_ms=(now_obs - tf_obs) * 1000.0,
             swap_inflight=len(self._restoring), rids=obs_rids,
+            program="_spec_round", flops=cost_fl,
+            bytes_accessed=cost_by,
         )
         round_proposed = round_accepted = 0
         # NOTE: the per-row fill/pos advances below touch the numpy
@@ -3213,6 +3406,13 @@ class ContinuousBatcher:
             )
             chunk = evicted[start:start + self.blocks_per_slot]
             ids[: len(chunk)] = chunk
+            self._dispatch_cost(
+                "_release_blocks", (ids.shape[0],),
+                lambda: _release_blocks.lower(
+                    self.pool.pos,
+                    jax.ShapeDtypeStruct(ids.shape, ids.dtype),
+                ),
+            )
             self.pool = dataclasses.replace(
                 self.pool,
                 # audit: host-upload(eviction-batch id upload on the
@@ -3266,7 +3466,8 @@ class ContinuousBatcher:
     # -- prefill/decode disaggregation handoff ------------------------------
 
     def export_prefix(
-        self, tokens: Sequence[int]
+        self, tokens: Sequence[int],
+        request_id: Optional[str] = None,
     ) -> Tuple[List[bytes], List[Dict[str, Any]]]:
         """Disaggregation handoff, PREFILL side: the longest
         HBM-resident cached chain prefix of ``tokens`` fetched as host
@@ -3294,10 +3495,18 @@ class ContinuousBatcher:
                 slab.update(fetch_slab(self.draft_pool, blk, prefix="d_"))
             slabs.append(slab)
         self.kv_export_blocks_total += len(slabs)
+        # Fleet-trace link: the instant event carries the EXTERNAL
+        # request id (when the handoff orchestrator knows it), so the
+        # router's merged /debug/trace ties this replica's export to
+        # the peer's import of the same session.
+        self.obs.annotate(
+            "prefix_export", blocks=len(slabs), request_id=request_id,
+        )
         return keys[: len(match.blocks)], slabs
 
     def import_prefix(
-        self, keys: Sequence[bytes], slabs: Sequence[Dict[str, Any]]
+        self, keys: Sequence[bytes], slabs: Sequence[Dict[str, Any]],
+        request_id: Optional[str] = None,
     ) -> int:
         """Disaggregation handoff, DECODE side: land exported slabs in
         this batcher's pool (alloc + ``kvcache.stage_restore`` +
@@ -3355,6 +3564,11 @@ class ContinuousBatcher:
                 [b for b in fresh if b not in adopted]
             )
             self.kv_import_blocks_total += len(adopted)
+            # Fleet-trace link (see export_prefix).
+            self.obs.annotate(
+                "prefix_import", blocks=len(adopted),
+                request_id=request_id,
+            )
             return len(adopted)
         finally:
             # Matched blocks return to the idle LRU (nobody is using
@@ -3607,6 +3821,23 @@ class ContinuousBatcher:
         # never executed.
         for req, _, _ in grp:
             self.obs.begin_span(req.rid, "prefilling")
+
+        def _sds(a):
+            # Aval stand-ins (shape/dtype only) for the host arrays the
+            # dispatch below uploads — the cost hook must not add one.
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        cost_fl, cost_by = self._dispatch_cost(
+            "_paged_suffix_insert", (kb, T),
+            lambda: _paged_suffix_insert.lower(
+                self.params, self.pool, _sds(table_rows),
+                _sds(n_alloc_arr), _sds(fill0s), _sds(st), _sds(sm),
+                _sds(keysA), _sds(temps), _sds(top_ps), _sds(top_ks),
+                config=self.config, prefill_chunk=self.prefill_chunk,
+                mesh=self.mesh, with_logprobs=self.logprobs,
+                placed=self._mesh_placed,
+            ),
+        )
         t0_obs = time.monotonic()
         self._record_dispatch(["prefix_cache"])
         self._fault("suffix_insert")
@@ -3649,6 +3880,8 @@ class ContinuousBatcher:
             wall_ms=(time.monotonic() - t0_obs) * 1000.0,
             swap_inflight=len(self._restoring),
             rids=[r.rid for r, _, _ in grp],
+            program="_paged_suffix_insert", flops=cost_fl,
+            bytes_accessed=cost_by,
         )
         idx = jnp.asarray(np.asarray(slots, np.int32))
         self.tau = self.tau.at[idx].set(tau[:k])
@@ -3846,6 +4079,10 @@ class ContinuousBatcher:
                 ready = True
             if not ready or r.polls <= self.swap_poll_min:
                 continue
+            cost_fl, cost_by = self._dispatch_cost(
+                "_adopt_jit", (len(r.staged["ids"]),),
+                lambda: adopt_lower(self.pool, r.staged),
+            )
             t_adopt = time.monotonic()
             self.pool = adopt_into_pool(self.pool, r.staged)
             if self.spec:
@@ -3876,6 +4113,8 @@ class ContinuousBatcher:
                 wall_ms=adopt_ms,
                 swap_inflight=len(self._restoring),
                 rids=(r.req.rid,),
+                program="_adopt_jit", flops=cost_fl,
+                bytes_accessed=cost_by,
             )
             self.obs.begin_span(r.req.rid, "queued", note="restored")
 
@@ -4183,6 +4422,24 @@ class ContinuousBatcher:
             )
             for req in batch:
                 self.obs.begin_span(req.rid, "prefilling")
+
+            def _sds(a):
+                # Aval stand-ins for the admission upload arrays — the
+                # cost hook lowers without adding a host->device copy.
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+            cost_fl, cost_by = self._dispatch_cost(
+                "_paged_insert", (kb, P),
+                lambda: _paged_insert.lower(
+                    self.params, self.pool, _sds(bid), _sds(pt),
+                    _sds(pm), _sds(keys), _sds(temps), _sds(top_ps),
+                    _sds(top_ks),
+                    config=self.config,
+                    prefill_chunk=self.prefill_chunk,
+                    mesh=self.mesh, with_logprobs=self.logprobs,
+                    placed=self._mesh_placed,
+                ),
+            )
             t0_obs = time.monotonic()
             self._record_dispatch(
                 ["flash_attention"] if flash else []
@@ -4258,6 +4515,8 @@ class ContinuousBatcher:
                 fetch_ms=(now_obs - tf_obs) * 1000.0,
                 swap_inflight=len(self._restoring),
                 rids=[r.rid for r in batch],
+                program="_paged_insert", flops=cost_fl,
+                bytes_accessed=cost_by,
             )
             for i, req in enumerate(batch):
                 b = slot_ids[i]

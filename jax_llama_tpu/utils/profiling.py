@@ -14,6 +14,7 @@ import collections
 import contextlib
 import dataclasses
 import glob
+import os
 import re
 import shutil
 import tempfile
@@ -121,6 +122,86 @@ def device_op_times(
             continue  # outer loops double-count their bodies
         agg[key[e.metadata_id]] += e.duration_ps
     return agg
+
+
+# Event-name spellings that carry a jitted-program identity in an
+# xplane capture: the host plane's python line traces dispatch frames
+# as ``PjitFunction(<name>)``, and device planes' "XLA Modules" line
+# names executables ``jit_<name>`` (sometimes with a ``.N`` or
+# ``(...)`` specialization suffix).
+_PJIT_RE = re.compile(r"^PjitFunction\((.+)\)$")
+_JIT_MODULE_RE = re.compile(r"^jit_(.+?)(?:\.\d+)?$")
+
+
+def normalize_program_name(event_name: str):
+    """The serving-program name behind an xplane event name, or None
+    for events that are not jitted-program roots (individual HLO ops,
+    host syscalls, ...)."""
+    m = _PJIT_RE.match(event_name)
+    if m:
+        return m.group(1)
+    m = _JIT_MODULE_RE.match(event_name)
+    if m:
+        return m.group(1)
+    return None
+
+
+def summarize_xplane(log_dir: str) -> Dict[str, object]:
+    """Aggregate the newest xplane capture under ``log_dir`` into
+    per-jitted-program time attribution.
+
+    Device planes (name contains TPU/GPU) attribute their "XLA
+    Modules" line — executable-granular device time, the number the
+    MXU-gap investigation needs; the host plane's ``PjitFunction``
+    frames attribute host-side dispatch time (on a CPU-only capture
+    that is the only signal, and it still answers "which program").
+    Raises ImportError when the TensorFlow xplane protos are absent
+    and FileNotFoundError when ``log_dir`` holds no capture — the
+    /debug/profile/summary endpoint maps both to clean HTTP errors.
+    """
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(
+        glob.glob(f"{log_dir}/**/*.xplane.pb", recursive=True),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        raise FileNotFoundError(
+            f"no .xplane.pb capture under {log_dir!r}"
+        )
+    path = paths[-1]
+    space = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+    device_ms: Dict[str, float] = collections.defaultdict(float)
+    host_ms: Dict[str, float] = collections.defaultdict(float)
+    for plane in space.planes:
+        is_device = any(t in plane.name for t in ("TPU", "GPU"))
+        names = {k: v.name for k, v in plane.event_metadata.items()}
+        for line in plane.lines:
+            if is_device and line.name != "XLA Modules":
+                continue  # per-op lines double-count their module
+            for e in line.events:
+                prog = normalize_program_name(
+                    names.get(e.metadata_id, "")
+                )
+                if prog is None:
+                    continue
+                sink = device_ms if is_device else host_ms
+                sink[prog] += e.duration_ps / 1e9
+    programs = sorted(set(device_ms) | set(host_ms))
+    return {
+        "xplane": path,
+        "programs": {
+            p: {
+                "device_ms": round(device_ms.get(p, 0.0), 3),
+                "host_ms": round(host_ms.get(p, 0.0), 3),
+            }
+            for p in programs
+        },
+        "total_device_ms": round(sum(device_ms.values()), 3),
+        "total_host_ms": round(sum(host_ms.values()), 3),
+    }
 
 
 @dataclasses.dataclass

@@ -21,7 +21,9 @@ import pytest
 from jax_llama_tpu import get_config, init_params
 from jax_llama_tpu.obs import (
     HISTOGRAMS,
+    LABELED_HISTOGRAMS,
     METRICS,
+    CostModelCache,
     Histogram,
     Observability,
     StructuredLogger,
@@ -111,8 +113,15 @@ def test_metric_registry_shape():
     assert METRICS["radix_nodes_total"][0] == "gauge"
     assert set(HISTOGRAMS) == {
         "ttft_ms", "itl_ms", "queue_wait_ms", "prefill_chunk_ms",
-        "swap_in_ms", "dispatch_ms",
+        "swap_in_ms", "compile_ms", "dispatch_ms",
     }
+    # dispatch_ms renders as one labeled series per dispatch kind.
+    assert LABELED_HISTOGRAMS == {"dispatch_ms"}
+    # The labeled attribution families are registered too.
+    for fam in ("mxu_utilization", "hbm_utilization",
+                "host_overhead_ratio", "jit_cache_entries",
+                "program_compiles_total", "compiles_total"):
+        assert metric_meta(fam) is not None, fam
 
 
 # ---------------------------------------------------------------------------
@@ -154,8 +163,10 @@ def test_span_lifecycle_and_dispatch_links():
     # The queued->prefilling edge fed the queue-wait histogram.
     assert obs.hist["queue_wait_ms"].count == 1
     assert obs.hist["queue_wait_ms"].sum == pytest.approx(50.0)
-    # dispatch_ms saw both; prefill_chunk_ms only the insert.
-    assert obs.hist["dispatch_ms"].count == 2
+    # dispatch_ms saw both (one per-kind series each);
+    # prefill_chunk_ms only the insert.
+    assert obs.hist_dispatch["insert"].count == 1
+    assert obs.hist_dispatch["decode"].count == 1
     assert obs.hist["prefill_chunk_ms"].count == 1
     # Lookup also works by provisional id and bare rid.
     assert obs.timeline_json("7")["request_id"] == "ext-abc"
@@ -389,6 +400,105 @@ def test_trace_json_window_filters_old_events():
 
 
 # ---------------------------------------------------------------------------
+# Device-time attribution: per-kind histograms, cost models, compiles
+# ---------------------------------------------------------------------------
+
+def test_per_kind_dispatch_histograms_and_utilization():
+    """Dispatches split into per-kind labeled dispatch_ms series; a
+    dispatch carrying a cost model feeds the per-kind utilization
+    window (flops/bytes over wall vs the configured peaks) and its
+    record gains a roofline device-time estimate."""
+    obs = Observability(peak_flops=1e12, peak_bytes_per_s=1e12)
+    # 1 GFLOP + 1 MB over 10 ms wall -> 10% MXU, ~0.01% HBM, and a
+    # device estimate of 1 ms -> host_overhead_ratio 10.
+    obs.record_dispatch(kind="decode", k=4, wall_ms=10.0,
+                        program="_paged_decode_chunk",
+                        flops=1e8, bytes_accessed=1e6)
+    obs.record_dispatch(kind="spec", k=2, wall_ms=5.0)  # no model
+    rec = list(obs.dispatches)[0]
+    assert rec["program"] == "_paged_decode_chunk"
+    assert rec["device_est_ms"] == pytest.approx(0.1)
+    assert obs.hist_dispatch["decode"].count == 1
+    assert obs.hist_dispatch["spec"].count == 1
+    lines = obs.expose_histograms()
+    # ONE family header, labeled series per kind.
+    assert lines.count("# TYPE llm_dispatch_ms histogram") == 1
+    assert any(
+        ln.startswith('llm_dispatch_ms_bucket{kind="decode",le=')
+        for ln in lines
+    )
+    assert 'llm_dispatch_ms_count{kind="spec"} 1' in lines
+    util = {
+        (fam, lab.get("kind")): v
+        for fam, lab, v in obs.utilization_metrics()
+    }
+    assert util[("mxu_utilization", "decode")] == pytest.approx(0.01)
+    assert util[("host_overhead_ratio", "decode")] == pytest.approx(
+        100.0
+    )
+    # The model-less spec dispatch feeds no utilization window.
+    assert ("mxu_utilization", "spec") not in util
+
+
+def test_cost_model_cache_computes_once_and_caches_failure():
+    calls = {"n": 0}
+
+    class _Lowered:
+        def cost_analysis(self):
+            return {"flops": 8.0, "bytes accessed": 16.0}
+
+    def lower():
+        calls["n"] += 1
+        return _Lowered()
+
+    cache = CostModelCache()
+    assert cache.get("p", (4, True), lower) == (8.0, 16.0)
+    assert cache.get("p", (4, True), lower) == (8.0, 16.0)
+    assert calls["n"] == 1  # trace-time only: the second get is a hit
+    assert cache.get("p", (8, True), lower) == (8.0, 16.0)
+    assert calls["n"] == 2  # a new jit-cache key lowers once more
+
+    def broken():
+        raise RuntimeError("exotic sharded lowering")
+
+    assert cache.get("q", (), broken) is None
+    assert cache.get("q", (), broken) is None  # failure cached too
+    snap = cache.snapshot()
+    assert snap["p"]["keys"] == 2 and snap["p"]["modeled"] == 2
+    assert snap["q"]["modeled"] == 0
+
+
+def test_compile_recording_spans_and_counters():
+    """record_compile (the jax.monitoring listener's sink) feeds the
+    compile_ms histogram, the per-program counters, and a span on the
+    trace's dedicated 'jit compiles' track; the trace carries the
+    wall-clock anchor the fleet merge normalizes with."""
+    clk = FakeClock()
+    obs = Observability(clock=clk)
+    clk.advance(0.100)
+    obs.record_compile("_fused_chunk", 40.0)
+    obs.record_compile("_fused_chunk", 10.0)
+    obs.record_compile("_paged_insert", 5.0)
+    assert obs.hist["compile_ms"].count == 3
+    assert obs.metrics()["compiles_total"] == 3
+    assert obs.compiles_by_program == {
+        "_fused_chunk": 2, "_paged_insert": 1,
+    }
+    assert (
+        "program_compiles_total", {"program": "_fused_chunk"}, 2,
+    ) in obs.utilization_metrics()
+    doc = obs.trace_json()
+    assert doc["t0_unix_s"] > 0
+    compiles = [
+        e for e in doc["traceEvents"] if e.get("cat") == "compile"
+    ]
+    assert len(compiles) == 3
+    assert compiles[0]["name"] == "compile _fused_chunk"
+    assert compiles[0]["tid"] == 0  # its own track
+    assert compiles[0]["dur"] == 40000  # us
+
+
+# ---------------------------------------------------------------------------
 # Structured logging
 # ---------------------------------------------------------------------------
 
@@ -451,7 +561,9 @@ def test_classic_admission_span_lifecycle(model):
     assert "insert" in kinds and "decode" in kinds
     ins = [d for d in tl["dispatch_spans"] if d["kind"] == "insert"][0]
     assert ins["prefill_tokens"] == 4
-    assert cb.obs.hist["dispatch_ms"].count >= len(tl["dispatch_spans"])
+    assert sum(
+        h.count for h in cb.obs.hist_dispatch.values()
+    ) >= len(tl["dispatch_spans"])
 
 
 def test_fused_admission_span_lifecycle(model):

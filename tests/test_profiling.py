@@ -38,3 +38,22 @@ def test_trace_writes_profile(tmp_path):
     for root, _, files in os.walk(d):
         found += [f for f in files if f.endswith(".xplane.pb")]
     assert found, f"no xplane files under {d}"
+
+
+def test_normalize_program_name():
+    """xplane event names map to serving-program names: host-plane
+    PjitFunction frames and device-plane jit_ module names (with
+    specialization suffixes) both normalize; HLO-op and host noise
+    names return None."""
+    from jax_llama_tpu.utils.profiling import normalize_program_name
+
+    assert normalize_program_name(
+        "PjitFunction(_paged_decode_chunk)"
+    ) == "_paged_decode_chunk"
+    assert normalize_program_name(
+        "jit__fused_chunk"
+    ) == "_fused_chunk"
+    assert normalize_program_name("jit_myprog.3") == "myprog"
+    assert normalize_program_name("%fusion.12") is None
+    assert normalize_program_name("Thread dispatch") is None
+    assert normalize_program_name("") is None

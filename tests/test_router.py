@@ -250,6 +250,125 @@ def test_handoff_counter_via_router(model, fleet):
     assert "llm_router_kv_handoffs_total 1" in router.metrics_text()
 
 
+def test_fleet_debug_requests_aggregation_and_routing_record(
+    model, fleet,
+):
+    """/debug/requests on the router aggregates ALL healthy replicas
+    (entries tagged with their replica id — not first-to-answer), and
+    /debug/requests/<id> resolves through the routing record the relay
+    filled from each reply's X-Request-Id."""
+    router, servers, tok = fleet
+    ids = {}
+    for i, text in enumerate(["fleet dbg a", "fleet dbg b"]):
+        req = urllib.request.Request(
+            router.address + "/generate",
+            data=json.dumps(
+                {"text": text, "max_new_tokens": 4}
+            ).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "X-Request-Id": f"fleet-req-{i}",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            assert r.status == 200
+            ids[f"fleet-req-{i}"] = int(r.headers["X-Replica-Id"])
+    # Least-loaded on an idle pair alternates: both replicas served.
+    assert set(ids.values()) == {0, 1}
+    st, text = _get(router.address, "/debug/requests")
+    assert st == 200
+    idx = json.loads(text)
+    assert sorted(idx["replicas"]) == [0, 1]
+    by_id = {e["request_id"]: e for e in idx["requests"]}
+    for rid, rep in ids.items():
+        assert by_id[rid]["replica"] == rep, rid
+    # Routed lookup: the routing record names the serving replica —
+    # the OTHER replica is never asked first, so the answer cannot be
+    # a first-healthy-replica guess.
+    for rid, rep in ids.items():
+        st, tl = _get(router.address, "/debug/requests/" + rid)
+        assert st == 200
+        tl = json.loads(tl)
+        assert tl["replica"] == rep
+        assert tl["routed_replica"] == rep
+
+
+def test_fleet_merged_trace_schema_and_handoff_link(model, fleet):
+    """ACCEPTANCE PIN: the router's /debug/trace is ONE loadable
+    Perfetto document — router track + both replica tracks re-tagged
+    to their own pids with clock-offset-normalized timestamps — whose
+    router spans are causally ordered (every forward follows a route
+    to the same replica) and whose handoff span links the prefix move
+    by external request id (the same id both batchers' export/import
+    annotations carry)."""
+    router, servers, tok = fleet
+    params, config = model
+    for text in ("trace seed a", "trace seed b"):
+        st, _, _ = _post(
+            router.address, {"text": text, "max_new_tokens": 4}
+        )
+        assert st == 200
+    # A handoff brokered through the router, linked by external id.
+    prompt = list(np.random.RandomState(7).randint(1, 128, 40))
+
+    def mk():
+        return ContinuousBatcher(
+            params, config, n_slots=2, max_len=64, block_size=16,
+        )
+
+    src, dst = mk(), mk()
+    src.submit(prompt, max_new_tokens=4)
+    src.run_to_completion()
+    n = handoff_prefix(
+        src, dst, prompt, router=router,
+        request_id="sess-handoff-1", src=0, dst=1,
+    )
+    assert n > 0
+    # Both batchers' rings carry the linked annotations.
+    for cb, name in ((src, "prefix_export"), (dst, "prefix_import")):
+        evs = [
+            e for e in cb.obs.trace_json()["traceEvents"]
+            if e.get("name") == name
+        ]
+        assert evs, name
+        assert evs[-1]["args"]["request_id"] == "sess-handoff-1"
+    st, text = _get(router.address, "/debug/trace")
+    assert st == 200
+    doc = json.loads(text)  # loadable Perfetto JSON
+    assert doc["displayTimeUnit"] == "ms" and "t0_unix_s" in doc
+    assert sorted(doc["replicas"]) == [0, 1]
+    evs = doc["traceEvents"]
+    procs = {
+        e["args"]["name"] for e in evs
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert {"router", "replica-0", "replica-1"} <= procs
+    # Replica tracks carry real slices, shifted into the router frame.
+    slice_pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+    assert {0, 1, 2} <= slice_pids
+    # Router spans causally ordered: every forward follows a route
+    # decision to the same replica.
+    router_x = [
+        e for e in evs if e.get("pid") == 0 and e.get("ph") == "X"
+    ]
+    routes = [e for e in router_x if e["name"] == "route"]
+    fwds = [e for e in router_x if e["name"] == "forward"]
+    assert routes and fwds
+    for f in fwds:
+        assert any(
+            r["ts"] <= f["ts"]
+            and r["args"]["replica"] == f["args"]["replica"]
+            for r in routes
+        ), "forward without a preceding route decision"
+    # The linked handoff span.
+    hand = [e for e in router_x if e["name"] == "handoff"]
+    assert hand
+    assert hand[-1]["args"]["request_id"] == "sess-handoff-1"
+    assert hand[-1]["args"]["blocks"] == n
+    assert hand[-1]["args"]["src"] == 0
+    assert hand[-1]["args"]["dst"] == 1
+
+
 def test_router_input_validation(model, fleet):
     import urllib.error
 

@@ -3,6 +3,7 @@ must match standalone batcher output, and /metrics must expose counters."""
 
 import json
 import threading
+import urllib.parse
 import urllib.request
 
 import jax
@@ -752,7 +753,9 @@ def test_metrics_exposition_valid_prometheus(model):
     consistent with semantics, and the histogram families obey the
     cumulative-bucket invariants."""
     params, config = model
-    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, cost_models=True,
+    )
     with LLMServer(cb, tokenizer=ByteTokenizer()) as srv:
         status, _ = _post(
             srv.address, {"prompt": [3, 4, 5], "max_new_tokens": 6}
@@ -788,7 +791,7 @@ def test_metrics_exposition_valid_prometheus(model):
     # The serving histograms are exposed and internally consistent.
     for fam in ("llm_ttft_ms", "llm_itl_ms", "llm_queue_wait_ms",
                 "llm_prefill_chunk_ms", "llm_swap_in_ms",
-                "llm_dispatch_ms"):
+                "llm_compile_ms"):
         assert types[fam] == "histogram"
         buckets = [
             (n, v) for n, v in samples.items()
@@ -801,9 +804,48 @@ def test_metrics_exposition_valid_prometheus(model):
         assert len(inf) == 1
         assert inf[0] == samples[fam + "_count"]
         assert samples[fam + "_sum"] >= 0.0
-    # The request actually fed TTFT and dispatch histograms.
+    # dispatch_ms is a LABELED family: one series per dispatch kind,
+    # each internally cumulative with its own _sum/_count.
+    assert types["llm_dispatch_ms"] == "histogram"
+    kind_re = __import__("re").compile(r'kind="([a-z_]+)"')
+    kinds = {
+        kind_re.search(n).group(1)
+        for n in samples if n.startswith("llm_dispatch_ms_bucket{")
+    }
+    assert "decode" in kinds and "insert" in kinds, kinds
+    for kind in kinds:
+        buckets = [
+            v for n, v in samples.items()
+            if n.startswith("llm_dispatch_ms_bucket{")
+            and f'kind="{kind}"' in n
+        ]
+        assert buckets == sorted(buckets), f"{kind} not cumulative"
+        assert buckets[-1] == samples[
+            f'llm_dispatch_ms_count{{kind="{kind}"}}'
+        ]
+        assert samples[f'llm_dispatch_ms_sum{{kind="{kind}"}}'] >= 0.0
+    # The request actually fed TTFT and the per-kind dispatch series.
     assert samples["llm_ttft_ms_count"] >= 1
-    assert samples["llm_dispatch_ms_count"] >= 1
+    assert samples['llm_dispatch_ms_count{kind="decode"}'] >= 1
+    # Device-time attribution: per-kind utilization gauges (the
+    # batcher above has cost models ON) and the jit-cache entry gauge
+    # (one labeled sample per registered program).
+    for fam in ("llm_mxu_utilization", "llm_hbm_utilization",
+                "llm_host_overhead_ratio", "llm_jit_cache_entries",
+                "llm_program_compiles_total"):
+        assert fam in types, fam
+    assert types["llm_mxu_utilization"] == "gauge"
+    assert samples['llm_mxu_utilization{kind="decode"}'] >= 0.0
+    assert samples['llm_host_overhead_ratio{kind="decode"}'] > 0.0
+    cache_progs = {
+        n for n in samples if n.startswith("llm_jit_cache_entries{")
+    }
+    assert (
+        'llm_jit_cache_entries{program="_paged_decode_chunk"}'
+        in cache_progs
+    )
+    assert len(cache_progs) == 10  # all registered serving programs
+    assert samples["llm_compiles_total"] >= 0
     # SLO gauges present (unset deadlines -> 0 / attainment 1.0).
     assert samples["llm_slo_ttft_ms"] == 0.0
     assert samples["llm_slo_attainment"] == 1.0
@@ -964,12 +1006,25 @@ def test_debug_profiler_endpoint(model, tmp_path):
         except urllib.error.HTTPError as e:
             return e.code, json.loads(e.read())
 
+    def get_json(srv, path):
+        try:
+            with urllib.request.urlopen(
+                srv.address + path, timeout=60
+            ) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
     log_dir = str(tmp_path / "xplane")
     with LLMServer(cb) as srv:
         status, body = post_prof(srv, {"action": "bogus"})
         assert status == 400
         status, body = post_prof(srv, {"action": "stop"})
         assert status == 409  # nothing active
+        # No completed session yet: the summary endpoint 404s cleanly
+        # (before any xplane parsing machinery is touched).
+        status, body = get_json(srv, "/debug/profile/summary")
+        assert status == 404 and "profiler" in body["error"]
         status, body = post_prof(
             srv, {"action": "start", "log_dir": log_dir}
         )
@@ -978,6 +1033,12 @@ def test_debug_profiler_endpoint(model, tmp_path):
             srv, {"action": "start", "log_dir": log_dir}
         )
         assert status == 409  # already tracing
+        # Summarizing the ACTIVE session's dir is refused too.
+        status, body = get_json(
+            srv, "/debug/profile/summary?log_dir="
+            + urllib.parse.quote(log_dir)
+        )
+        assert status == 409
         status, _ = _post(
             srv.address, {"prompt": [3, 4], "max_new_tokens": 3}
         )
@@ -989,6 +1050,56 @@ def test_debug_profiler_endpoint(model, tmp_path):
     assert any(
         f for _, _, fs in os.walk(log_dir) for f in fs
     ), "profiler session wrote no trace files"
+
+
+@pytest.mark.obs
+@pytest.mark.slow
+def test_debug_profile_summary_attributes_programs(model, tmp_path):
+    """GET /debug/profile/summary parses the completed xplane session
+    into per-program time attribution: the serving programs the
+    bracketed traffic dispatched appear with nonzero host/device ms.
+    Slow-marked: the xplane proto import (tensorflow.tsl) costs
+    seconds; self-skips where the protos are unavailable."""
+    pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=1, max_len=64)
+
+    def post_prof(srv, payload):
+        req = urllib.request.Request(
+            srv.address + "/debug/profiler",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+
+    log_dir = str(tmp_path / "xplane")
+    with LLMServer(cb) as srv:
+        status, _ = post_prof(
+            srv, {"action": "start", "log_dir": log_dir}
+        )
+        assert status == 200
+        status, _ = _post(
+            srv.address, {"prompt": [5, 6, 7], "max_new_tokens": 4}
+        )
+        assert status == 200
+        status, _ = post_prof(srv, {"action": "stop"})
+        assert status == 200
+        with urllib.request.urlopen(
+            srv.address + "/debug/profile/summary", timeout=120
+        ) as r:
+            assert r.status == 200
+            summary = json.loads(r.read())
+    assert summary["log_dir"] == log_dir
+    progs = summary["programs"]
+    # The bracketed request dispatched decode chunks: attributed.
+    assert "_paged_decode_chunk" in progs
+    attributed = (
+        progs["_paged_decode_chunk"]["host_ms"]
+        + progs["_paged_decode_chunk"]["device_ms"]
+    )
+    assert attributed > 0
+    assert summary["total_host_ms"] + summary["total_device_ms"] > 0
 
 
 def test_http_overload_refusal_503_carries_retry_after(model):
