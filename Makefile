@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve bench-compare
+.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve bench-compare check
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -83,11 +83,17 @@ mesh-serve:
 # Invariant auditor (jax_llama_tpu/analysis): host-boundary lint,
 # lowering-contract audit (donated args actually alias, host-fetch
 # surface within budget, no full-pool-copy equations — all ten
-# registered jitted programs lowered at a tiny geometry), and the
-# lock-discipline / thread-confinement check — plus `ruff check`
-# (pyflakes-class rules, [tool.ruff] in pyproject.toml) when ruff is
-# installed in the environment.  Exit non-zero on any finding; the
-# static layers also gate tier-1 via tests/test_analysis.py.
+# registered jitted programs lowered at a tiny geometry), the
+# lock-discipline / thread-confinement check, the retrace auditor
+# (bounded jit-cache-key domains statically + the admission-sweep
+# cache drill), the comms-budget contracts (collective counts/bytes
+# in the COMPILED sharded lowerings; full-pool collectives are hard
+# findings), the schedule explorer (every racy-read/unguarded pragma
+# backed by a passing interleaving model) and the metrics-registry
+# lint — plus `ruff check` (pyflakes-class rules, [tool.ruff] in
+# pyproject.toml) when ruff is installed in the environment.  Exit
+# non-zero on any finding; the static layers also gate tier-1 via
+# tests/test_analysis.py.
 lint-invariants:
 	env JAX_PLATFORMS=cpu python -m jax_llama_tpu.analysis
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -95,6 +101,16 @@ lint-invariants:
 	else \
 		echo "ruff not installed; skipping ruff check (pip install ruff)"; \
 	fi
+
+# THE single pre-PR gate: the full invariant audit (above, ruff
+# included behind its command gate), the fast analysis tests, and the
+# perf-smoke host-boundary drills.  Green `make check` = the static
+# contracts, the thread-safety models, the jit-cache/comms budgets
+# and the 1-fetch/0-upload discipline all hold — run it before every
+# push; tier1 remains the full gating suite.
+check: lint-invariants
+	$(PYTEST) tests/test_analysis.py -q -m 'not slow'
+	$(MAKE) perf-smoke
 
 # Machine-check the bench trajectory: diff headline keys between two
 # BENCH_*/MULTICHIP_* records and exit non-zero past tolerance

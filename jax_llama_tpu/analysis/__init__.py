@@ -30,9 +30,32 @@ machine-checked contracts, runnable on any backend in seconds:
     ``LLMServer`` (owner-thread-confined); unguarded touches need an
     ``# audit: racy-read(...)`` / ``locked(...)`` / ``unguarded(...)``
     pragma carrying the safety argument.
+  * :mod:`.retrace` — **retrace auditor** (AST dataflow + runtime
+    drill): every value entering a registered program's jit cache key
+    — static args and admission-shaped dims — must flow through a
+    bounded-domain constructor (``pow2_bucket``, a clamp, a bool, a
+    ctor-stable attribute); each contract declares ``max_cache_keys``
+    and a real-batcher admission sweep asserts
+    ``serving.jit_cache_entries()`` stays within it.  Sanction with
+    ``# audit: trace-domain(...)``.
+  * :mod:`.comms` — **comms-budget contracts** (compiled sharded
+    lowering + jaxpr): per-program collective counts/bytes against a
+    declared :class:`~.contracts.CommsBudget`; a full-pool-shaped
+    collective is a hard finding (the silent reshard class
+    mesh-sharding-drift cannot see).
+  * :mod:`.schedules` — **schedule explorer**: every ``racy-read`` /
+    ``unguarded`` pragma maps to a deterministic interleaving model
+    over the real classes (preemption-exploring the real readers
+    line-by-line against the writers' declared critical regions under
+    a virtual clock); a pragma with no passing model is a finding.
+  * :mod:`.metricscheck` — **metrics-registry lint**: ``obs.METRICS``
+    names must be emitted somewhere and every provider-emitted scalar
+    must be registered — statically, for every configuration.
 
 Run everything with ``python -m jax_llama_tpu.analysis`` (exit 0 =
-clean) or ``make lint-invariants``; tier-1 runs the same checks via
+clean) or ``make lint-invariants``; ``make check`` stacks the ruff
+gate, the fast analysis tests and perf-smoke on top as the single
+pre-PR gate.  Tier-1 runs the same checks via
 ``tests/test_analysis.py`` (``pytest -m analysis``), so a violating
 change fails CI before any bench round notices.  The pragma grammar
 and the how-to for registering a new program's contract live in
@@ -40,7 +63,9 @@ README.md ("Static analysis").
 """
 
 from .common import Finding, Pragmas  # noqa: F401
-from .contracts import REGISTRY, ProgramContract  # noqa: F401
+from .contracts import (  # noqa: F401
+    REGISTRY, CommsBudget, ProgramContract,
+)
 from .hostsync import AUDITED_MODULES, HostBoundaryChecker  # noqa: F401
 from .lockcheck import (  # noqa: F401
     CONFINEMENTS, LOCK_GUARDS, LockDisciplineChecker, LockGuard,
@@ -52,9 +77,19 @@ from typing import List
 
 
 def run_all(trace: bool = True) -> List[Finding]:
-    """Run all three checkers over the package; [] means clean."""
+    """Run every checker over the package; [] means clean.  ``trace``
+    gates the compile-heavy layers (abstract-trace lowering, comms
+    budgets, the retrace jit-cache drill)."""
+    from . import comms, metricscheck, retrace, schedules
+
     findings: List[Finding] = []
     findings.extend(HostBoundaryChecker().check_package())
     findings.extend(LockDisciplineChecker().check_package())
     findings.extend(LoweringAuditor().check_package(trace=trace))
+    findings.extend(retrace.check_static())
+    if trace:
+        findings.extend(retrace.check_runtime())
+        findings.extend(comms.check_package())
+    findings.extend(schedules.check_package())
+    findings.extend(metricscheck.check_package())
     return findings

@@ -2,17 +2,31 @@
 auditor over the package (or explicit files) and exit non-zero on any
 finding.
 
-    python -m jax_llama_tpu.analysis                  # all three checkers
-    python -m jax_llama_tpu.analysis --checker host   # one checker
-    python -m jax_llama_tpu.analysis --no-trace       # skip the (slower)
-                                                      # abstract-trace layer
+    python -m jax_llama_tpu.analysis                  # all seven passes
+    python -m jax_llama_tpu.analysis --checker host   # one pass
+    python -m jax_llama_tpu.analysis --no-trace       # skip the compile-
+                                                      # heavy layers (trace
+                                                      # lowering, comms,
+                                                      # the jit-cache drill)
     python -m jax_llama_tpu.analysis path/to/file.py  # lint given files
                                                       # (host + lock only)
     python -m jax_llama_tpu.analysis --contracts pkg.mod
                                                       # audit an external
                                                       # REGISTRY (tests)
+    python -m jax_llama_tpu.analysis --json           # machine-readable
+                                                      # findings + per-pass
+                                                      # exit codes
+    python -m jax_llama_tpu.analysis --report         # dump the sanctioned
+                                                      # pragma surface +
+                                                      # schedule models
 
-Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.  Under
+``--json`` the findings code is per-pass stable instead of 1 — CI can
+route failures without parsing:
+
+    9  findings in more than one pass
+    10 host-boundary   11 lowering   12 lock-discipline
+    13 retrace         14 comms      15 schedules        16 metrics
 """
 
 from __future__ import annotations
@@ -24,19 +38,31 @@ import os
 import sys
 from typing import List, Optional, Sequence
 
-# The serving-mesh contract pass lowers sharded program variants on
-# forced host devices — the flag must land before ANY jax import (the
-# checkers import jax lazily, so setting it here covers them all).
+# The serving-mesh contract + comms passes lower sharded program
+# variants on forced host devices — the flag must land before ANY jax
+# import (the checkers import jax lazily, so setting it here covers
+# them all).
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-from .common import Finding
+from .common import Finding, Pragmas, iter_package_sources
 from .hostsync import HostBoundaryChecker
 from .lockcheck import LockDisciplineChecker
 from .lowering import LoweringAuditor
+
+# Pass order is the exit-code order (module docstring).
+PASS_CODES = {
+    "host-boundary": 10, "lowering": 11, "lock-discipline": 12,
+    "retrace": 13, "comms": 14, "schedules": 15, "metrics": 16,
+}
+
+_CHECKER_CHOICES = (
+    "all", "host", "lowering", "lock", "retrace", "comms",
+    "schedules", "metrics",
+)
 
 
 def _file_findings(paths: Sequence[str], checker: str) -> List[Finding]:
@@ -52,29 +78,75 @@ def _file_findings(paths: Sequence[str], checker: str) -> List[Finding]:
     return out
 
 
+def _report() -> dict:
+    """The sanctioned-surface dump: every audit pragma in the package
+    with kind, site and justification, plus the schedule models (name,
+    site, claim) the cross-thread pragmas resolve to."""
+    from .schedules import MODELS, pragma_sites
+
+    pragmas = []
+    for path, source in iter_package_sources():
+        for line, kind, reason in Pragmas.scan(source).records:
+            pragmas.append({
+                "path": path, "line": line, "kind": kind,
+                "reason": reason,
+            })
+    sites = {(s.module, s.func) for s in pragma_sites()}
+    models = []
+    for mk in MODELS:
+        m = mk()
+        models.append({
+            "model": m.name, "site": f"{m.module}.{m.func}",
+            "claim": m.claim,
+            "pragma_site_exists": (m.module, m.func) in sites,
+        })
+    by_kind: dict = {}
+    for p in pragmas:
+        by_kind[p["kind"]] = by_kind.get(p["kind"], 0) + 1
+    return {
+        "pragmas": sorted(
+            pragmas, key=lambda p: (p["kind"], p["path"], p["line"])
+        ),
+        "pragma_counts": by_kind,
+        "schedule_models": models,
+    }
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m jax_llama_tpu.analysis",
         description="Invariant auditor for the serving stack "
                     "(host-boundary lint, lowering contracts, lock "
-                    "discipline).",
+                    "discipline, retrace domains, comms budgets, "
+                    "schedule models, metrics registry).",
     )
     parser.add_argument(
-        "--checker", choices=("all", "host", "lowering", "lock"),
-        default="all",
+        "--checker", choices=_CHECKER_CHOICES, default="all",
     )
     parser.add_argument(
         "--no-trace", action="store_true",
-        help="lowering auditor: static (AST) layer only — skip the "
-             "abstract trace of each registered program",
+        help="skip the compile-heavy layers: the lowering auditor's "
+             "abstract-trace + mesh passes, the comms-budget compile, "
+             "and the retrace jit-cache drill (static layers still "
+             "run)",
     )
     parser.add_argument(
         "--contracts", metavar="MODULE",
         help="import MODULE and audit its REGISTRY instead of the "
              "built-in one (fixture/testing hook)",
     )
-    parser.add_argument("--json", action="store_true",
-                        help="machine-readable findings")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable findings (checker, rule, path, line, "
+             "message, severity, sanctionable) and per-pass stable "
+             "exit codes",
+    )
+    parser.add_argument(
+        "--report", action="store_true",
+        help="dump the sanctioned surface (every audit pragma with "
+             "its justification + the schedule-model table) as JSON "
+             "and exit 0",
+    )
     parser.add_argument(
         "paths", nargs="*",
         help="explicit .py files to lint (host + lock checkers only); "
@@ -82,6 +154,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     args = parser.parse_args(argv)
 
+    if args.report:
+        print(json.dumps(_report(), indent=2))
+        return 0
     if args.contracts and args.no_trace:
         # An external registry has ONLY the trace layer — static-only
         # would silently audit nothing.
@@ -91,13 +166,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.paths and args.checker == "lowering":
-        # The lowering auditor works from the contract registry, not
-        # from source paths — "clean" here would mean "never ran".
+    if args.no_trace and args.checker == "comms":
+        # The comms pass IS a compile-time audit — "clean" under
+        # --no-trace would mean "never ran".
         print(
-            "--checker lowering audits the contract registry and does "
-            "not take file paths (use --checker host/lock/all with "
-            "paths)",
+            "--checker comms has only the compiled-lowering layer; "
+            "--no-trace would skip it and report a vacuous clean",
+            file=sys.stderr,
+        )
+        return 2
+    if args.contracts and args.checker == "retrace":
+        # The retrace static layer audits the PACKAGE's dispatch call
+        # sites and the jit-cache drill is package-batcher-driven —
+        # neither can audit an external fixture registry, so "clean"
+        # here would mean "never looked at your registry".
+        print(
+            "--checker retrace audits the package's own call sites "
+            "and cache drill; it cannot audit an external --contracts "
+            "registry (use --checker lowering/comms with --contracts)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.paths and args.checker not in ("all", "host", "lock"):
+        # Registry-driven passes audit the contract registry / the
+        # package, not source paths — "clean" would mean "never ran".
+        print(
+            f"--checker {args.checker} audits the package registries "
+            "and does not take file paths (use --checker host/lock/"
+            "all with paths)",
             file=sys.stderr,
         )
         return 2
@@ -111,21 +207,47 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 findings.extend(HostBoundaryChecker().check_package())
             if args.checker in ("all", "lock"):
                 findings.extend(LockDisciplineChecker().check_package())
-        if args.checker in ("all", "lowering") and not args.paths:
+            if args.checker in ("all", "retrace"):
+                from . import retrace
+
+                findings.extend(retrace.check_static())
+                if not args.no_trace and not args.contracts:
+                    findings.extend(retrace.check_runtime())
+            if args.checker in ("all", "schedules"):
+                from . import schedules
+
+                findings.extend(schedules.check_package())
+            if args.checker in ("all", "metrics"):
+                from . import metricscheck
+
+                findings.extend(metricscheck.check_package())
+        if args.checker in ("all", "lowering", "comms") and not args.paths:
             if args.contracts:
                 # External registry: audit ITS programs' lowerings only
                 # (the static coverage layer is about the package's own
                 # modules and would mis-fire against a fixture registry).
-                from .lowering import check_traces
+                registry = importlib.import_module(
+                    args.contracts
+                ).REGISTRY
+                if args.checker in ("all", "lowering"):
+                    from .lowering import check_traces
 
-                registry = importlib.import_module(args.contracts).REGISTRY
-                findings.extend(check_traces(registry))
+                    findings.extend(check_traces(registry))
+                if args.checker in ("all", "comms"):
+                    from . import comms
+
+                    findings.extend(comms.check_package(registry))
             else:
-                findings.extend(
-                    LoweringAuditor().check_package(
-                        trace=not args.no_trace
+                if args.checker in ("all", "lowering"):
+                    findings.extend(
+                        LoweringAuditor().check_package(
+                            trace=not args.no_trace
+                        )
                     )
-                )
+                if args.checker in ("all", "comms") and not args.no_trace:
+                    from . import comms
+
+                    findings.extend(comms.check_package())
     except Exception as e:  # noqa: BLE001 - CLI boundary
         print(f"analysis failed: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -142,7 +264,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             f"invariant audit: {n} finding{'s' if n != 1 else ''}"
             + ("" if n else " — clean")
         )
-    return 1 if findings else 0
+    if not findings:
+        return 0
+    if args.json:
+        passes = {f.checker for f in findings}
+        if len(passes) == 1:
+            return PASS_CODES.get(passes.pop(), 1)
+        return 9
+    return 1
 
 
 if __name__ == "__main__":

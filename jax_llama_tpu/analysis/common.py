@@ -32,9 +32,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 # Pragma kinds, by checker:
 #   host-fetch / host-upload / device-flow  -> hostsync.py
 #   locked / racy-read / unguarded          -> lockcheck.py
+#   trace-domain                            -> retrace.py
 PRAGMA_KINDS = frozenset({
     "host-fetch", "host-upload", "device-flow",
     "locked", "racy-read", "unguarded",
+    "trace-domain",
 })
 
 _PRAGMA_OPEN_RE = re.compile(r"#\s*audit:\s*([A-Za-z-]+)\s*\((.*)$")
@@ -45,10 +47,19 @@ class Finding:
     """One invariant violation (or registry inconsistency)."""
 
     checker: str    # "host-boundary" | "lowering" | "lock-discipline"
+                    # | "retrace" | "comms" | "schedules" | "metrics"
     rule: str       # short kebab-case rule id, e.g. "host-fetch"
     path: str       # repo-relative or synthetic module path
     line: int       # 1-based line of the offending node (0 = module)
     message: str
+    # "error" findings gate lint-invariants; "warn" is reserved for
+    # advisory output (--report surfaces), never emitted by the gating
+    # passes today.  Machine consumers read it from --json.
+    severity: str = "error"
+    # Whether a pragma of the sanctioning kind could suppress this
+    # finding (the --json "pragma" field: tooling distinguishes
+    # annotate-to-sanction findings from hard structural ones).
+    sanctionable: bool = False
 
     def render(self) -> str:
         return (
@@ -61,9 +72,13 @@ class Pragmas:
     """``# audit:`` pragmas of one source file, indexed by line."""
 
     def __init__(self, by_line: Dict[int, List[Tuple[str, str]]],
-                 bad_lines: List[Tuple[int, str]]):
+                 bad_lines: List[Tuple[int, str]],
+                 records: Optional[List[Tuple[int, str, str]]] = None):
         self._by_line = by_line
         self.bad_lines = bad_lines  # [(line, raw kind)] unknown kinds
+        # One (first_line, kind, reason) per pragma — the --report
+        # surface (by_line duplicates multi-line pragmas per line).
+        self.records = records if records is not None else []
 
     @classmethod
     def scan(cls, source: str) -> "Pragmas":
@@ -72,12 +87,14 @@ class Pragmas:
         the pragma then covers every line it spans."""
         by_line: Dict[int, List[Tuple[str, str]]] = {}
         bad: List[Tuple[int, str]] = []
+        records: List[Tuple[int, str, str]] = []
 
         def record(kind: str, reason: str, lines: List[int]) -> None:
             reason = reason.strip()
             if kind not in PRAGMA_KINDS or not reason:
                 bad.append((lines[0], kind))
                 return
+            records.append((lines[0], kind, reason))
             for line in lines:
                 by_line.setdefault(line, []).append((kind, reason))
 
@@ -122,7 +139,7 @@ class Pragmas:
                 bad.append((open_lines[0], open_kind))
         except tokenize.TokenError:
             pass  # syntactically broken file: the AST parse reports it
-        return cls(by_line, bad)
+        return cls(by_line, bad, records)
 
     def kinds_in_span(self, lo: int, hi: int) -> Set[str]:
         out: Set[str] = set()

@@ -43,6 +43,33 @@ _VOCAB, _MAXLEN, _BLOCK, _SLOTS = 128, 64, 16, 2
 
 
 @dataclasses.dataclass(frozen=True)
+class CommsBudget:
+    """Per-program collective-communication budget, declared at the
+    contract's tiny mesh example geometry (data=2 x tensor=2 over 4
+    forced host devices) and checked by :mod:`.comms` against the
+    COMPILED sharded lowering (GSPMD inserts collectives at partition
+    time — they exist nowhere earlier) plus the traced jaxpr (explicit
+    ``psum``/``all_gather``-class primitives from shard_map code).
+
+      * ``max_count``: collective kind -> max instruction count in the
+        compiled module (a kind absent from the dict allows ZERO).
+        Counts are per compiled-module text — an op inside a scan body
+        counts once but executes per iteration, which is exactly the
+        per-dispatch cost class the budget bounds.
+      * ``max_bytes``: result bytes of the largest single collective.
+        The legit per-layer tensor-axis reductions the matmul sharding
+        implies are activation-sized; a pool-sized reshard is 1-2
+        orders larger at any geometry, so the byte bar separates the
+        two robustly even as XLA's exact op counts drift.
+
+    Full-pool / one-plane shaped collective RESULTS are a hard finding
+    regardless of budget (not declarable here on purpose)."""
+
+    max_count: Dict[str, int]
+    max_bytes: int
+
+
+@dataclasses.dataclass(frozen=True)
 class ProgramContract:
     name: str
     module: str                       # import path of the owning module
@@ -74,6 +101,20 @@ class ProgramContract:
         Callable[[], Tuple[Tuple[str, ...], tuple, dict]]
     ] = None
     mesh_aliases: Optional[Dict[str, int]] = None
+    # Jit-cache-key budget (analysis/retrace.py): the maximum number of
+    # NEW executable-cache entries ONE serving configuration may create
+    # for this program across its whole admission surface — the product
+    # of the bounded domains its static args and admission-shaped dims
+    # may take (pow2 buckets are O(log), bools are 2, ctor-stable args
+    # are 1).  Checked two ways: the static pass proves every cache-key
+    # value at every dispatch call site flows through a bounded-domain
+    # constructor, and the runtime drill sweeps the admission surface
+    # asserting ``serving.jit_cache_entries()`` stays within this
+    # budget.  REQUIRED: a registered program without one is a finding.
+    max_cache_keys: Optional[int] = None
+    # Collective-comms budget (analysis/comms.py) for the SHARDED
+    # lowering; required whenever ``mesh_build`` is set.
+    comms: Optional[CommsBudget] = None
 
 
 # -- example-argument factories ---------------------------------------------
@@ -472,6 +513,30 @@ _CHUNK_DONATED = (
     "keys",
 )
 
+# Comms budgets (see CommsBudget): counts measured on this image's XLA
+# at the tiny data=2 x tensor=2 geometry after the gathered-view /
+# pool-plane sharding pins landed, with ~50% headroom.  The all-reduce
+# populations are the per-layer tensor-axis reductions the Megatron
+# matmul sharding implies (attn out + mlp down per layer, per scan
+# iteration) plus scalar control reductions; the only all-gathers are
+# slab-/row-/[1, V]-logits-sized.  ``max_bytes`` sits an order of
+# magnitude below the full-pool byte size at the same geometry (64 KiB)
+# so a pool-scale reshard can never hide inside the count budget.
+_DECODE_CHUNK_COMMS = CommsBudget(
+    max_count={
+        "all-gather": 8, "all-reduce": 36, "collective-permute": 12,
+        "reduce-scatter": 4,
+    },
+    max_bytes=4096,
+)
+_FUSED_CHUNK_COMMS = CommsBudget(
+    max_count={
+        "all-gather": 24, "all-reduce": 280, "collective-permute": 24,
+        "reduce-scatter": 8, "all-to-all": 4,
+    },
+    max_bytes=16384,
+)
+
 REGISTRY: Dict[str, ProgramContract] = {
     c.name: c for c in (
         ProgramContract(
@@ -479,6 +544,9 @@ REGISTRY: Dict[str, ProgramContract] = {
             donated=("pool",), max_live_outputs=2,
             max_fetch_bytes_per_row=16,
             build=_build_paged_decode_step,
+            # all_greedy (bool); config/mesh/allow_kernel/with_logprobs
+            # are ctor-stable per batcher.
+            max_cache_keys=4,
         ),
         ProgramContract(
             name="_paged_decode_chunk", module="jax_llama_tpu.serving",
@@ -487,6 +555,10 @@ REGISTRY: Dict[str, ProgramContract] = {
             build=_build_paged_decode_chunk,
             mesh_build=_build_paged_decode_chunk_mesh,
             mesh_aliases=dict(_CHUNK_ALIASES),
+            # n_iter pow2 <= decode_chunk (log2 K + 1 <= 6) x all_greedy
+            # (2) x stop-table width pow2 regrowth (O(log max stops)).
+            max_cache_keys=24,
+            comms=_DECODE_CHUNK_COMMS,
         ),
         ProgramContract(
             name="_fused_chunk", module="jax_llama_tpu.serving",
@@ -495,12 +567,20 @@ REGISTRY: Dict[str, ProgramContract] = {
             build=_build_fused_chunk,
             mesh_build=_build_fused_chunk_mesh,
             mesh_aliases=dict(_CHUNK_ALIASES, pf_off=9),
+            # n_iter pow2 (<= 6) x pf_chunk pow2-down from the budget
+            # flag (<= 5) x pf_toks buffer in pow2 chunk counts
+            # (<= 5) x all_greedy (2) — the admission sweep touches a
+            # sparse corner of that product, and every axis is O(log).
+            max_cache_keys=48,
+            comms=_FUSED_CHUNK_COMMS,
         ),
         ProgramContract(
             name="_spec_round", module="jax_llama_tpu.serving",
             donated=("t_pool", "d_pool"), max_live_outputs=4,
             max_fetch_bytes_per_row=64,
             build=_build_spec_round,
+            # all_greedy (2) x use_kernel (2).
+            max_cache_keys=6,
         ),
         ProgramContract(
             name="_spec_rounds_chunk", module="jax_llama_tpu.serving",
@@ -508,18 +588,27 @@ REGISTRY: Dict[str, ProgramContract] = {
                      "pos", "active", "remaining", "keys"),
             max_live_outputs=1, max_fetch_bytes_per_row=64,
             build=_build_spec_rounds_chunk,
+            # n_rounds pow2 <= spec_rounds (<= 5) x all_greedy (2) x
+            # use_kernel (2) x stop-width regrowth.
+            max_cache_keys=24,
         ),
         ProgramContract(
             name="_paged_insert", module="jax_llama_tpu.serving",
             donated=("pool",), max_live_outputs=4,
             max_fetch_bytes_per_row=32,
             build=_build_paged_insert,
+            # row count kb pow2 (log2 n_slots + 1) x group width P in
+            # pow2 block counts (log2 blocks_per_slot + 1).
+            max_cache_keys=32,
         ),
         ProgramContract(
             name="_paged_suffix_insert", module="jax_llama_tpu.serving",
             donated=("pool",), max_live_outputs=3,
             max_fetch_bytes_per_row=32,
             build=_build_paged_suffix_insert,
+            # row count kb pow2 x suffix width T in pow2 block counts
+            # (_suffix_pad).
+            max_cache_keys=32,
         ),
         ProgramContract(
             name="_scatter_rows", module="jax_llama_tpu.serving",
@@ -530,6 +619,9 @@ REGISTRY: Dict[str, ProgramContract] = {
             # per-slot state twins; its whole contract is the
             # donation/zero-live-output check above.
             forbid_pool_shapes=False,
+            # dirty-row count Rb pow2 (log2 n_slots + 1) x stop-table
+            # width pow2 regrowth.
+            max_cache_keys=16,
         ),
         ProgramContract(
             name="_release_blocks", module="jax_llama_tpu.serving",
@@ -539,6 +631,9 @@ REGISTRY: Dict[str, ProgramContract] = {
             # Only the pool's [NB, BLK] pos plane rides along — that
             # is the shape no copy-class equation may produce.
             forbidden_shapes=lambda args: [tuple(args[0].shape)],
+            # id batches are padded to the FIXED blocks_per_slot width
+            # (_invalidate_evicted): one key per batcher geometry.
+            max_cache_keys=2,
         ),
         ProgramContract(
             name="_adopt_jit", module="jax_llama_tpu.kvcache",
@@ -550,6 +645,9 @@ REGISTRY: Dict[str, ProgramContract] = {
             forbidden_shapes=lambda args: [
                 tuple(a.shape) for a in args[0]
             ],
+            # staged block count pow2-bucketed (kvcache.stage_restore):
+            # log2 n_blocks + 1 buckets.
+            max_cache_keys=12,
         ),
     )
 }

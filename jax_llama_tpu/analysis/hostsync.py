@@ -251,6 +251,7 @@ class _FunctionLint(ast.NodeVisitor):
         self.findings.append(Finding(
             checker=CHECKER, rule=rule, path=self.path,
             line=getattr(node, "lineno", 0), message=message,
+            sanctionable=True,
         ))
 
     # -- visitors ------------------------------------------------------------

@@ -267,6 +267,7 @@ class _WithLockTracker(ast.NodeVisitor):
             ):
                 self.findings.append(Finding(
                     checker=CHECKER, rule="unlocked-access",
+                    sanctionable=True,
                     path=self.path, line=node.lineno,
                     message=(
                         f"{self.guard.cls}.{self.method} touches "
@@ -375,6 +376,7 @@ class LockDisciplineChecker:
                     continue
                 findings.append(Finding(
                     checker=CHECKER, rule="foreign-thread-access",
+                    sanctionable=True,
                     path=path, line=sub.lineno,
                     message=(
                         f"{conf.cls}.{node.name} (runs off the owner "
@@ -436,6 +438,7 @@ class LockDisciplineChecker:
                 )
                 findings.append(Finding(
                     checker=CHECKER, rule="foreign-thread-access",
+                    sanctionable=True,
                     path=path, line=node.lineno,
                     message=(
                         f"access to {conf.cls} state "

@@ -76,7 +76,7 @@ from ..ops.norm import rms_norm
 from ..ops.quant import QuantizedTensor as _QuantizedTensor
 from ..ops.quant import matmul as _quant_matmul
 from ..ops.rope import apply_rope, rope_table
-from ..parallel.mesh import constrain
+from ..parallel.mesh import constrain, current_mesh
 
 Params = Dict[str, Any]
 
@@ -271,6 +271,36 @@ _POOL_WRITE_UNROLL_MAX = 256
 FLASH_MIN_SEQ = 8
 
 
+def _constrain_heads(x: Optional[jnp.ndarray], axis: int):
+    """Pin one array's (KV-)head axis to ``tensor`` when the active
+    mesh's tensor size divides it; no-op otherwise (no mesh, head
+    count not divisible, tensor == 1).  Left unconstrained, GSPMD's
+    propagation is free to resolve conflicts by REPLICATING cached KV
+    operands — a full-pool/full-view all-gather inside every decode
+    iteration, which the comms-budget contracts (analysis/comms.py)
+    treat as a hard finding."""
+    mesh = current_mesh()
+    if mesh is None or x is None:
+        return x
+    tp = int(mesh.shape.get("tensor", 1))
+    if tp <= 1 or x.shape[axis] % tp:
+        return x
+    names: list = [None] * x.ndim
+    names[axis] = "tensor"
+    return constrain(x, *names)
+
+
+def _constrain_pool_plane(plane: jnp.ndarray) -> jnp.ndarray:
+    """Pin a paged-pool KV plane ``[L, KVH, NB, BLK(, d)]`` to the
+    serving placement's KV-head-over-``tensor`` sharding.  No-op
+    without an active mesh, for 2-dim pos planes, and when ``tensor``
+    does not divide the head axis (off-envelope meshes keep legacy
+    propagation).  See :func:`_constrain_heads` for why."""
+    if plane.ndim < 4:
+        return plane
+    return _constrain_heads(plane, 1)
+
+
 def paged_pool_write(
     plane: jnp.ndarray,
     upd: jnp.ndarray,
@@ -314,13 +344,18 @@ def paged_pool_write(
     blk, off: [B, T] int32 physical coordinates (sentinel NB = drop).
     """
     B, T = blk.shape
+    plane = _constrain_pool_plane(plane)
+    # The update slabs carry the same [L, KVH, ...] head axis: pin them
+    # too, or their (replicated) sharding drags the slab re-reads — and
+    # with them the whole plane — replicated through the `where`.
+    upd = _constrain_pool_plane(upd)
     if B * T > _POOL_WRITE_UNROLL_MAX:
         # Batched scatter: mode="drop" discards the sentinel NB pairs,
         # matching the chain's contract exactly.
         if plane.ndim == 5 or plane.ndim == 4:
-            return plane.at[:, :, blk, off].set(
+            return _constrain_pool_plane(plane.at[:, :, blk, off].set(
                 upd.astype(plane.dtype), mode="drop"
-            )
+            ))
         return plane.at[blk, off].set(upd.astype(plane.dtype), mode="drop")
     if plane.ndim == 5:
         L, KVH, NB, BLK, d = plane.shape
@@ -344,7 +379,9 @@ def paged_pool_write(
             )
             cur = lax.dynamic_slice(plane, start, slab)
             u = jnp.where(live[b, t], pick(b, t).astype(plane.dtype), cur)
-            plane = lax.dynamic_update_slice(plane, u, start)
+            plane = _constrain_pool_plane(
+                lax.dynamic_update_slice(plane, u, start)
+            )
     return plane
 
 
@@ -1163,8 +1200,12 @@ def forward(
             # cache + scales per layer.
             def scan_fn(carry, xs):
                 layer_params, ck, cv, cks, cvs = xs
+                # Per-layer cache slices [B, S, KVH(, hd)]: keep the
+                # KV-head axis sharded through the scan's xs slicing.
                 y, ck, cv, cks, cvs = block(
-                    carry, layer_params, ck, cv, cks, cvs
+                    carry, layer_params,
+                    _constrain_heads(ck, 2), _constrain_heads(cv, 2),
+                    _constrain_heads(cks, 2), _constrain_heads(cvs, 2),
                 )
                 return y, (ck, cv, cks, cvs)
 
@@ -1182,7 +1223,12 @@ def forward(
             # double-buffer copy per decode step inside scan/while.
             def scan_fn(carry, xs):
                 layer_params, ck, cv = xs
-                y, ck, cv, _, _ = block(carry, layer_params, ck, cv)
+                # Per-layer cache slices [B, S, KVH, hd]: keep the
+                # KV-head axis sharded through the scan's xs slicing.
+                y, ck, cv, _, _ = block(
+                    carry, layer_params,
+                    _constrain_heads(ck, 2), _constrain_heads(cv, 2),
+                )
                 return y, (ck, cv)
 
             x, (new_k, new_v) = lax.scan(

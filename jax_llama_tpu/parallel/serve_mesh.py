@@ -300,6 +300,46 @@ def _constrain(x, spec: P):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def constrain_view(view):
+    """Pin a gathered per-row cache view (``_gather_cache`` output:
+    k/v ``[L, B, W, KVH, hd]``, scales ``[L, B, W, KVH]``, pos
+    ``[B, W]``) to KV-heads-over-``tensor`` — the same head slice the
+    pool itself shards — with rows over the batch axes when they
+    divide.  Without this pin GSPMD is free to satisfy the gather by
+    REPLICATING the source pool first: a full-pool all-gather inside
+    every scan iteration (the silent reshard the comms-budget pass
+    exists to catch), instead of the shard-local block gather the
+    placement implies.  No-op when no serving mesh is active or the
+    head axis does not divide."""
+    mesh = current_mesh()
+    if not is_serving_mesh(mesh):
+        return view
+    tp = mesh.shape.get("tensor", 1)
+    kvh = int(view.k.shape[3])
+    if tp == 1 or kvh % tp:
+        return view
+    rows = (
+        ROW_AXES if int(view.k.shape[1]) % row_shards(mesh) == 0
+        else None
+    )
+    spec_kv = P(None, rows, None, "tensor", None)
+    spec_scale = P(None, rows, None, "tensor")
+    return dataclasses.replace(
+        view,
+        k=_constrain(view.k, spec_kv),
+        v=_constrain(view.v, spec_kv),
+        pos=_constrain(view.pos, P(rows, None)),
+        k_scale=(
+            None if view.k_scale is None
+            else _constrain(view.k_scale, spec_scale)
+        ),
+        v_scale=(
+            None if view.v_scale is None
+            else _constrain(view.v_scale, spec_scale)
+        ),
+    )
+
+
 def constrain_pool(pool):
     """Pin a program's output pool to the canonical pool specs — called
     inside the jitted programs under ``use_mesh``, so the donated input

@@ -288,6 +288,7 @@ def _gather_cache(
     table: jnp.ndarray,     # [B, MB] int32 physical block ids (NB = invalid)
     n_alloc: jnp.ndarray,   # [B] int32 allocated blocks per row
     fill: jnp.ndarray,      # [B] int32 per-row write offset (tokens)
+    placed: bool = False,   # pin the view's KVH axis (serving mesh)
 ) -> KVCache:
     """Materialize the per-row virtually-contiguous cache view.
 
@@ -315,7 +316,17 @@ def _gather_cache(
     ks = vs = None
     if pool.quantized:
         ks, vs = g(pool.k_scale), g(pool.v_scale)
-    return KVCache(k=kg, v=vg, pos=posg, index=fill, k_scale=ks, v_scale=vs)
+    view = KVCache(
+        k=kg, v=vg, pos=posg, index=fill, k_scale=ks, v_scale=vs
+    )
+    if placed:
+        # Pin the gathered view to the pool's own KV-head sharding:
+        # left unconstrained, GSPMD may satisfy the block gather by
+        # REPLICATING the pool first — a full-pool all-gather inside
+        # every scan iteration, which the comms-budget contracts
+        # (analysis/comms.py) treat as a hard finding.
+        view = smesh.constrain_view(view)
+    return view
 
 
 def _scatter_back(
@@ -487,7 +498,7 @@ def _kernel_eligible(block_size, mesh, kv_heads, n_rows, draft_config=None):
 def _decode_step_core(
     params, pool, table, n_alloc, fill, tau, pos, active, keys,
     temperature, top_p, top_k, *, config, all_greedy, use_kernel,
-    with_logprobs,
+    with_logprobs, placed=False,
 ):
     """One [n_slots, 1] decode iteration over the paged pool — the shared
     body of the single-step program (``_paged_decode_step``) and each
@@ -512,7 +523,7 @@ def _decode_step_core(
             k_scale=pcache.k_scale, v_scale=pcache.v_scale,
         )
     else:
-        view = _gather_cache(pool, table, n_alloc, fill)
+        view = _gather_cache(pool, table, n_alloc, fill, placed=placed)
         logits, view = forward(
             params, tau[:, None], positions, config, cache=view,
             attn_mask=active[:, None],
@@ -581,7 +592,7 @@ def _paged_decode_step(
             params, pool, table, n_alloc, fill, tau, pos, active, keys,
             temperature, top_p, top_k, config=config,
             all_greedy=all_greedy, use_kernel=use_kernel,
-            with_logprobs=with_logprobs,
+            with_logprobs=with_logprobs, placed=placed,
         )
         if placed:
             keys, = smesh.constrain_rows(keys)
@@ -694,7 +705,7 @@ def _chunk_scan(
             params, pool, table, n_alloc, fill, tau, pos, active,
             keys, temperature, top_p, top_k, config=config,
             all_greedy=all_greedy, use_kernel=use_kernel,
-            with_logprobs=with_logprobs,
+            with_logprobs=with_logprobs, placed=placed,
         )
         tau = jnp.where(active, nxt, tau)
         if with_logprobs:
@@ -811,7 +822,9 @@ def _fused_chunk(
         table_r = lax.dynamic_slice_in_dim(table, pf_row, 1, axis=0)
         n_alloc_r = lax.dynamic_slice_in_dim(n_alloc, pf_row, 1, axis=0)
         write_at = (pf_base + pf_off).astype(jnp.int32)
-        view = _gather_cache(pool, table_r, n_alloc_r, write_at[None])
+        view = _gather_cache(
+            pool, table_r, n_alloc_r, write_at[None], placed=placed
+        )
         # Scalar index (ONE prefilling row): keeps the view off the
         # per-row-index must-xla path, so "auto" runs flash over the
         # chunk; the host-side _pf_chunk clamp guarantees
@@ -1053,7 +1066,9 @@ def _paged_suffix_insert(
     """
     with use_mesh(mesh):
         B1, T = suffix_tokens.shape
-        view = _gather_cache(pool, table_row, n_alloc_row, fill0)
+        view = _gather_cache(
+            pool, table_row, n_alloc_row, fill0, placed=placed
+        )
         slen = jnp.sum(suffix_mask.astype(jnp.int32), axis=1)  # [k]
         positions = jnp.where(
             suffix_mask,
@@ -1125,7 +1140,7 @@ def _spec_round_core(
     t_params, d_params, t_pool, d_pool, table, n_alloc, fill, tau, pos,
     active, keys, temperature, top_p, top_k, *,
     t_config, d_config, n_draft, all_greedy, use_kernel, mesh=None,
-    with_logprobs=False,
+    with_logprobs=False, placed=False,
 ):
     """One speculative round for every active slot — greedy or sampled
     verification, per-row policies.  The shared row-wise draft/verify
@@ -1185,8 +1200,12 @@ def _spec_round_core(
             )
 
         if not use_kernel:
-            t_view = _gather_cache(t_pool, table, n_alloc, fill)
-            d_view = _gather_cache(d_pool, table, n_alloc, fill)
+            t_view = _gather_cache(
+                t_pool, table, n_alloc, fill, placed=placed
+            )
+            d_view = _gather_cache(
+                d_pool, table, n_alloc, fill, placed=placed
+            )
 
         # --- 1. draft chain: propose d_1 .. d_G by REPLAYING the block ---
         # Every chain step re-processes the growing block
@@ -1385,7 +1404,7 @@ def _spec_round(
         pos, active, keys, temperature, top_p, top_k,
         t_config=t_config, d_config=d_config, n_draft=n_draft,
         all_greedy=all_greedy, use_kernel=use_kernel, mesh=mesh,
-        with_logprobs=with_logprobs,
+        with_logprobs=with_logprobs, placed=placed,
     )
     with use_mesh(mesh):
         if placed:
@@ -1473,7 +1492,7 @@ def _spec_rounds_chunk(
                 fill, tau, pos, active, keys, temperature, top_p,
                 top_k, t_config=t_config, d_config=d_config,
                 n_draft=G, all_greedy=all_greedy, use_kernel=use_kernel,
-                mesh=mesh, with_logprobs=with_logprobs,
+                mesh=mesh, with_logprobs=with_logprobs, placed=placed,
             )
             # --- the host's accepted-prefix emit scan, on device ---
             verify_nan = active & (acc < 0)
@@ -4383,10 +4402,24 @@ class ContinuousBatcher:
                 continue
             k = len(batch)
             kb, keys, temps, top_ps, top_ks = self._row_bucket(batch)
-            P = max(
-                _round_up(len(r.tokens), self.block_size) for r in batch
+            # Group width: the max block-padded prompt length, its
+            # BLOCK COUNT pow2-bucketed (clamped to the reservation
+            # cap, which admissibility guarantees covers every row) —
+            # the same jit-cache-key discipline the suffix path
+            # (_suffix_pad) and admission row counts already follow.
+            # Un-bucketed, diverse prompt lengths compiled one
+            # _paged_insert executable per distinct block count
+            # (O(max_len / block_size) cache keys — the over-wide
+            # trace-key domain analysis/retrace.py flags); the extra
+            # padding is masked compute and sentinel block ids drop.
+            nb = min(
+                pow2_bucket(max(
+                    _round_up(len(r.tokens), self.block_size)
+                    for r in batch
+                ) // self.block_size),
+                self.blocks_per_slot,
             )
-            nb = P // self.block_size
+            P = nb * self.block_size
             pt = np.zeros((kb, P), np.int32)
             pm = np.zeros((kb, P), bool)
             bid = np.full((kb, nb), self.n_blocks, np.int32)

@@ -617,9 +617,14 @@ class TestCLI:
             "    def f(self, staged):\n"
             "        jax.block_until_ready(staged)\n"
         )
-        assert cli_main(["--json", str(bad)]) == 1
+        # --json uses the per-pass stable exit codes (host-boundary =
+        # 10); findings objects carry the machine-readable fields.
+        assert cli_main(["--json", str(bad)]) == 10
         payload = _json.loads(capsys.readouterr().out)
         assert payload and payload[0]["rule"] == "host-fetch"
+        assert payload[0]["checker"] == "host-boundary"
+        assert payload[0]["severity"] == "error"
+        assert payload[0]["sanctionable"] in (True, False)
 
     @pytest.mark.slow
     def test_cli_contracts_hook_donation_and_pool_copy(
@@ -669,3 +674,594 @@ def test_package_clean_static_gate():
     tier-1 here before any bench round notices."""
     findings = run_all(trace=False)
     assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Retrace auditor (analysis/retrace.py)
+# ---------------------------------------------------------------------------
+
+_RETRACE_FIXTURE = '''
+import functools, jax
+import numpy as np
+import jax.numpy as jnp
+from jax_llama_tpu.engine import pow2_bucket
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def _prog(x, *, width):
+    return x[:width]
+
+class Batcher:
+    def __init__(self):
+        self.cap = 8
+    def good(self, req):
+        w = pow2_bucket(len(req))
+        buf = np.zeros((w,), np.int32)
+        return _prog(jnp.asarray(buf), width=min(len(req), self.cap))
+    def bad_static(self, req):
+        buf = np.zeros((self.cap,), np.int32)
+        return _prog(jnp.asarray(buf), width=len(req))
+    def bad_shape(self, req):
+        buf = np.zeros((len(req),), np.int32)
+        return _prog(jnp.asarray(buf), width=self.cap)
+    def sanctioned(self, req):
+        buf = np.zeros((len(req),), np.int32)  # audit: trace-domain(fixture: caller guarantees <= 4 lengths)
+        # audit: trace-domain(fixture: caller-bounded)
+        return _prog(jnp.asarray(buf), width=len(req))
+'''
+
+
+class TestRetraceStatic:
+    def _registry(self, max_cache_keys=4):
+        return {"_prog": ProgramContract(
+            name="_prog", module="retrace_fixture", donated=(),
+            max_live_outputs=1, max_fetch_bytes_per_row=1 << 20,
+            max_cache_keys=max_cache_keys,
+        )}
+
+    def _check(self):
+        from jax_llama_tpu.analysis.retrace import check_module_source
+
+        return check_module_source(
+            "retrace_fixture.py", _RETRACE_FIXTURE,
+            registry=self._registry(),
+        )
+
+    def test_unbounded_static_arg_caught(self):
+        fs = self._check()
+        assert any(
+            f.rule == "unbounded-trace-domain" and "bad_static" in
+            f.message and "static arg" in f.message for f in fs
+        ), [f.render() for f in fs]
+
+    def test_unbounded_array_dim_caught(self):
+        fs = self._check()
+        assert any(
+            f.rule == "unbounded-trace-domain" and "bad_shape" in
+            f.message for f in fs
+        ), [f.render() for f in fs]
+
+    def test_bounded_and_sanctioned_paths_clean(self):
+        fs = self._check()
+        assert not any(
+            "good" in f.message or "sanctioned" in f.message
+            for f in fs
+        ), [f.render() for f in fs]
+        # the findings are pragma-sanctionable and say so
+        assert all(f.sanctionable for f in fs)
+
+    def test_missing_cache_key_budget_is_finding(self):
+        from jax_llama_tpu.analysis.retrace import check_static
+
+        fs = check_static(registry=self._registry(max_cache_keys=None))
+        assert any(f.rule == "no-cache-key-budget" for f in fs)
+
+    def test_every_contract_declares_cache_key_budget(self):
+        assert all(
+            c.max_cache_keys is not None for c in REGISTRY.values()
+        ), "registered programs must bound their jit-cache domains"
+
+    def test_package_retrace_static_clean(self):
+        from jax_llama_tpu.analysis.retrace import check_static
+
+        fs = check_static()
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+
+@pytest.mark.slow
+def test_retrace_runtime_drill_within_contract():
+    """The jit-cache drill: a real admission sweep must stay within
+    every contract's max_cache_keys (the runtime half of the retrace
+    contract; ~60 s of tiny-model compiles)."""
+    from jax_llama_tpu.analysis.retrace import check_runtime
+
+    fs = check_runtime()
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+@pytest.mark.slow
+def test_classic_insert_width_is_bucketed():
+    """Regression pin for the over-wide _paged_insert trace-key domain
+    the retrace pass surfaced: whole-prompt admissions in DIFFERENT
+    raw block counts but the same pow2 bucket must share ONE compiled
+    executable (pre-fix: P was only block-rounded, one cache entry per
+    distinct prompt block count)."""
+    import numpy as np
+
+    from jax_llama_tpu import serving
+    from jax_llama_tpu.analysis.contracts import (
+        _MAXLEN, _VOCAB, _tiny_config_params,
+    )
+    from jax_llama_tpu.serving import ContinuousBatcher
+
+    cfg, params = _tiny_config_params()
+    cb = ContinuousBatcher(
+        params, cfg, n_slots=2, max_len=_MAXLEN, block_size=8,
+        prefix_cache=False,
+    )
+    rng = np.random.RandomState(3)
+    before = serving.jit_cache_entries()["_paged_insert"]
+    if before < 0:
+        pytest.skip("jax hides the executable cache")
+    # 20 tokens = 3 blocks and 28 tokens = 4 blocks, both bucket to 4
+    for n in (20, 28):
+        cb.submit(list(rng.randint(1, _VOCAB, n)), max_new_tokens=2)
+        cb.run_to_completion()
+    after = serving.jit_cache_entries()["_paged_insert"]
+    assert after - before == 1, (
+        f"two same-bucket admissions compiled {after - before} "
+        "_paged_insert variants (want 1: the pow2 group width)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Schedule explorer (analysis/schedules.py)
+# ---------------------------------------------------------------------------
+
+class TestSchedules:
+    def _toctou_model(self, safe):
+        from jax_llama_tpu.analysis.schedules import Op, ScheduleModel
+
+        def make():
+            class PF:
+                remaining = 7
+
+            class S:
+                pass
+
+            s = S()
+            s.pf = PF()
+            return s
+
+        def racy_reader(s):
+            if s.pf is not None:
+                return s.pf.remaining
+            return 0
+
+        def safe_reader(s):
+            pf = s.pf
+            if pf is not None:
+                return pf.remaining
+            return 0
+
+        return ScheduleModel(
+            name="fixture-toctou", module="x", func="reader",
+            claim="snapshot", make=make,
+            writers={"loop": (
+                Op("null", lambda s, c: setattr(s, "pf", None),
+                   frozenset({"pf"})),
+            )},
+            reader=safe_reader if safe else racy_reader,
+            trace_fn="safe_reader" if safe else "racy_reader",
+        )
+
+    def test_toctou_reader_fails_with_counterexample(self):
+        from jax_llama_tpu.analysis.schedules import explore
+
+        fails = explore(self._toctou_model(safe=False))
+        assert fails and "AttributeError" in fails[0], fails
+
+    def test_snapshot_safe_reader_passes(self):
+        from jax_llama_tpu.analysis.schedules import explore
+
+        assert explore(self._toctou_model(safe=True)) == []
+
+    def test_single_writer_violation_is_structural(self):
+        from jax_llama_tpu.analysis.schedules import (
+            Op, ScheduleModel, explore,
+        )
+
+        m = ScheduleModel(
+            name="two-writers", module="x", func="f",
+            claim="single-writer",
+            make=lambda: type("S", (), {"n": 0})(),
+            writers={
+                "a": (Op("wa", lambda s, c: setattr(s, "n", 1),
+                         frozenset({"n"})),),
+                "b": (Op("wb", lambda s, c: setattr(s, "n", 2),
+                         frozenset({"n"})),),
+            },
+        )
+        fails = explore(m)
+        assert fails and "single-writer claim is structurally void" in \
+            fails[0]
+
+    def test_happens_before_edge_enforced(self):
+        from jax_llama_tpu.analysis.schedules import (
+            Op, ScheduleModel, explore,
+        )
+
+        def make():
+            s = type("S", (), {})()
+            s.x = None
+            return s
+
+        def read(s, c):
+            assert s.x is not None, "read before write"
+
+        write = Op("write", lambda s, c: setattr(s, "x", c),
+                   frozenset({"x"}))
+        base = dict(
+            name="hb", module="x", func="f", claim="happens-before",
+            make=make,
+            writers={"main": (write,), "loop": (Op("read", read),)},
+        )
+        # without the edge some interleaving reads first...
+        assert explore(ScheduleModel(**base)) != []
+        # ...the declared edge makes every schedule safe
+        assert explore(ScheduleModel(
+            **base, after={"loop": ("main", "write")}
+        )) == []
+
+    def test_unmodeled_pragma_is_finding(self):
+        from jax_llama_tpu.analysis.schedules import check_package
+
+        src = (
+            "class C:\n"
+            "    def f(self):\n"
+            "        # audit: racy-read(nobody modeled this)\n"
+            "        return self.x\n"
+        )
+        fs = check_package(models=[], sources=[("fixmod.py", src)])
+        assert [f.rule for f in fs] == ["unmodeled-pragma"]
+
+    def test_stale_model_is_finding(self):
+        from jax_llama_tpu.analysis.schedules import (
+            ScheduleModel, check_package,
+        )
+
+        ghost = ScheduleModel(
+            name="ghost", module="serving", func="no_such_method",
+            claim="owner-thread", make=lambda: object(), writers={},
+        )
+        fs = check_package(models=[ghost])
+        assert any(f.rule == "stale-model" for f in fs)
+
+    def test_every_pragma_site_has_a_passing_model(self):
+        """The tier-1 gate: every racy-read/unguarded pragma in the
+        package resolves to a schedule model and every model's
+        exploration passes (sub-second: the explorers preempt real
+        stats()/_health() readers line-by-line)."""
+        from jax_llama_tpu.analysis.schedules import check_package
+
+        fs = check_package()
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+    def test_pragma_sites_found(self):
+        from jax_llama_tpu.analysis.schedules import pragma_sites
+
+        keys = {(s.module, s.func) for s in pragma_sites()}
+        # the load-bearing cross-thread surfaces must be in the scan
+        assert ("serving", "stats") in keys
+        assert ("serving", "_window_acceptance") in keys
+        assert ("server", "_health") in keys
+        assert ("server", "_watchdog") in keys
+
+
+# ---------------------------------------------------------------------------
+# Metrics-registry lint (analysis/metricscheck.py)
+# ---------------------------------------------------------------------------
+
+class TestMetricsLint:
+    def test_package_metrics_clean(self):
+        from jax_llama_tpu.analysis.metricscheck import check_package
+
+        fs = check_package()
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+    def test_ghost_registration_caught(self):
+        from jax_llama_tpu import obs
+        from jax_llama_tpu.analysis.metricscheck import check_package
+
+        reg = dict(obs.METRICS)
+        reg["ghost_gauge_total"] = ("counter", "never emitted")
+        fs = check_package(registry=reg)
+        assert any(
+            f.rule == "unemitted-metric" and "ghost_gauge_total" in
+            f.message for f in fs
+        )
+
+    def test_unregistered_emission_caught(self):
+        from jax_llama_tpu.analysis.metricscheck import check_package
+
+        src = (
+            "class P:\n"
+            "    def stats(self):\n"
+            "        return {'rogue_scalar': 1}\n"
+        )
+        fs = check_package(
+            registry={"known": ("gauge", "k")},
+            sources=[("provider_mod.py", src)],
+            providers=(("provider_mod", "P", "stats"),),
+        )
+        assert any(
+            f.rule == "unregistered-metric" and "rogue_scalar" in
+            f.message for f in fs
+        )
+
+    def test_templated_family_matches_registration(self):
+        from jax_llama_tpu.analysis.metricscheck import check_package
+
+        src = (
+            "SITES = ('a',)\n"
+            "class P:\n"
+            "    def stats(self):\n"
+            "        out = {}\n"
+            "        for s in SITES:\n"
+            "            out[f'faults_injected_{s}_total'] = 1\n"
+            "        return out\n"
+        )
+        fs = check_package(
+            registry={"faults_injected_step_total": ("counter", "x")},
+            sources=[("provider_mod.py", src)],
+            providers=(("provider_mod", "P", "stats"),),
+        )
+        assert not any(f.rule == "unregistered-metric" for f in fs), \
+            [f.render() for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# Comms-budget contracts (analysis/comms.py)
+# ---------------------------------------------------------------------------
+
+def _mesh4():
+    import jax
+
+    from jax_llama_tpu.parallel.serve_mesh import (
+        ServeMeshSpec, build_serve_mesh,
+    )
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 forced host devices")
+    return build_serve_mesh(
+        ServeMeshSpec(data=2, tensor=2), devices=jax.devices()[:4]
+    )
+
+
+@pytest.mark.slow
+class TestComms:
+    """Sharded-lowering comms matrix: compiles tiny mesh programs."""
+
+    def _fixture_contract(self, body_kind, budget):
+        """A contract whose program runs ``body_kind`` inside a scan
+        body over a pool-shaped sharded operand."""
+        import sys as _sys
+        import types as _types
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from jax_llama_tpu.analysis.contracts import ProgramContract
+
+        mesh = _mesh4()
+        mod = _types.ModuleType("comms_fixture_mod")
+
+        @jax.jit
+        def _fx(pool, x):
+            def body(carry, _):
+                if body_kind == "pool-gather":
+                    full = jax.lax.with_sharding_constraint(
+                        pool, NamedSharding(mesh, P())
+                    )
+                    return carry + full.sum(), None
+                row = jax.lax.with_sharding_constraint(
+                    pool[0, :, 0, 0], NamedSharding(mesh, P())
+                )
+                return carry + row.sum(), None
+
+            out, _ = jax.lax.scan(body, x, None, length=2)
+            return out
+
+        mod._fx = _fx
+        _sys.modules["comms_fixture_mod"] = mod
+
+        def build():
+            pool = jax.device_put(
+                np.ones((2, 2, 8, 16, 16), np.float32),
+                NamedSharding(mesh, P(None, "tensor")),
+            )
+            return ("pool", "x"), (pool, jnp.zeros(())), {}
+
+        return ProgramContract(
+            name="_fx", module="comms_fixture_mod", donated=(),
+            max_live_outputs=1, max_fetch_bytes_per_row=1 << 20,
+            mesh_build=build, max_cache_keys=4, comms=budget,
+            forbidden_shapes=lambda args: [
+                tuple(args[0].shape), tuple(args[0].shape[1:]),
+            ],
+        )
+
+    def test_full_pool_all_gather_in_scan_body_is_hard_finding(self):
+        from jax_llama_tpu.analysis.comms import check_comms
+        from jax_llama_tpu.analysis.contracts import CommsBudget
+
+        # even a budget that ALLOWS big all-gathers cannot sanction a
+        # pool-shaped one
+        c = self._fixture_contract("pool-gather", CommsBudget(
+            max_count={"all-gather": 99, "all-reduce": 99,
+                       "collective-permute": 99},
+            max_bytes=1 << 30,
+        ))
+        fs = check_comms(c)
+        assert any(f.rule == "pool-collective" for f in fs), \
+            [f.render() for f in fs]
+
+    def test_count_and_byte_budgets_enforced_and_sanctionable(self):
+        from jax_llama_tpu.analysis.comms import check_comms
+        from jax_llama_tpu.analysis.contracts import CommsBudget
+
+        # a small row gather: not pool-shaped, so the BUDGET decides
+        tight = self._fixture_contract("row-gather", CommsBudget(
+            max_count={}, max_bytes=1,
+        ))
+        fs = check_comms(tight)
+        assert any(f.rule == "comms-count" for f in fs)
+        loose = self._fixture_contract("row-gather", CommsBudget(
+            max_count={"all-gather": 8, "all-reduce": 8,
+                       "collective-permute": 8},
+            max_bytes=65536,
+        ))
+        assert not [
+            f for f in check_comms(loose)
+            if f.rule in ("comms-count", "comms-bytes",
+                          "pool-collective")
+        ]
+
+    def test_mesh_program_without_budget_is_finding(self):
+        from jax_llama_tpu.analysis.comms import check_comms
+
+        c = self._fixture_contract("row-gather", None)
+        fs = check_comms(c)
+        assert [f.rule for f in fs] == ["no-comms-budget"]
+
+    def test_package_comms_clean(self):
+        """The regression pin for the full-pool reshard this PR fixed:
+        the sharded _paged_decode_chunk / _fused_chunk lowerings hold
+        their comms budgets and contain NO pool-shaped collective
+        (pre-fix: 4 and 36 full-pool all-gathers per scan body)."""
+        from jax_llama_tpu.analysis.comms import check_package
+
+        fs = check_package()
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+    def test_every_mesh_contract_declares_budget(self):
+        for c in REGISTRY.values():
+            if c.mesh_build is not None:
+                assert c.comms is not None, c.name
+
+
+def test_constrain_view_pins_kv_heads():
+    """Fast pin for the gathered-view sharding fix: under a serving
+    mesh, constrain_view forces the view's KV-head axis onto the
+    ``tensor`` axis (the pin that stops GSPMD replicating the pool)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jax_llama_tpu.models.llama import KVCache
+    from jax_llama_tpu.parallel import mesh as pmesh
+    from jax_llama_tpu.parallel import serve_mesh as smesh
+
+    mesh = _mesh4()
+
+    @jax.jit
+    def f(k, v, pos):
+        view = KVCache(
+            k=k, v=v, pos=pos, index=jnp.zeros((2,), jnp.int32)
+        )
+        with pmesh.use_mesh(mesh):
+            return smesh.constrain_view(view).k
+
+    k = jnp.zeros((2, 2, 32, 2, 16), jnp.float32)
+    out = f(k, k, jnp.zeros((2, 32), jnp.int32))
+    spec = out.sharding.spec
+    assert tuple(spec)[3] == "tensor", spec
+
+
+# ---------------------------------------------------------------------------
+# Review-hardening pins for the new passes themselves
+# ---------------------------------------------------------------------------
+
+class TestPassRobustness:
+    def test_unsatisfiable_happens_before_edge_is_not_vacuous(self):
+        from jax_llama_tpu.analysis.schedules import (
+            Op, ScheduleModel, explore,
+        )
+
+        m = ScheduleModel(
+            name="vac", module="x", func="f", claim="happens-before",
+            make=lambda: object(),
+            writers={"main": (Op("w", lambda s, c: None),),
+                     "loop": (Op("r", lambda s, c: None),)},
+            after={"loop": ("main", "TYPO_no_such_op")},
+        )
+        fails = explore(m)
+        assert fails and "no complete schedule" in fails[0]
+
+    def test_shape_of_parameter_is_not_bounded(self):
+        from jax_llama_tpu.analysis.retrace import check_module_source
+
+        src = (
+            "import functools, jax\n"
+            "import jax.numpy as jnp\n"
+            '@functools.partial(jax.jit, static_argnames=("width",))\n'
+            "def _prog(x, *, width):\n"
+            "    return x[:width]\n"
+            "class B:\n"
+            "    def f(self, toks):\n"
+            "        return _prog(jnp.asarray(toks), "
+            "width=toks.shape[0])\n"
+        )
+        reg = {"_prog": ProgramContract(
+            name="_prog", module="fixture_mod", donated=(),
+            max_live_outputs=1, max_fetch_bytes_per_row=1 << 20,
+            max_cache_keys=4,
+        )}
+        fs = check_module_source("fixture_mod.py", src, registry=reg)
+        assert any("request-shaped" in f.message for f in fs), \
+            [f.render() for f in fs]
+
+    def test_tuple_result_collectives_parsed(self):
+        from jax_llama_tpu.analysis.comms import collectives_in_text
+
+        text = (
+            "%ag = (f32[2,2,8,16,16]{4,3,2,0,1}, s32[4]{0}) "
+            "all-gather(f32[2,1,8,16,16] %a, s32[2] %b), dims={1}\n"
+            "%ar = f32[1,64]{1,0} all-reduce(f32[1,64] %c)\n"
+            "%done = (f32[8]{0}) all-gather-done(%x)\n"
+        )
+        got = collectives_in_text(text)
+        kinds = [k for k, _ in got]
+        assert kinds == ["all-gather", "all-reduce"]  # -done skipped
+        shapes = [s for _, rs in got for s, _ in rs]
+        assert (2, 2, 8, 16, 16) in shapes and (4,) in shapes
+
+    def test_docstring_mention_is_not_emission_evidence(self):
+        from jax_llama_tpu.analysis.metricscheck import check_package
+
+        src = (
+            '"""Module docs mention ghost_gauge by name."""\n'
+            "class P:\n"
+            '    """Docs: ghost_gauge again."""\n'
+            "    def stats(self):\n"
+            "        return {}\n"
+        )
+        fs = check_package(
+            registry={"ghost_gauge": ("gauge", "x")},
+            sources=[("provider_mod.py", src)],
+            providers=(),
+        )
+        assert any(
+            f.rule == "unemitted-metric" and "ghost_gauge" in f.message
+            for f in fs
+        ), [f.render() for f in fs]
+
+    def test_cli_comms_no_trace_is_usage_error(self, capsys):
+        assert cli_main(["--checker", "comms", "--no-trace"]) == 2
+        assert "vacuous" in capsys.readouterr().err
+
+    def test_cli_retrace_with_contracts_is_usage_error(self, capsys):
+        assert cli_main(
+            ["--checker", "retrace", "--contracts", "anything"]
+        ) == 2
+        assert "cannot audit an external" in capsys.readouterr().err
