@@ -548,6 +548,29 @@ def multichip_serving_main(record_path=None) -> None:
         both_served = all(
             r["routed_total"] > 0 for r in h["replicas"]
         )
+        # -- fleet cache baseline (PR 13 telemetry): publish the SAME
+        # chain on both replicas (direct posts — deterministic), then
+        # scrape the router's fleet cache view.  The duplicate-chain
+        # bytes are the number that justifies the cache-aware
+        # disaggregation scheduler (ROADMAP item 2); the scrape cost
+        # bounds what a scheduler tick would pay.
+        shared = {"prompt": prompts[2], "max_new_tokens": 4, "seed": 3}
+        for s in servers:
+            post(s.address, shared)
+        t0 = time.time()
+        with urllib.request.urlopen(
+            router.address + "/debug/kv/fleet", timeout=60
+        ) as r:
+            fleet_doc = _json.loads(r.read())
+        fleet_scrape_ms = round((time.time() - t0) * 1000.0, 2)
+        fl = fleet_doc["fleet"]
+        fleet_ok = fl["duplicate_kv_bytes"] > 0 and (
+            sorted(fl["replicas_scraped"]) == [0, 1]
+        )
+        per_replica_hit = {
+            str(p["replica"]): p["hit_ratio"]
+            for p in fleet_doc["replicas"]
+        }
     finally:
         router.stop()
         for s in servers:
@@ -557,8 +580,15 @@ def multichip_serving_main(record_path=None) -> None:
         f"token-identical={routed_ok}, both replicas served="
         f"{both_served}, {routed_tps} tok/s wall (CPU behavior round)"
     )
+    tail.append(
+        f"dryrun_multichip_serving ok: fleet cache view duplicate-"
+        f"chain bytes={fl['duplicate_kv_bytes']} "
+        f"({fl['duplicate_chains']} chains on both replicas), fleet "
+        f"hit ratio={fl['prefix_hit_ratio']}, scrape="
+        f"{fleet_scrape_ms} ms"
+    )
 
-    ok = parity_ok and lowering_ok and routed_ok
+    ok = parity_ok and lowering_ok and routed_ok and fleet_ok
     result = {
         "n_devices": n_devices,
         "rc": 0 if ok else 1,
@@ -575,6 +605,18 @@ def multichip_serving_main(record_path=None) -> None:
             "routed_both_replicas_served": both_served,
             "routed_tokens_per_s_wall_cpu": routed_tps,
             "route_policy": "least-loaded",
+            # Fleet cache baseline (router /debug/kv/fleet): the next
+            # MULTICHIP round diffs these — duplicate-chain bytes are
+            # the disaggregation scheduler's headline input.
+            "fleet_kv": {
+                "duplicate_chains": fl["duplicate_chains"],
+                "fleet_duplicate_kv_blocks": fl["duplicate_kv_blocks"],
+                "fleet_duplicate_kv_bytes": fl["duplicate_kv_bytes"],
+                "fleet_prefix_hit_ratio": fl["prefix_hit_ratio"],
+                "per_replica_hit_ratio": per_replica_hit,
+                "digest_scrape_ms": fleet_scrape_ms,
+                "fleet_view_nonzero_duplicates": fleet_ok,
+            },
         },
     }
     print(_json.dumps(result))

@@ -129,14 +129,31 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
     # Replica router (router.py): HTTP handler threads (forward /
     # metrics / healthz) and the health-poller thread share the replica
     # table, sticky-session map, routing counters, the router-local
-    # trace ring, and the request-id routing record — every access
-    # goes under the one lock.  The router holds no jax state.
+    # trace ring, the request-id routing record, and the cached fleet
+    # cache view — every access goes under the one lock.  The router
+    # holds no jax state.
     LockGuard(
         module="router", cls="ReplicaRouter", lock="_lock",
         fields=frozenset({
             "_replicas", "_affinity", "routed_by_policy",
             "reroutes_total", "replica_failures_total",
             "kv_handoffs_total", "_trace", "_routes",
+            "affinity_stale_routes_total", "_fleet_kv",
+        }),
+    ),
+    # KV chain digest (kvcache.py): the serving loop mutates it at
+    # every prefix-store content mutation while HTTP handler threads
+    # read /debug/kv, /healthz kv.digest, and the stats() gauges — the
+    # ONE piece of KV-cache state that is legitimately cross-thread,
+    # so every field lives under its own leaf lock (taken nowhere else
+    # while another lock is held).
+    LockGuard(
+        module="kvcache", cls="KvDigest", lock="_lock",
+        fields=frozenset({
+            "_entries", "_seq", "_hash", "_hbm", "_host", "_idle",
+            "version", "loss_version", "depth_max",
+            "publishes_total", "evictions_total", "demotions_total",
+            "restores_total", "host_evictions_total",
         }),
     ),
 )
@@ -165,6 +182,7 @@ CONFINEMENTS: Tuple[ThreadConfinement, ...] = (
         # Methods documented/observed to run on HTTP-handler threads.
         foreign_methods=frozenset({
             "stats", "_window_acceptance", "acceptance_rate",
+            "kv_debug_json",
         }),
         holders=frozenset({"batcher"}),
     ),

@@ -411,6 +411,7 @@ def _make_batcher_stub():
         stats = ContinuousBatcher.stats
         _window_acceptance = ContinuousBatcher._window_acceptance
         acceptance_rate = ContinuousBatcher.acceptance_rate
+        kv_debug_json = ContinuousBatcher.kv_debug_json
 
     s = _StubBatcher()
     s.fault_injector = None
@@ -456,6 +457,14 @@ def _make_batcher_stub():
     s.decode_stall_ms_total = 0.0
     s.prefix_index = "radix"
     s.n_slots = 2
+    # KV chain-digest surface (PR 13): the REAL store's real digest
+    # (its own leaf lock), plus the ctor-stable geometry scalars
+    # stats()/kv_debug_json read.
+    s.kv_digest = s._store.digest
+    s.block_bytes = 4096
+    s.block_size = 16
+    s.kv_export_events_total = 0
+    s.kv_import_events_total = 0
     return s
 
 
@@ -542,6 +551,51 @@ def _model_window_acceptance() -> ScheduleModel:
                frozenset({"_accept_window"})),
         )},
         reader=lambda s: s._window_acceptance(),
+        check=check,
+    )
+
+
+def _model_kv_debug() -> ScheduleModel:
+    """``kv_debug_json``'s racy-read (the /debug/kv endpoint, handler
+    threads): the digest reads go through KvDigest's own leaf lock and
+    the two hit-token counters are single-writer point-in-time reads.
+    The writer ops drive the REAL RadixPrefixStore (publish / retain /
+    evict), so every digest mutation hook runs under preemption."""
+    def loop_publish(s, clock):
+        key = (b"chain-%d" % clock) * 2
+        s._store.publish([key], [clock % 8])
+        s.prefix_hit_tokens_total += 16
+        s.prompt_tokens_total += 32
+
+    def loop_retain_evict(s, clock):
+        blk = clock % 8
+        if s._store.is_keyed(blk):
+            s._store.retain([blk])
+        s._store.pop_evictable()
+
+    def check(state, result):
+        assert isinstance(result, dict), "kv_debug_json returned junk"
+        assert "summary" in result and "nodes" in result
+        for node in result["nodes"]:
+            assert {"key", "depth", "tier", "refcount", "seq"} <= set(
+                node
+            ), f"malformed digest node {node!r}"
+        assert result["summary"]["nodes"] >= 0
+
+    return ScheduleModel(
+        name="kv-debug-digest-snapshot",
+        module="serving", func="kv_debug_json", claim="snapshot",
+        make=_make_batcher_stub,
+        writers={"loop": (
+            Op("publish", loop_publish, frozenset({
+                "_store", "kv_digest", "prefix_hit_tokens_total",
+                "prompt_tokens_total",
+            })),
+            Op("retain_evict", loop_retain_evict, frozenset({
+                "_store", "kv_digest",
+            })),
+        )},
+        reader=lambda s: s.kv_debug_json(),
         check=check,
     )
 
@@ -773,6 +827,7 @@ def _model_loop_owner() -> ScheduleModel:
 MODELS: Tuple[Callable[[], ScheduleModel], ...] = (
     _model_stats,
     _model_window_acceptance,
+    _model_kv_debug,
     _model_health,
     _model_do_post_depth,
     _model_start_happens_before,

@@ -43,6 +43,16 @@ Three index modes (``run.py --prefix-index``): ``radix`` (the default
 chain map, kept as the behavioral oracle; no host tier), ``off`` (no
 prefix matching or retention — the old ``prefix_cache=False``).
 
+Every store also maintains a :class:`KvDigest` (r13 fleet cache
+telemetry): an incrementally-updated, lock-guarded, cross-thread-
+readable digest of the published chains — order-independent content
+hash, version / loss-version counters, residency aggregates, and a
+bounded per-node walk — the sensor the ``/debug/kv`` endpoint, the
+``/healthz`` ``kv.digest`` summary, and the router's fleet cache view
+(``/debug/kv/fleet``) read.  Digest maintenance is host bookkeeping at
+mutation points the store already owns: zero added device dispatches,
+zero added host syncs (``make perf-smoke`` pins it).
+
 This module owns only HOST-side bookkeeping plus the three
 device-boundary primitives (:func:`fetch_slab` demote D2H,
 :func:`stage_restore` async H2D staging, :func:`adopt_into_pool`
@@ -53,8 +63,10 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import hashlib
+import threading
 from collections import OrderedDict
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +75,212 @@ import numpy as np
 from .engine import pow2_bucket
 
 PREFIX_INDEX_MODES = ("radix", "exact", "off")
+
+
+# ---------------------------------------------------------------------------
+# Chain digest (replica radix digests — the fleet cache view's sensor)
+# ---------------------------------------------------------------------------
+
+def _entry_hash(key: bytes, tier: str) -> int:
+    """Order-independent per-entry hash: XOR-accumulating these over
+    the digest's (key, tier) set yields the same value for the same
+    published chains regardless of publish/evict interleaving — the
+    determinism the digest-correctness tests pin."""
+    h = hashlib.blake2b(key + tier.encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+class KvDigest:
+    """Incrementally-maintained, cross-thread-readable digest of one
+    prefix store's published chains.
+
+    The store (serving-loop thread) calls the ``on_*`` hooks at every
+    content mutation; HTTP handler threads read :meth:`summary` (O(1)
+    aggregates — the compact form piggybacked on ``/healthz``'s ``kv``
+    section for the router poller) and :meth:`nodes_json` (the bounded
+    tree walk behind ``GET /debug/kv``).  All state lives under one
+    leaf lock (``_lock``; registered in analysis/lockcheck.py), so the
+    readers need no racy-read pragmas and the writers pay two dict ops
+    per mutation — pure host bookkeeping, zero device work.
+
+    Versioning: ``version`` bumps on every content mutation (publish /
+    evict / demote / restore), so a consumer holding an older version
+    knows its copy is stale; ``loss_version`` bumps only on mutations
+    that can LOSE a chain's HBM residency (evict, demote, host-tier
+    drop) — the signal the router's affinity policy consults before
+    trusting a pinned session's cache locality.  Both reset when a
+    crash-recovery/quarantine rebuild replaces the store (a rebuild
+    empties the cache, so any change of version IS staleness —
+    consumers compare with ``!=``, not ``>``).
+
+    ``hash`` is an order-independent XOR set-hash over (chain key,
+    residency tier): equal for equal published content, cheap to
+    maintain under removals (XOR is its own inverse)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # key -> [depth, tier("hbm"|"host"), idle(bool), seq]
+        self._entries: Dict[bytes, List[Any]] = {}
+        self._seq = 0
+        self._hash = 0
+        self._hbm = 0
+        self._host = 0
+        self._idle = 0
+        self.version = 0
+        self.loss_version = 0
+        self.depth_max = 0  # high-water mark, not current max
+        self.publishes_total = 0
+        self.evictions_total = 0
+        self.demotions_total = 0
+        self.restores_total = 0
+        self.host_evictions_total = 0
+
+    # -- mutation hooks (store/serving-loop thread) -------------------------
+
+    def _set_tier_locked(self, ent: List[Any], key: bytes,
+                         tier: str) -> None:
+        if ent[1] != tier:
+            self._hash ^= _entry_hash(key, ent[1])
+            self._hash ^= _entry_hash(key, tier)
+            if tier == "hbm":
+                self._hbm += 1
+                self._host -= 1
+            else:
+                self._host += 1
+                self._hbm -= 1
+                if ent[2]:
+                    ent[2] = False
+                    self._idle -= 1
+            ent[1] = tier
+        self._seq += 1
+        ent[3] = self._seq
+
+    def on_publish(self, key: bytes, depth: int) -> None:
+        """A chain block became HBM-resident under ``key`` (fresh node
+        or a re-publish adopting a new copy over a demoted one)."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self._seq += 1
+                self._entries[key] = [int(depth), "hbm", False, self._seq]
+                self._hash ^= _entry_hash(key, "hbm")
+                self._hbm += 1
+                self.depth_max = max(self.depth_max, int(depth))
+            else:
+                self._set_tier_locked(ent, key, "hbm")
+            self.publishes_total += 1
+            self.version += 1
+
+    def on_remove(self, key: bytes) -> None:
+        """``key`` left the index entirely (eviction drop, non-finite
+        unpublish, host-tier victim's subtree)."""
+        with self._lock:
+            ent = self._entries.pop(key, None)
+            if ent is None:
+                return
+            self._hash ^= _entry_hash(key, ent[1])
+            if ent[1] == "hbm":
+                self._hbm -= 1
+                if ent[2]:
+                    self._idle -= 1
+            else:
+                self._host -= 1
+            self.evictions_total += 1
+            self.version += 1
+            self.loss_version += 1
+
+    def on_demote(self, key: bytes) -> None:
+        """HBM -> host-tier demotion (stays matchable, loses HBM)."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return
+            self._set_tier_locked(ent, key, "host")
+            self.demotions_total += 1
+            self.version += 1
+            self.loss_version += 1
+
+    def on_restore(self, key: bytes) -> None:
+        """Host-tier -> HBM swap-in landed."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return
+            self._set_tier_locked(ent, key, "hbm")
+            self.restores_total += 1
+            self.version += 1
+
+    def on_host_evict(self, key: bytes) -> None:
+        """The host tier's LRU dropped ``key``'s slab (the node itself
+        leaves via :meth:`on_remove` when that strands its subtree)."""
+        with self._lock:
+            self.host_evictions_total += 1
+            self.version += 1
+            self.loss_version += 1
+
+    def on_idle(self, key: bytes, idle: bool) -> None:
+        """Refcount-boundary flip: idle (refcount 0, evictable) vs
+        claimed.  Recency (``seq``) updates; versions do not — claims
+        happen every admission and would drown real staleness."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None or ent[2] == idle:
+                return
+            ent[2] = idle
+            self._idle += 1 if idle else -1
+            self._seq += 1
+            ent[3] = self._seq
+
+    # -- readers (any thread) -----------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        """O(1) aggregate snapshot — the bounded payload piggybacked on
+        ``/healthz``'s ``kv.digest`` section (the router poller scrapes
+        it for free; no new poll endpoint)."""
+        with self._lock:
+            return {
+                "version": self.version,
+                "loss_version": self.loss_version,
+                "hash": format(self._hash, "016x"),
+                "nodes": len(self._entries),
+                "hbm_blocks": self._hbm,
+                "host_blocks": self._host,
+                "idle_blocks": self._idle,
+                "depth_max": self.depth_max,
+                "publishes_total": self.publishes_total,
+                "evictions_total": self.evictions_total,
+                "demotions_total": self.demotions_total,
+                "restores_total": self.restores_total,
+                "host_evictions_total": self.host_evictions_total,
+            }
+
+    def nodes_json(self, depth: Optional[int] = None,
+                   max_nodes: int = 2048) -> Dict[str, Any]:
+        """The full (bounded) tree walk behind ``GET /debug/kv``:
+        per-node chain-prefix hash, depth, residency tier, refcount>0
+        flag, and recency seq — depth-capped by ``depth`` and
+        truncated (shallowest-first, deterministic order) past
+        ``max_nodes``, so the payload stays bounded at max radix
+        occupancy."""
+        with self._lock:
+            items = [
+                (d, key.hex(), tier, idle, seq)
+                for key, (d, tier, idle, seq) in self._entries.items()
+                if depth is None or d <= depth
+            ]
+            version = self.version
+        items.sort()
+        truncated = max(0, len(items) - max_nodes)
+        return {
+            "version": version,
+            "nodes": [
+                {"key": k, "depth": d, "tier": tier,
+                 "refcount": not idle, "seq": seq}
+                for d, k, tier, idle, seq in items[:max_nodes]
+            ],
+            "truncated": truncated,
+            "depth_cap": depth,
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -206,6 +424,10 @@ class RadixPrefixStore:
         # refcount-0 HBM-resident keyed nodes; front = evict first.
         self._idle: "OrderedDict[bytes, RadixNode]" = OrderedDict()
         self.tier = HostTier(host_blocks) if host_blocks > 0 else None
+        # Cross-thread-readable chain digest (fleet cache telemetry):
+        # updated at every content mutation below, read by /debug/kv
+        # and the /healthz kv section from handler threads.
+        self.digest = KvDigest()
         # Optional observability sink (obs.Observability.annotate):
         # tier transitions — demotions, host-LRU drops, completed
         # restores — land as instant events in the serving trace, so a
@@ -255,6 +477,7 @@ class RadixPrefixStore:
                 self._by_key[key] = node
                 node.block = blk
                 self._by_block[blk] = node
+                self.digest.on_publish(key, node.depth)
             elif node.block is None and not node.restoring:
                 node.block = blk
                 self._by_block[blk] = node
@@ -262,6 +485,7 @@ class RadixPrefixStore:
                     node.host = None
                     if self.tier is not None:
                         self.tier.drop(key)
+                self.digest.on_publish(key, node.depth)
             parent = node
         return []
 
@@ -284,6 +508,7 @@ class RadixPrefixStore:
             n = stack.pop()
             stack.extend(n.children.values())
             self._by_key.pop(n.key, None)
+            self.digest.on_remove(n.key)
             if n.block is not None:
                 if self._by_block.get(n.block) is n:
                     del self._by_block[n.block]
@@ -311,12 +536,14 @@ class RadixPrefixStore:
             node = self._by_block.get(blk)
             if node is not None and node.block == blk:
                 self._idle[node.key] = node
+                self.digest.on_idle(node.key, True)
 
     def on_claim(self, blocks: Sequence[int]) -> None:
         for blk in blocks:
             node = self._by_block.get(blk)
             if node is not None:
                 self._idle.pop(node.key, None)
+                self.digest.on_idle(node.key, False)
 
     # -- eviction / demotion -----------------------------------------------
 
@@ -349,6 +576,7 @@ class RadixPrefixStore:
             del self._by_block[blk]
             node.block = None
             node.host = slab
+            self.digest.on_demote(key)
             self._event("kv_demote", block=blk, depth=node.depth)
             extra: List[int] = []
             for ekey in self.tier.put(key, slab):
@@ -359,6 +587,7 @@ class RadixPrefixStore:
                 if enode is None:
                     continue
                 enode.host = None
+                self.digest.on_host_evict(ekey)
                 self._event("kv_host_evict", depth=enode.depth)
                 if enode.block is None:
                     extra.extend(self._drop_subtree(enode))
@@ -373,6 +602,7 @@ class RadixPrefixStore:
         if chosen is None:
             chosen = next(iter(self._idle.values()))
         blk = chosen.block
+        self._event("kv_evict", block=blk, depth=chosen.depth)
         extra = self._drop_subtree(chosen)
         extra.remove(blk)
         return blk, extra
@@ -405,6 +635,7 @@ class RadixPrefixStore:
             n.restoring = False
             if self.tier is not None:
                 self.tier.drop(n.key)
+            self.digest.on_restore(n.key)
         if nodes:
             self._event("kv_restore_complete", blocks=len(nodes))
 
@@ -438,6 +669,9 @@ class ExactPrefixStore:
         self._prefix_index: Dict[bytes, int] = {}
         self._block_chain: Dict[int, bytes] = {}
         self._reusable: "OrderedDict[int, None]" = OrderedDict()
+        # Flat-map digest: depth = chain index + 1 (no tree, but the
+        # same versioned surface every store exposes).
+        self.digest = KvDigest()
 
     def match(self, keys: Sequence[bytes]) -> MatchResult:
         hits: List[int] = []
@@ -451,21 +685,28 @@ class ExactPrefixStore:
     def publish(self, keys: Sequence[bytes],
                 blocks: Sequence[int]) -> List[int]:
         superseded: List[int] = []
-        for blk, key in zip(blocks, keys):
+        for depth, (blk, key) in enumerate(zip(blocks, keys)):
             old = self._prefix_index.get(key)
             if old is not None and old != blk:
                 self._block_chain.pop(old, None)
                 if old in self._reusable:
                     del self._reusable[old]
                     superseded.append(old)
+                # The key now binds the freshly published (claimed)
+                # block: clear any idle flag inherited from the
+                # superseded one, or /debug/kv would report a live
+                # session's block as evictable for its whole life.
+                self.digest.on_idle(key, False)
             self._block_chain[blk] = key
             self._prefix_index[key] = blk
+            self.digest.on_publish(key, depth + 1)
         return superseded
 
     def unpublish(self, blk: int) -> List[int]:
         key = self._block_chain.pop(blk, None)
         if key is not None and self._prefix_index.get(key) == blk:
             del self._prefix_index[key]
+            self.digest.on_remove(key)
         return []
 
     def is_keyed(self, blk: int) -> bool:
@@ -474,10 +715,16 @@ class ExactPrefixStore:
     def retain(self, blocks: Sequence[int]) -> None:
         for blk in reversed(list(blocks)):
             self._reusable[blk] = None
+            key = self._block_chain.get(blk)
+            if key is not None:
+                self.digest.on_idle(key, True)
 
     def on_claim(self, blocks: Sequence[int]) -> None:
         for blk in blocks:
             self._reusable.pop(blk, None)
+            key = self._block_chain.get(blk)
+            if key is not None:
+                self.digest.on_idle(key, False)
 
     def evictable(self) -> int:
         return len(self._reusable)
@@ -509,6 +756,9 @@ class NullPrefixStore:
 
     kind = "off"
     enabled = False
+
+    def __init__(self):
+        self.digest = KvDigest()  # permanently empty, version 0
 
     def match(self, keys) -> MatchResult:
         return MatchResult(blocks=[], path=[], restore=[])
@@ -577,6 +827,17 @@ _POOL_FIELDS = ("k", "v", "pos", "k_scale", "v_scale")
 
 def _pool_names(pool) -> Tuple[str, ...]:
     return _POOL_FIELDS if pool.k_scale is not None else _POOL_FIELDS[:3]
+
+
+def pool_block_bytes(pool) -> int:
+    """Bytes of pool memory ONE block occupies (k + v + pos + scales on
+    int8 pools) — the unit the router's duplicate-chain accounting
+    multiplies node counts by.  Every pool array carries exactly one
+    n_blocks axis, so total bytes / n_blocks is exact.  Host-side
+    metadata arithmetic only (``nbytes`` never touches buffers)."""
+    n_blocks = pool.pos.shape[0]
+    total = sum(getattr(pool, name).nbytes for name in _pool_names(pool))
+    return int(total // max(1, n_blocks))
 
 
 def fetch_slab(pool, blk: int, prefix: str = "") -> Dict[str, np.ndarray]:

@@ -206,12 +206,32 @@ HISTOGRAMS = {
         "Wall time per jitted serving dispatch incl. its packed fetch "
         "(ms; one K-iteration or R-round chunk each; LABELED by "
         "dispatch kind)"),
+    "prefix_hit_depth_tokens": (
+        "Prefix-cache hit depth per admission (TOKENS served from "
+        "cached blocks; the 0-hit mass lands in the first bucket — "
+        "a cold fleet reads as all-first-bucket)"),
+    "session_kv_blocks": (
+        "KV pool blocks a session held at slot free (BLOCKS, not ms; "
+        "the per-session cache footprint distribution)"),
 }
 
 # Families rendered as one labeled series per dispatch kind rather
 # than a single lumped series (Observability keeps one Histogram per
 # kind, created lazily on first dispatch of that kind).
 LABELED_HISTOGRAMS = frozenset({"dispatch_ms"})
+
+# Non-latency families override the ms bucket ladder with their own
+# unit's (tokens / blocks, pow2 — the same bucketing the admission
+# paths use for jit-cache keys, so histogram edges line up with the
+# actual quantization of the measured values).
+HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
+    "prefix_hit_depth_tokens": tuple(
+        float(1 << i) for i in range(15)  # 1 .. 16384 tokens
+    ),
+    "session_kv_blocks": tuple(
+        float(1 << i) for i in range(11)  # 1 .. 1024 blocks
+    ),
+}
 
 
 # ---------------------------------------------------------------------------
@@ -268,6 +288,32 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "counter", "Cumulative swap-in wall time (ms)"),
     "swap_failures_total": _reg(
         "counter", "Swap-ins failed cleanly (request-scoped)"),
+    # -- KV chain digest (kvcache.KvDigest — fleet cache telemetry) ---------
+    "kv_digest_version": _reg(
+        "gauge", "Chain-digest content version (bumps on publish/evict/"
+                 "demote/restore; resets with the store on rebuild — "
+                 "compare with !=, any change means the consumer's "
+                 "copy is stale)"),
+    "kv_digest_loss_version": _reg(
+        "gauge", "Chain-digest loss version (bumps only when a chain "
+                 "can LOSE HBM residency: evict/demote/host-drop — "
+                 "the affinity-freshness signal the router consults)"),
+    "kv_publish_events_total": _reg(
+        "counter", "Chain blocks published into the prefix index"),
+    "kv_evict_events_total": _reg(
+        "counter", "Chain blocks evicted out of the prefix index"),
+    "kv_demote_events_total": _reg(
+        "counter", "Chain blocks demoted HBM -> host tier (digest "
+                   "view of the swap-out ledger)"),
+    "kv_restore_events_total": _reg(
+        "counter", "Chain blocks restored host tier -> HBM (digest "
+                   "view of the swap-in ledger)"),
+    "kv_host_evict_events_total": _reg(
+        "counter", "Host-tier slabs lost to the tier's own LRU"),
+    "kv_block_bytes": _reg(
+        "gauge", "Pool bytes one KV block occupies (k+v+pos+scales, "
+                 "draft twins included) — the duplicate-chain "
+                 "accounting unit"),
     # -- scale-out serving (serve_mesh.py / router.py) ----------------------
     "kv_export_blocks_total": _reg(
         "counter", "Prefix blocks exported to peer replicas "
@@ -275,6 +321,10 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "kv_import_blocks_total": _reg(
         "counter", "Prefix blocks landed from peer replicas "
                    "(disaggregation handoff, decode side)"),
+    "kv_export_events_total": _reg(
+        "counter", "Prefix handoff exports that moved >= 1 block"),
+    "kv_import_events_total": _reg(
+        "counter", "Prefix handoff imports that landed >= 1 block"),
     "serve_mesh_data": _reg(
         "gauge", "Serving-mesh row shards (data*fsdp axes; 1 off-mesh)"),
     "serve_mesh_tensor": _reg(
@@ -597,7 +647,7 @@ class _Span:
 class _Timeline:
     __slots__ = (
         "request_id", "rids", "prompt_tokens", "created", "spans",
-        "outcome", "error", "route",
+        "outcome", "error", "route", "kv",
     )
 
     def __init__(self, request_id: str, rid: int, prompt_tokens: int,
@@ -613,6 +663,9 @@ class _Timeline:
         # which replica/policy served this request — shown by
         # /debug/requests/<id> next to the spans it annotates.
         self.route: Optional[str] = None
+        # Per-session KV accounting (request_kv): blocks held, prefix
+        # hit depth in tokens, swap bytes moved, evictions suffered.
+        self.kv: Dict[str, Any] = {}
 
 
 class Observability:
@@ -680,7 +733,10 @@ class Observability:
         # after construction (the server wires its controller here).
         self.on_dispatch: Optional[Any] = None
         self.hist: Dict[str, Histogram] = {
-            name: Histogram(name, help_text)
+            name: Histogram(
+                name, help_text,
+                buckets=HISTOGRAM_BUCKETS.get(name, DEFAULT_BUCKETS_MS),
+            )
             for name, help_text in HISTOGRAMS.items()
             if name not in LABELED_HISTOGRAMS
         }
@@ -993,6 +1049,42 @@ class Observability:
             self.hist["swap_in_ms"].observe(ms)
         self.annotate("kv_swap_in", ms=round(ms, 3), blocks=blocks)
 
+    # -- per-session KV accounting ------------------------------------------
+
+    # request_kv fields that ACCUMULATE across calls (a replay or a
+    # second swap-in adds to the session's ledger); everything else is
+    # set-latest (gauge semantics: blocks_held, prefix_hit_tokens).
+    _KV_ADDITIVE = frozenset({
+        "swap_in_bytes", "swap_out_bytes", "evictions_suffered",
+    })
+
+    def request_kv(self, rid: int, **fields) -> None:
+        """Merge per-session KV accounting onto ``rid``'s timeline —
+        blocks held, prefix-hit depth in tokens, swap bytes moved,
+        evictions suffered — shown under ``kv`` in
+        ``/debug/requests/<id>``.  Host bookkeeping only."""
+        with self._lock:
+            tl = self._by_rid.get(rid)
+            if tl is None:
+                return
+            for k, v in fields.items():
+                if k in self._KV_ADDITIVE:
+                    tl.kv[k] = tl.kv.get(k, 0) + v
+                else:
+                    tl.kv[k] = v
+
+    def observe_kv(self, hit_depth_tokens: Optional[int] = None,
+                   session_blocks: Optional[int] = None) -> None:
+        """Feed the KV-capacity histograms: prefix-hit depth at
+        admission, session block footprint at slot free."""
+        with self._lock:
+            if hit_depth_tokens is not None:
+                self.hist["prefix_hit_depth_tokens"].observe(
+                    hit_depth_tokens
+                )
+            if session_blocks is not None:
+                self.hist["session_kv_blocks"].observe(session_blocks)
+
     def annotate(self, name: str, **fields) -> None:
         """Instant event into the bounded annotation ring (fault
         injections, quarantine transitions, kv-tier demotions...) —
@@ -1171,6 +1263,7 @@ class Observability:
                 "outcome": tl.outcome,
                 "error": tl.error,
                 "route": tl.route,
+                "kv": dict(tl.kv),
                 "spans": [self._span_json(sp) for sp in tl.spans],
                 "dispatch_spans": [
                     dict(d) for d in self.dispatches if d["seq"] in seqs
@@ -1287,12 +1380,30 @@ class Observability:
                     },
                 })
             tid += 1
+        # KV-cache events (tier demotions / host-LRU drops / evictions
+        # / swap-ins / handoff export+import) get their OWN track, so a
+        # trace window reads cache churn as one lane instead of noise
+        # interleaved with dispatch annotations.  Each instant's args
+        # keep whatever rid/request_id the emitter attached — the link
+        # back to the owning request's track.
+        kv_tid = tid
+        kv_named = False
         for e in events:
             if horizon is not None and e["t_ms"] < horizon:
                 continue
+            is_kv = e["name"].startswith("kv_") or e["name"] in (
+                "prefix_export", "prefix_import",
+            )
+            if is_kv and not kv_named:
+                kv_named = True
+                ev.append({
+                    "ph": "M", "pid": 1, "tid": kv_tid,
+                    "name": "thread_name",
+                    "args": {"name": "kv cache"},
+                })
             ev.append({
                 "name": e["name"], "cat": "annotation", "ph": "i",
-                "pid": 1, "tid": 1, "s": "g",
+                "pid": 1, "tid": kv_tid if is_kv else 1, "s": "g",
                 "ts": round(e["t_ms"] * 1000.0, 1),
                 "args": dict(e["fields"]),
             })

@@ -52,6 +52,7 @@ so clients need no changes):
                              a ``replicas`` section (per-replica
                              health/occupancy/mesh snapshot)
     GET  /metrics            router gauges + per-replica labeled series
+    GET  /debug/kv/fleet     FLEET CACHE VIEW (schema below)
     GET  /debug/trace        FLEET-MERGED Perfetto trace (schema below)
     GET  /debug/requests     index aggregated across ALL healthy
                              replicas, each entry tagged ``replica``
@@ -82,11 +83,53 @@ Chrome/Perfetto ``trace_event`` document containing
     external request id, so a prefill-on-A / decode-on-B session
     reads as one timeline across three tracks.
 
+**Fleet cache view** (``GET /debug/kv/fleet[?depth=D]``, r13): the
+router-side aggregation of every healthy replica's chain digest
+(``GET /debug/kv``, scraped on demand with probe-class timeouts —
+never from the poller; the poller's ``/healthz`` scrape already
+carries each replica's O(1) digest summary under ``kv.digest``)::
+
+    {"fleet": {
+       "prefix_hit_ratio": float,        # sum(hit tokens)/sum(prompt)
+       "prefix_hit_tokens_total": int, "prompt_tokens_total": int,
+       "duplicate_chains": int,          # chain keys HBM-resident on
+                                         # >= 2 replicas
+       "duplicate_kv_blocks": int,       # copies beyond the first
+       "duplicate_kv_bytes": int,        # ... priced per replica's
+                                         # block_bytes — the HBM a
+                                         # cache-aware scheduler
+                                         # (ROADMAP item 2) reclaims
+       "replicas_scraped": [int, ...],
+       "truncated_replicas": [int, ...], # digests cut at the node cap
+                                         # (duplicates = LOWER bound)
+       "scrape_ms": float},
+     "replicas": [{"replica": int, "summary": {<replica /debug/kv
+                   summary>}, "hit_ratio": float,
+                   "hbm_bytes": int}, ...]}
+
+The computed aggregate is cached for ``/metrics``:
+``llm_fleet_duplicate_kv_blocks`` / ``llm_fleet_duplicate_kv_bytes`` /
+``llm_fleet_prefix_hit_ratio`` / ``llm_fleet_kv_age_s`` (samples
+appear after the first fleet-view computation).  Per-replica labeled
+cache gauges ride every scrape of the health poller:
+``llm_router_replica_kv_{nodes,hbm_blocks,host_blocks,idle_blocks,
+digest_version,hit_ratio}`` — qualified by
+``llm_replica_health_age_s`` (seconds since that replica's labeled
+values were last refreshed; -1 = never scraped; an unroutable
+replica's gauges persist STALE, so dashboards gate on the age).
+Digest freshness also feeds the affinity policy: an affinity hit onto
+a replica whose digest ``loss_version`` changed since the session
+pinned (evictions/demotions — or a rebuild, which resets versions)
+still routes there, but as a counted, logged stale event
+(``llm_router_affinity_stale_routes_total``; the pin refreshes to the
+observed version so one loss event counts once) instead of a silent
+cache miss.
+
 Thread discipline: handler threads (forward) and the health poller
-share the replica table, counters, routing record, and trace ring —
-every access goes under ``_lock`` (registered in
-analysis/lockcheck.py).  The router holds no jax state at all; it is
-pure host-side HTTP."""
+share the replica table, counters, routing record, trace ring, and
+the cached fleet cache view — every access goes under ``_lock``
+(registered in analysis/lockcheck.py).  The router holds no jax state
+at all; it is pure host-side HTTP."""
 
 from __future__ import annotations
 
@@ -142,10 +185,22 @@ class _Replica:
     routed_total: int = 0
     failures_total: int = 0
     last_health: Dict[str, Any] = field(default_factory=dict)
+    # Monotonic instant of the last SUCCESSFUL health scrape (0.0 =
+    # never scraped).  A replica that goes unroutable keeps its last
+    # scraped values in ``last_health`` — the per-replica labeled
+    # /metrics gauges would silently serve stale numbers, so the
+    # exposition emits ``llm_replica_health_age_s`` alongside them and
+    # dashboards gate on it.
+    last_health_t: float = 0.0
 
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
+
+    def kv_digest(self) -> Dict[str, Any]:
+        """The chain-digest summary of the last health scrape (empty
+        dict before the first scrape / from pre-digest replicas)."""
+        return (self.last_health.get("kv") or {}).get("digest") or {}
 
     def snapshot(self) -> Dict[str, Any]:
         h = self.last_health
@@ -160,6 +215,11 @@ class _Replica:
             "degraded": h.get("degraded"),
             "overload_state": (h.get("overload") or {}).get("state"),
             "replica": h.get("replica"),
+            "health_age_s": (
+                round(time.monotonic() - self.last_health_t, 3)
+                if self.last_health_t > 0 else None
+            ),
+            "kv": h.get("kv"),
         }
 
 
@@ -215,17 +275,30 @@ class ReplicaRouter:
                 self._replicas.append(
                     _Replica(index=i, host=h, port=p, server=rep)
                 )
-        # Sticky-session map: affinity key -> replica index (bounded
+        # Sticky-session map: affinity key -> [replica index, the
+        # replica's chain-digest loss_version at pin time] (bounded
         # LRU — hits refresh recency, so long-lived active sessions
         # are not the eviction victims; a dead replica's entries
-        # re-pin on next use).
-        self._affinity: "OrderedDict[bytes, int]" = OrderedDict()
+        # re-pin on next use).  The loss_version is the digest-
+        # freshness check: a later hit whose replica has since evicted
+        # or demoted chains (loss_version changed) is routed anyway —
+        # affinity is a locality HINT, not a correctness contract —
+        # but as a COUNTED, logged stale-route event instead of a
+        # silent cache miss (affinity_stale_routes_total; the entry
+        # re-pins at the observed version so one loss event counts
+        # once, not on every subsequent turn).
+        self._affinity: "OrderedDict[bytes, List[Any]]" = OrderedDict()
         self.routed_by_policy: Dict[str, int] = {
             "least-loaded": 0, "affinity": 0, "reroute": 0,
         }
         self.reroutes_total = 0
         self.replica_failures_total = 0
         self.kv_handoffs_total = 0
+        self.affinity_stale_routes_total = 0
+        # Last computed fleet cache view (fleet_kv_json fills it; the
+        # /metrics fleet gauges read it) — None until the first
+        # GET /debug/kv/fleet.
+        self._fleet_kv: Optional[Dict[str, Any]] = None
         # Router-local trace ring (fleet-merged /debug/trace): bounded
         # span dicts, appended under _lock by handler threads.  The
         # monotonic/wall anchors are captured at the same instant —
@@ -354,6 +427,7 @@ class ReplicaRouter:
                     rep.healthy = ok
                     if payload:
                         rep.last_health = payload
+                        rep.last_health_t = time.monotonic()
                 if was != ok:
                     self._log(
                         "router_replica_health",
@@ -374,6 +448,7 @@ class ReplicaRouter:
                 rep.healthy = ok
                 if payload:
                     rep.last_health = payload
+                    rep.last_health_t = time.monotonic()
 
     # -- routing -------------------------------------------------------------
 
@@ -400,31 +475,58 @@ class ReplicaRouter:
 
     def _pick_locked(
         self, key: Optional[bytes], exclude: frozenset
-    ) -> Tuple[Optional[_Replica], str]:
+    ) -> Tuple[Optional[_Replica], str, bool]:
         """Choose a replica (caller holds ``_lock``): sticky key first
         (affinity policy), else least-loaded among healthy replicas not
-        in ``exclude`` (prior failed attempts for this request)."""
+        in ``exclude`` (prior failed attempts for this request).
+
+        Returns ``(replica, how, stale)``.  ``stale`` is True for an
+        affinity hit whose replica's chain-digest ``loss_version`` has
+        changed since the session pinned — the pinned chain may have
+        been evicted or demoted, so the route is a CACHE GAMBLE rather
+        than a known hit.  Compared with ``!=`` (not ``>``): a
+        crash-recovery rebuild resets the digest to version 0 and
+        empties the cache — exactly a staleness event."""
         candidates = [
             r for r in self._replicas
             if r.healthy and r.index not in exclude
         ]
         if not candidates:
-            return None, "none"
+            return None, "none", False
         if self.policy == "affinity" and key is not None:
-            idx = self._affinity.get(key)
-            if idx is not None:
+            ent = self._affinity.get(key)
+            if ent is not None:
                 for r in candidates:
-                    if r.index == idx:
+                    if r.index == ent[0]:
                         self._affinity.move_to_end(key)  # LRU refresh
-                        return r, "affinity"
+                        cur = r.kv_digest().get("loss_version")
+                        stale = (
+                            ent[1] is not None and cur is not None
+                            and cur != ent[1]
+                        )
+                        if stale:
+                            self.affinity_stale_routes_total += 1
+                            # Re-pin at the observed version: one loss
+                            # event counts once, not every turn.
+                            ent[1] = cur
+                        elif ent[1] is None and cur is not None:
+                            # The session pinned before this replica's
+                            # first digest scrape (None baseline) —
+                            # BACKFILL at the first observed version,
+                            # or the None would disable staleness
+                            # detection for the session's whole life.
+                            ent[1] = cur
+                        return r, "affinity", stale
         chosen = min(
             candidates, key=lambda r: (r.inflight, r.routed_total)
         )
         if self.policy == "affinity" and key is not None:
             while len(self._affinity) >= self.affinity_max_sessions:
                 self._affinity.popitem(last=False)  # evict coldest
-            self._affinity[key] = chosen.index
-        return chosen, "least-loaded"
+            self._affinity[key] = [
+                chosen.index, chosen.kv_digest().get("loss_version"),
+            ]
+        return chosen, "least-loaded", False
 
     # -- proxying ------------------------------------------------------------
 
@@ -458,7 +560,9 @@ class ReplicaRouter:
         while True:
             t_pick = self._now_ms()
             with self._lock:
-                rep, how = self._pick_locked(key, frozenset(tried))
+                rep, how, stale = self._pick_locked(
+                    key, frozenset(tried)
+                )
                 if rep is not None:
                     rep.inflight += 1
                     rep.routed_total += 1
@@ -475,6 +579,15 @@ class ReplicaRouter:
                 )
                 return
             tried.add(rep.index)
+            if stale:
+                # Digest freshness said the pinned chain may be gone:
+                # route anyway (locality hint, not a contract), but as
+                # a counted, logged event — the cache-aware scheduler's
+                # future miss signal, no longer silent.
+                self._log(
+                    "router_affinity_stale",
+                    replica=rep.index, request_id=client_rid,
+                )
             fwd_headers["X-Routed-By"] = (
                 f"replica-{rep.index}/{how}"
             )
@@ -485,6 +598,7 @@ class ReplicaRouter:
             self._span(
                 "route", t_pick, replica=rep.index, policy=how,
                 path=handler.path, request_id=client_rid,
+                stale_chain=stale or None,
             )
             t_fwd = self._now_ms()
             try:
@@ -671,6 +785,17 @@ class ReplicaRouter:
             self._reply_json(
                 handler, 200, self.fleet_trace_json(window_ms)
             )
+        elif route == "/debug/kv/fleet":
+            depth = None
+            if "depth" in query:
+                try:
+                    depth = int(query["depth"][0])
+                except ValueError:
+                    self._reply_json(
+                        handler, 400, {"error": "bad depth"}
+                    )
+                    return
+            self._reply_json(handler, 200, self.fleet_kv_json(depth))
         elif route == "/debug/requests":
             self._reply_json(
                 handler, *self._fleet_requests_index(handler.path)
@@ -867,6 +992,101 @@ class ReplicaRouter:
             "replicas": merged_replicas,
         }
 
+    def fleet_kv_json(
+        self, depth: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """``GET /debug/kv/fleet``: the router-side fleet cache view.
+
+        Scrapes every healthy replica's ``/debug/kv`` digest
+        (sequential, probe-class 2 s timeouts — on demand, never from
+        the poller) and aggregates:
+
+          * **fleet prefix-hit ratio** — sum of hit tokens over sum of
+            admitted prompt tokens across the fleet;
+          * **per-replica occupancy/watermarks** — nodes, HBM/host
+            residency, idle (evictable) depth, digest version/age;
+          * **cross-replica duplicate chains** — chain-prefix keys
+            HBM-resident on >= 2 replicas, with the redundant blocks
+            and BYTES (copies beyond the first, priced at each extra
+            copy's own block_bytes): the HBM a cache-aware
+            disaggregation scheduler (ROADMAP item 2) would get back.
+
+        The computed fleet aggregate is cached (``_fleet_kv``) for the
+        ``llm_fleet_duplicate_kv_blocks`` /metrics gauges; truncated
+        replica digests make the duplicate count a LOWER bound and are
+        listed in ``truncated_replicas``."""
+        with self._lock:
+            reps = [
+                (r.index, r.host, r.port)
+                for r in self._replicas if r.healthy
+            ]
+        t0 = time.monotonic()
+        suffix = f"?depth={depth}" if depth is not None else ""
+        per: List[Dict[str, Any]] = []
+        truncated: List[int] = []
+        # chain key -> [(replica index, block_bytes), ...] HBM copies
+        chains: Dict[str, List[Tuple[int, int]]] = {}
+        hit_tokens = prompt_tokens = 0
+        for index, host, port in reps:
+            got = self._get_replica_json(
+                _Replica(index=index, host=host, port=port),
+                "/debug/kv" + suffix,
+            )
+            if got is None or got[0] != 200:
+                continue
+            doc = got[1]
+            summ = doc.get("summary") or {}
+            bb = int(summ.get("block_bytes") or 0)
+            for node in doc.get("nodes", []):
+                if (
+                    isinstance(node, dict)
+                    and node.get("tier") == "hbm"
+                ):
+                    chains.setdefault(str(node.get("key")), []).append(
+                        (index, bb)
+                    )
+            if doc.get("truncated"):
+                truncated.append(index)
+            hit_tokens += int(summ.get("prefix_hit_tokens_total") or 0)
+            prompt_tokens += int(summ.get("prompt_tokens_total") or 0)
+            per.append({
+                "replica": index,
+                "summary": summ,
+                "hit_ratio": round(
+                    int(summ.get("prefix_hit_tokens_total") or 0)
+                    / max(1, int(summ.get("prompt_tokens_total") or 0)),
+                    6,
+                ),
+                "hbm_bytes": (
+                    int(summ.get("hbm_blocks") or 0) * bb
+                ),
+            })
+        dup_chains = dup_blocks = dup_bytes = 0
+        for copies in chains.values():
+            if len({i for i, _ in copies}) < 2:
+                continue
+            dup_chains += 1
+            extra = sorted(copies)[1:]  # first copy is the keeper
+            dup_blocks += len(extra)
+            dup_bytes += sum(b for _, b in extra)
+        scrape_ms = round((time.monotonic() - t0) * 1000.0, 3)
+        fleet = {
+            "prefix_hit_ratio": round(
+                hit_tokens / max(1, prompt_tokens), 6
+            ),
+            "prefix_hit_tokens_total": hit_tokens,
+            "prompt_tokens_total": prompt_tokens,
+            "duplicate_chains": dup_chains,
+            "duplicate_kv_blocks": dup_blocks,
+            "duplicate_kv_bytes": dup_bytes,
+            "replicas_scraped": [p["replica"] for p in per],
+            "truncated_replicas": truncated,
+            "scrape_ms": scrape_ms,
+        }
+        with self._lock:
+            self._fleet_kv = dict(fleet, computed_unix_s=time.time())
+        return {"fleet": fleet, "replicas": per}
+
     def health(self) -> Dict[str, Any]:
         """Aggregate /healthz: ok while ANY replica is routable, with
         the per-replica snapshots under ``replicas``."""
@@ -874,12 +1094,21 @@ class ReplicaRouter:
             snaps = [r.snapshot() for r in self._replicas]
             affinity_sessions = len(self._affinity)
             handoffs = self.kv_handoffs_total
+            stale_routes = self.affinity_stale_routes_total
+            fleet_kv = (
+                dict(self._fleet_kv)
+                if self._fleet_kv is not None else None
+            )
         return {
             "ok": any(s["healthy"] for s in snaps),
             "policy": self.policy,
             "replicas": snaps,
             "affinity_sessions": affinity_sessions,
             "kv_handoffs_total": handoffs,
+            "affinity_stale_routes_total": stale_routes,
+            # Last computed fleet cache aggregate (None until the
+            # first GET /debug/kv/fleet).
+            "fleet_kv": fleet_kv,
         }
 
     def metrics_text(self) -> str:
@@ -893,6 +1122,11 @@ class ReplicaRouter:
             failures = self.replica_failures_total
             handoffs = self.kv_handoffs_total
             affinity_sessions = len(self._affinity)
+            stale_routes = self.affinity_stale_routes_total
+            fleet_kv = (
+                dict(self._fleet_kv)
+                if self._fleet_kv is not None else None
+            )
         lines: List[str] = []
 
         def fam(name: str, kind: str, help_text: str) -> None:
@@ -924,6 +1158,56 @@ class ReplicaRouter:
         fam("affinity_sessions", "gauge",
             "Sticky sessions currently pinned")
         lines.append(f"llm_router_affinity_sessions {affinity_sessions}")
+        fam("affinity_stale_routes_total", "counter",
+            "Affinity routes taken onto a replica whose chain digest "
+            "changed since the session pinned (possible cache miss — "
+            "counted, no longer silent)")
+        lines.append(
+            f"llm_router_affinity_stale_routes_total {stale_routes}"
+        )
+        # Fleet cache aggregate (last GET /debug/kv/fleet computation;
+        # headers always present for dashboard discovery, samples only
+        # once a fleet view has been computed).
+        lines.append(
+            "# HELP llm_fleet_duplicate_kv_blocks HBM blocks holding "
+            "chain prefixes duplicated on >= 2 replicas (copies beyond "
+            "the first; last fleet-view computation)"
+        )
+        lines.append("# TYPE llm_fleet_duplicate_kv_blocks gauge")
+        lines.append(
+            "# HELP llm_fleet_duplicate_kv_bytes HBM bytes behind the "
+            "duplicate chain blocks — the disaggregation scheduler's "
+            "reclaimable redundancy"
+        )
+        lines.append("# TYPE llm_fleet_duplicate_kv_bytes gauge")
+        lines.append(
+            "# HELP llm_fleet_prefix_hit_ratio Fleet-wide fraction of "
+            "admitted prompt tokens served from cached prefix blocks "
+            "(last fleet-view computation)"
+        )
+        lines.append("# TYPE llm_fleet_prefix_hit_ratio gauge")
+        lines.append(
+            "# HELP llm_fleet_kv_age_s Seconds since the fleet cache "
+            "view was last computed"
+        )
+        lines.append("# TYPE llm_fleet_kv_age_s gauge")
+        if fleet_kv is not None:
+            lines.append(
+                "llm_fleet_duplicate_kv_blocks "
+                f"{fleet_kv['duplicate_kv_blocks']}"
+            )
+            lines.append(
+                "llm_fleet_duplicate_kv_bytes "
+                f"{fleet_kv['duplicate_kv_bytes']}"
+            )
+            lines.append(
+                "llm_fleet_prefix_hit_ratio "
+                f"{fleet_kv['prefix_hit_ratio']}"
+            )
+            lines.append(
+                "llm_fleet_kv_age_s "
+                f"{round(time.time() - fleet_kv['computed_unix_s'], 3)}"
+            )
         fam("replica_healthy", "gauge", "Replica routable (per replica)")
         fam("replica_inflight", "gauge",
             "Router-tracked in-flight requests (per replica)")
@@ -935,6 +1219,36 @@ class ReplicaRouter:
         fam("replica_mesh_devices", "gauge",
             "Devices in the replica's serving mesh (last health "
             "scrape)")
+        # Per-replica cache gauges (from the /healthz kv.digest
+        # summary the poller already scrapes) + the staleness gauge
+        # that qualifies EVERY per-replica labeled value here: a
+        # replica that went unroutable keeps its last-scraped numbers,
+        # so dashboards gate on the age instead of trusting them.
+        lines.append(
+            "# HELP llm_replica_health_age_s Seconds since this "
+            "replica's labeled gauges were last refreshed from a "
+            "successful /healthz scrape (-1 = never scraped; stale "
+            "values persist for unroutable replicas — gate on this)"
+        )
+        lines.append("# TYPE llm_replica_health_age_s gauge")
+        fam("replica_kv_nodes", "gauge",
+            "Chain-digest nodes (keyed blocks) on this replica (last "
+            "health scrape)")
+        fam("replica_kv_hbm_blocks", "gauge",
+            "HBM-resident chain blocks on this replica (last health "
+            "scrape)")
+        fam("replica_kv_host_blocks", "gauge",
+            "Host-tier-resident chain blocks on this replica (last "
+            "health scrape)")
+        fam("replica_kv_idle_blocks", "gauge",
+            "Idle (refcount-0, evictable) chain blocks on this "
+            "replica (last health scrape)")
+        fam("replica_kv_digest_version", "gauge",
+            "Chain-digest content version on this replica (last "
+            "health scrape)")
+        fam("replica_kv_hit_ratio", "gauge",
+            "Replica fraction of admitted prompt tokens served from "
+            "cached prefix blocks (last health scrape)")
         for s in snaps:
             lab = f'replica="{s["index"]}"'
             lines.append(
@@ -957,6 +1271,39 @@ class ReplicaRouter:
             lines.append(
                 f"llm_router_replica_mesh_devices{{{lab}}} "
                 f"{mesh.get('devices', 1) or 1}"
+            )
+            age = s.get("health_age_s")
+            lines.append(
+                f"llm_replica_health_age_s{{{lab}}} "
+                f"{age if age is not None else -1}"
+            )
+            kv = s.get("kv") or {}
+            dig = kv.get("digest") or {}
+            lines.append(
+                f"llm_router_replica_kv_nodes{{{lab}}} "
+                f"{dig.get('nodes', 0) or 0}"
+            )
+            lines.append(
+                f"llm_router_replica_kv_hbm_blocks{{{lab}}} "
+                f"{dig.get('hbm_blocks', 0) or 0}"
+            )
+            lines.append(
+                f"llm_router_replica_kv_host_blocks{{{lab}}} "
+                f"{dig.get('host_blocks', 0) or 0}"
+            )
+            lines.append(
+                f"llm_router_replica_kv_idle_blocks{{{lab}}} "
+                f"{dig.get('idle_blocks', 0) or 0}"
+            )
+            lines.append(
+                f"llm_router_replica_kv_digest_version{{{lab}}} "
+                f"{dig.get('version', 0) or 0}"
+            )
+            hit = int(kv.get("prefix_hit_tokens_total") or 0)
+            prompt = int(kv.get("prompt_tokens_total") or 0)
+            lines.append(
+                f"llm_router_replica_kv_hit_ratio{{{lab}}} "
+                f"{round(hit / max(1, prompt), 6)}"
             )
         return "\n".join(lines) + "\n"
 

@@ -113,7 +113,27 @@ parity.  Design constraints, in order:
         "host_kv_blocks": int,     # tier capacity (0 = tier off)
         "host_tier_blocks": int,   # blocks currently demoted
         "swap_queue_depth": int,   # swap-ins in flight (restoring)
-        "restored_waiting": int    # swapped in, awaiting a slot
+        "restored_waiting": int,   # swapped in, awaiting a slot
+        "digest": {                # chain-digest summary (KvDigest —
+                                   # the compact form the router's
+                                   # health poller scrapes; bounded)
+          "version": int,          # bumps on publish/evict/demote/
+                                   # restore; resets on rebuild —
+                                   # compare with !=
+          "loss_version": int,     # bumps only on HBM-residency loss
+          "hash": "hex16",         # order-free set-hash of
+                                   # (chain key, tier)
+          "nodes": int, "hbm_blocks": int, "host_blocks": int,
+          "idle_blocks": int, "depth_max": int,
+          "publishes_total": int, "evictions_total": int,
+          "demotions_total": int, "restores_total": int,
+          "host_evictions_total": int
+        },
+        "block_bytes": int,        # pool bytes per block (the
+                                   # duplicate-chain accounting unit)
+        "total_blocks": int,
+        "prefix_hit_tokens_total": int,  # fleet hit-ratio numerator
+        "prompt_tokens_total": int       # ... and denominator
       },
       "overload": {            # overload controller (overload.py)
         "enabled": bool,           # priority classes + ladder active
@@ -232,6 +252,35 @@ the xplane protos).  Dispatch records (/debug/dispatches) gain
 ``bytes_accessed`` / ``device_est_ms`` (the roofline estimate the
 host_overhead_ratio gauge divides by).
 
+``GET /debug/kv[?depth=D&n=N]`` (KV chain digest, r13 — reads only the
+lock-guarded ``kvcache.KvDigest``, never the thread-confined store)::
+
+    {"version": int,
+     "nodes": [{"key": "<hex chain-prefix hash>",
+                "depth": int,            # blocks from the root
+                "tier": "hbm"|"host",    # residency
+                "refcount": bool,        # claimed by a live session?
+                "seq": int}, ...],       # recency (digest mutation seq)
+     "truncated": int,                   # nodes past the n= cap
+     "depth_cap": int|null,
+     "summary": {<the /healthz kv.digest dict> +
+                 prefix_index/block_size/block_bytes/total_blocks/
+                 host_kv_blocks/prefix_hit_tokens_total/
+                 prompt_tokens_total}}
+
+Nodes sort (depth, key) so equal content serializes identically; the
+walk is depth-capped by ``depth`` and truncated past ``n`` (default
+2048), so the payload stays bounded at max radix occupancy.  Per-
+session KV accounting rides ``/debug/requests/<id>`` as a ``kv`` dict
+(``blocks_held`` / ``prefix_hit_tokens`` / ``swap_in_bytes`` /
+``evictions_suffered``), the ``prefix_hit_depth_tokens`` (pow2 token
+buckets) and ``session_kv_blocks`` (pow2 block buckets) histograms
+feed from admissions and slot frees, and kv-tier events (demote /
+host-evict / evict / swap-in / handoff export+import) render on a
+dedicated ``kv cache`` track in the /debug/trace export, linked to the
+owning request through their args.  The router aggregates the per-
+replica digests at ``GET /debug/kv/fleet`` (router.py docstring).
+
 Every reply carries the end-to-end request id: blocking bodies and
 error bodies (400/413/500/503/504) as ``"request_id"``, plus an
 ``X-Request-Id`` header; each NDJSON stream line carries
@@ -305,6 +354,7 @@ Endpoints:
   GET  /healthz    {"ok": true}
   GET  /debug/requests[/<id>]   request-timeline JSON (schema above).
   GET  /debug/dispatches        recent dispatch-span ring.
+  GET  /debug/kv                chain-digest tree walk (schema above).
   GET  /debug/trace             Chrome/Perfetto trace_event JSON.
   POST /debug/profiler          jax.profiler session start/stop.
   GET  /debug/profile/summary   per-program xplane attribution
@@ -666,6 +716,18 @@ class LLMServer:
                 elif route == "/debug/dispatches":
                     self._reply_json(
                         200, server.obs.dispatches_json(qint("n", 128))
+                    )
+                elif route == "/debug/kv":
+                    # Full (depth-capped, node-bounded) chain-digest
+                    # walk — reads only the lock-guarded KvDigest, so
+                    # handler threads never touch the confined store.
+                    depth = qint("depth", 0)
+                    self._reply_json(
+                        200,
+                        server.batcher.kv_debug_json(
+                            depth=depth if depth > 0 else None,
+                            max_nodes=qint("n", 2048),
+                        ),
                     )
                 elif route == "/debug/trace":
                     window_ms = None
@@ -1613,6 +1675,18 @@ class LLMServer:
                 "host_tier_blocks": self.batcher._store.host_blocks(),
                 "swap_queue_depth": len(self.batcher._restoring),
                 "restored_waiting": len(self.batcher._restored_ready),
+                # Compact chain-digest summary (kvcache.KvDigest, its
+                # own leaf lock) piggybacked for the router's health
+                # poller: versions for staleness detection, residency
+                # counts, the publish/evict/demote/restore ledger —
+                # bounded O(1) payload, zero new poll endpoints.
+                "digest": self.batcher.kv_digest.summary(),
+                "block_bytes": self.batcher.block_bytes,
+                "total_blocks": self.batcher.n_blocks,
+                "prefix_hit_tokens_total": (
+                    self.batcher.prefix_hit_tokens_total
+                ),
+                "prompt_tokens_total": self.batcher.prompt_tokens_total,
             },
             "overload": self.overload.health(),
             # Scale-out serving (serve_mesh.py / router.py): the mesh

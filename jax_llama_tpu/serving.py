@@ -197,6 +197,7 @@ from .kvcache import (
     adopt_lower,
     fetch_slab,
     make_prefix_store,
+    pool_block_bytes,
     restore_ready,
     stage_restore,
 )
@@ -2005,6 +2006,19 @@ class ContinuousBatcher:
             prefix_index, host_blocks=self.host_kv_blocks,
             on_event=self.obs.annotate,
         )
+        # The store's chain digest, surfaced as a batcher attribute so
+        # HTTP handler threads (/debug/kv, /healthz, /metrics) can read
+        # it WITHOUT touching the thread-confined ``_store`` — the
+        # digest carries its own leaf lock (kvcache.KvDigest; lockcheck
+        # registered), making it the one piece of KV state that is
+        # legitimately cross-thread.
+        self.kv_digest = self._store.digest
+        # Bytes one pool block occupies (k+v+pos+scales, draft twins
+        # included) — the duplicate-chain accounting unit the router's
+        # fleet cache view multiplies by.  Ctor-stable.
+        self.block_bytes = pool_block_bytes(self.pool) + (
+            pool_block_bytes(self.draft_pool) if self.spec else 0
+        )
         self._block_refs: Dict[int, int] = {}    # block -> active users
         # In-flight swap-ins (the ``restoring`` admission state) and
         # completed ones awaiting a free slot.  ``swap_poll_min`` is a
@@ -2050,9 +2064,14 @@ class ContinuousBatcher:
         self.swap_in_ms_total = 0.0
         self.swap_failures_total = 0
         # Disaggregation handoff (export_prefix / import_prefix):
-        # prefix blocks shipped to / landed from peer replicas.
+        # prefix blocks shipped to / landed from peer replicas, plus
+        # the handoff EVENT counts (calls that moved >= 1 block — the
+        # per-event ledger the KV telemetry layer exports next to the
+        # digest's publish/evict/demote/restore counters).
         self.kv_export_blocks_total = 0
         self.kv_import_blocks_total = 0
+        self.kv_export_events_total = 0
+        self.kv_import_events_total = 0
         # Host-side numpy mirrors of the per-slot decode state — the
         # AUTHORITATIVE copy for all host bookkeeping (admission
         # capacity, slot frees, replay).  The chunked decode path keeps
@@ -2413,6 +2432,7 @@ class ContinuousBatcher:
         # audit: racy-read(point-in-time /metrics snapshot of
         # single-writer loop state; stale by <= 1 step, never torn)
         pf = self._pf
+        dg = self.kv_digest.summary()  # lock-guarded, O(1)
         out: Dict[str, float] = {} if self.fault_injector is None else (
             dict(self.fault_injector.stats())
         )
@@ -2455,6 +2475,18 @@ class ContinuousBatcher:
             "swap_out_blocks_total": self.swap_out_blocks_total,
             "swap_in_ms_total": round(self.swap_in_ms_total, 3),
             "swap_failures_total": self.swap_failures_total,
+            # Chain-digest surface (kvcache.KvDigest, its own leaf
+            # lock): digest versions for staleness detection plus the
+            # per-event publish/evict/demote/restore ledger — the
+            # replica half of the fleet cache view.
+            "kv_digest_version": dg["version"],
+            "kv_digest_loss_version": dg["loss_version"],
+            "kv_publish_events_total": dg["publishes_total"],
+            "kv_evict_events_total": dg["evictions_total"],
+            "kv_demote_events_total": dg["demotions_total"],
+            "kv_restore_events_total": dg["restores_total"],
+            "kv_host_evict_events_total": dg["host_evictions_total"],
+            "kv_block_bytes": self.block_bytes,
             # Disaggregation handoff ledger + serving-mesh shape (1/1
             # off-mesh AND on unplaced meshes — the gauge reports the
             # sharding actually ACTIVE, not the mesh the batcher was
@@ -2462,6 +2494,8 @@ class ContinuousBatcher:
             # replica).
             "kv_export_blocks_total": self.kv_export_blocks_total,
             "kv_import_blocks_total": self.kv_import_blocks_total,
+            "kv_export_events_total": self.kv_export_events_total,
+            "kv_import_events_total": self.kv_import_events_total,
             "serve_mesh_data": (
                 smesh.mesh_shape(self.mesh)["data"]
                 if self._mesh_placed else 1
@@ -2525,6 +2559,34 @@ class ContinuousBatcher:
         if not proposed:
             return 0.0
         return sum(a for _, a in window) / proposed
+
+    def kv_debug_json(self, depth: Optional[int] = None,
+                      max_nodes: int = 2048) -> Dict[str, Any]:
+        """The ``GET /debug/kv`` payload: the chain digest's bounded
+        tree walk (per-node chain-prefix hash / depth / residency tier
+        / refcount flag / recency) plus the O(1) summary with this
+        replica's cache geometry.  Safe from HTTP handler threads: it
+        reads ONLY the lock-guarded digest (kvcache.KvDigest) and
+        ctor-stable geometry scalars, plus two single-writer token
+        counters whose point-in-time reads are the same /metrics
+        snapshot contract ``stats()`` documents — never the
+        thread-confined store or pool."""
+        out = self.kv_digest.nodes_json(depth=depth, max_nodes=max_nodes)
+        summary = self.kv_digest.summary()
+        summary.update({
+            "prefix_index": self.prefix_index,
+            "block_size": self.block_size,
+            "block_bytes": self.block_bytes,
+            "total_blocks": self.n_blocks,
+            "host_kv_blocks": self.host_kv_blocks,
+            # audit: racy-read(point-in-time snapshot of single-writer
+            # hit counters; stale by <= 1 admission, never torn — the
+            # fleet view's hit-ratio numerator/denominator)
+            "prefix_hit_tokens_total": self.prefix_hit_tokens_total,
+            "prompt_tokens_total": self.prompt_tokens_total,
+        })
+        out["summary"] = summary
+        return out
 
     def step(self) -> List[Tuple]:
         """One decode dispatch for every active slot.
@@ -2852,9 +2914,16 @@ class ContinuousBatcher:
                 self.pos[b] = len(pf.req.tokens)
                 self.active[b] = True
                 slot = self.slots[b]
+                # FULL chain, not the suffix: the radix publish walk
+                # starts at the root, so a suffix-only publication
+                # after a partial hit would parent the new nodes at
+                # the root under mid-chain keys — unreachable for
+                # matching (extensions never hit) and depth-wrong in
+                # the digest.  The hit prefix re-publishes as a no-op
+                # (existing resident nodes keep their block) and
+                # supplies the correct parent chain.
                 self._register_chain(
-                    slot.blocks[pf.n_share: len(pf.chain)],
-                    pf.chain[pf.n_share:],
+                    slot.blocks[: len(pf.chain)], pf.chain,
                 )
                 pf_done_rid = pf.req.rid
                 self._pf = None
@@ -3514,6 +3583,8 @@ class ContinuousBatcher:
                 slab.update(fetch_slab(self.draft_pool, blk, prefix="d_"))
             slabs.append(slab)
         self.kv_export_blocks_total += len(slabs)
+        if slabs:
+            self.kv_export_events_total += 1
         # Fleet-trace link: the instant event carries the EXTERNAL
         # request id (when the handoff orchestrator knows it), so the
         # router's merged /debug/trace ties this replica's export to
@@ -3583,6 +3654,8 @@ class ContinuousBatcher:
                 [b for b in fresh if b not in adopted]
             )
             self.kv_import_blocks_total += len(adopted)
+            if adopted:
+                self.kv_import_events_total += 1
             # Fleet-trace link (see export_prefix).
             self.obs.annotate(
                 "prefix_import", blocks=len(adopted),
@@ -3698,6 +3771,8 @@ class ContinuousBatcher:
                 plain.append(blk)
         self._store.retain(retained)
         self._invalidate_and_free(plain)
+        # Session KV footprint at teardown (peak blocks held).
+        self.obs.observe_kv(session_blocks=len(slot.blocks))
         self.slots[b] = None
         self.table[b] = self.n_blocks
         self.n_alloc[b] = 0
@@ -3942,13 +4017,24 @@ class ContinuousBatcher:
             self._claim_blocks(row_fresh[i])
             # Extend the published chain with this request's own full
             # prompt blocks (indices n_share..len(chain)-1 are fresh).
-            self._register_chain(blocks[n_share: len(chain)],
-                                 chain[n_share:])
+            # FULL chain, not the suffix: a suffix-only radix publish
+            # would mis-root the extension at the tree root under
+            # mid-chain keys (unreachable for future matches) — the
+            # hit prefix re-publishes as a no-op and parents the
+            # fresh nodes correctly.
+            self._register_chain(blocks[: len(chain)], chain)
             self.prefix_requests_hit += 1
             self.prefix_blocks_reused += n_share
             self.prompt_tokens_total += len(req.tokens)
             self.prefix_hit_tokens_total += n_share * bs
             self.obs.begin_span(req.rid, "decoding")
+            # Per-session KV accounting: blocks reserved + hit depth
+            # onto the timeline, hit depth into its histogram.
+            self.obs.request_kv(
+                req.rid, blocks_held=len(blocks),
+                prefix_hit_tokens=n_share * bs,
+            )
+            self.obs.observe_kv(hit_depth_tokens=n_share * bs)
 
     def _fused_scheduling(self) -> bool:
         """Fused prefill-decode scheduling is in force for this batcher
@@ -4042,6 +4128,11 @@ class ContinuousBatcher:
         ))
         self.swap_ins_total += 1
         self.obs.begin_span(req.rid, "restoring")
+        # The evictions this session SUFFERED: matched prefix nodes
+        # that had been demoted out of HBM, forcing this swap-in.
+        self.obs.request_kv(
+            req.rid, evictions_suffered=len(match.restore),
+        )
         return True
 
     def _abort_restore(self, r: "_Restore") -> None:
@@ -4124,6 +4215,14 @@ class ContinuousBatcher:
             # the overlap design exists to avoid — the suffix path's
             # documented undercount applies).
             self.obs.record_swap_in(swap_ms, len(r.fresh))
+            # Swap bytes moved for this session (host metadata
+            # arithmetic on the staged buffers — no sync).
+            self.obs.request_kv(
+                r.req.rid,
+                swap_in_bytes=sum(
+                    int(a.nbytes) for a in r.staged.values()
+                ),
+            )
             self.obs.record_dispatch(
                 kind="adopt", k=len(r.fresh),
                 occupancy=sum(
@@ -4285,6 +4384,12 @@ class ContinuousBatcher:
             self.prefix_requests_hit += 1
             self.prefix_blocks_reused += n_share
             self.prefix_hit_tokens_total += base
+        # Per-session KV accounting (fused lane): reservation + hit
+        # depth onto the timeline and the hit-depth histogram.
+        self.obs.request_kv(
+            req.rid, blocks_held=len(blocks), prefix_hit_tokens=base,
+        )
+        self.obs.observe_kv(hit_depth_tokens=base)
 
     def _admit_classic(self) -> None:
         """Classic admission with the decode-stall clock around it: the
@@ -4430,6 +4535,12 @@ class ContinuousBatcher:
                 blocks = self._alloc_blocks(need)
                 row_blocks.append(blocks)
                 self.prompt_tokens_total += len(req.tokens)
+                # Per-session KV accounting (cold batched prefill):
+                # full reservation, zero hit depth.
+                self.obs.request_kv(
+                    req.rid, blocks_held=need, prefix_hit_tokens=0,
+                )
+                self.obs.observe_kv(hit_depth_tokens=0)
                 # RIGHT padding (r5): token j at view column j, so block
                 # content is a pure function of the tokens (the prefix
                 # cache's keying invariant).  Trailing sentinels cover

@@ -62,6 +62,42 @@ def _fake_chain(n):
     return [bytes([i]) * 8 for i in range(n)]
 
 
+def test_chain_extension_after_partial_hit_stays_matchable(model):
+    """REGRESSION (found by the r13 chain digest): a session that HIT
+    a cached prefix and extended it used to publish only its suffix —
+    the radix publish walk starts at the root, so the extension nodes
+    mis-rooted under mid-chain keys: unreachable for matching (a
+    revisit hit only the old depth) and depth-wrong in /debug/kv.
+    Both admission paths must publish the FULL chain so extensions
+    parent correctly and a revisit matches end-to-end."""
+    params, config = model
+    base = list(np.random.RandomState(0).randint(1, 128, 40))
+    ext = base[:32] + list(np.random.RandomState(1).randint(1, 128, 40))
+    for kw in (
+        {},                                      # classic admission
+        {"prefill_budget": 32, "decode_chunk": 4},  # fused lane
+    ):
+        # Geometry matches the module's parity matrix so the jit
+        # cache is shared — this test adds no compiles of its own.
+        cb = ContinuousBatcher(
+            params, config, n_slots=2, max_len=256, block_size=BS, **kw
+        )
+        cb.submit(base, max_new_tokens=4)
+        cb.run_to_completion()
+        cb.submit(ext, max_new_tokens=4)  # partial hit + extension
+        cb.run_to_completion()
+        keys = cb._chain_keys(ext, BS)
+        assert len(keys) == 4
+        # The whole extended chain is matchable...
+        assert len(cb._match_prefix(keys).blocks) == 4, kw
+        # ...and the digest sees one chain of depths 1..4, not two
+        # root-parented stumps.
+        depths = sorted(
+            n["depth"] for n in cb.kv_debug_json()["nodes"]
+        )
+        assert depths == [1, 2, 3, 4], kw
+
+
 def test_radix_publish_match_and_dedup():
     store = RadixPrefixStore()
     keys = _fake_chain(3)

@@ -114,6 +114,7 @@ def test_metric_registry_shape():
     assert set(HISTOGRAMS) == {
         "ttft_ms", "itl_ms", "queue_wait_ms", "prefill_chunk_ms",
         "swap_in_ms", "compile_ms", "dispatch_ms",
+        "prefix_hit_depth_tokens", "session_kv_blocks",
     }
     # dispatch_ms renders as one labeled series per dispatch kind.
     assert LABELED_HISTOGRAMS == {"dispatch_ms"}
@@ -337,6 +338,94 @@ def test_request_rejected_records_terminal_timeline():
     obs.request_rejected("live-id", "should not clobber")
     assert obs.timeline_json("live-id")["outcome"] is None
     assert obs.requests_failed_total == 2
+
+
+def test_request_kv_merge_semantics_and_timeline_field():
+    """Per-session KV accounting: gauge-like fields set-latest,
+    ledger fields (swap bytes, evictions suffered) accumulate, and the
+    merged dict rides /debug/requests/<id> as ``kv``."""
+    obs = Observability(clock=FakeClock())
+    obs.request_queued(1, prompt_tokens=64)
+    obs.bind(1, "kv-req")
+    obs.request_kv(1, blocks_held=4, prefix_hit_tokens=32)
+    obs.request_kv(1, evictions_suffered=2)
+    obs.request_kv(1, swap_in_bytes=1000, evictions_suffered=1)
+    obs.request_kv(1, blocks_held=6)       # set-latest
+    obs.request_kv(1, swap_in_bytes=500)   # accumulates
+    tl = obs.timeline_json("kv-req")
+    assert tl["kv"] == {
+        "blocks_held": 6, "prefix_hit_tokens": 32,
+        "evictions_suffered": 3, "swap_in_bytes": 1500,
+    }
+    # Unknown rid is a no-op, never a KeyError.
+    obs.request_kv(99, blocks_held=1)
+    # A timeline that never saw KV traffic exposes an empty dict.
+    obs.request_queued(2, prompt_tokens=8)
+    obs.bind(2, "kv-none")
+    assert obs.timeline_json("kv-none")["kv"] == {}
+
+
+def test_observe_kv_histograms_token_block_buckets():
+    """prefix_hit_depth_tokens / session_kv_blocks are pow2 TOKEN and
+    BLOCK histograms (not ms): 0-depth cold admissions land in the
+    first bucket, the families render into the exposition."""
+    obs = Observability(clock=FakeClock())
+    obs.observe_kv(hit_depth_tokens=0)
+    obs.observe_kv(hit_depth_tokens=32)
+    obs.observe_kv(session_blocks=3)
+    h = obs.hist["prefix_hit_depth_tokens"]
+    assert h.buckets[0] == 1.0 and h.buckets[-1] == 16384.0
+    assert h.count == 2
+    cum = dict(h.cumulative())
+    assert cum["1"] == 1 and cum["32"] == 2
+    hb = obs.hist["session_kv_blocks"]
+    assert hb.buckets[-1] == 1024.0 and hb.count == 1
+    lines = obs.expose_histograms("llm_")
+    assert any(
+        ln.startswith("llm_prefix_hit_depth_tokens_bucket")
+        for ln in lines
+    )
+    assert "llm_session_kv_blocks_count 1" in lines
+
+
+def test_trace_json_kv_track():
+    """KV-cache events (tier transitions, swap-ins, handoff
+    export/import) render on their own named track, instant-linked to
+    the owning request via their args; non-KV annotations stay on the
+    dispatch track."""
+    clk = FakeClock()
+    obs = Observability(clock=clk)
+    obs.request_queued(1, prompt_tokens=32)
+    clk.advance(0.01)
+    obs.annotate("kv_demote", block=3, depth=2)
+    obs.annotate("fault", site="step")  # non-KV control
+    obs.annotate("prefix_export", blocks=2, request_id="sess-1")
+    obs.record_swap_in(12.5, blocks=2)  # emits kv_swap_in
+    doc = obs.trace_json()
+    names = {
+        e["args"]["name"] for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert "kv cache" in names
+    kv_tid = next(
+        e["tid"] for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+        and e["args"]["name"] == "kv cache"
+    )
+    inst = {
+        e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "i"
+    }
+    for nm in ("kv_demote", "prefix_export", "kv_swap_in"):
+        assert inst[nm]["tid"] == kv_tid, nm
+    assert inst["fault"]["tid"] == 1  # non-KV stays on dispatches
+    # The request link: args carry the emitter's request id.
+    assert inst["prefix_export"]["args"]["request_id"] == "sess-1"
+    # KV track never collides with a request track.
+    req_tids = {
+        e["tid"] for e in doc["traceEvents"]
+        if e.get("cat") == "request"
+    }
+    assert kv_tid not in req_tids
 
 
 def test_annotation_ring_bounded():

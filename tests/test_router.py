@@ -369,6 +369,154 @@ def test_fleet_merged_trace_schema_and_handoff_link(model, fleet):
     assert hand[-1]["args"]["dst"] == 1
 
 
+def test_fleet_kv_view_reports_duplicate_chains(model, fleet):
+    """ACCEPTANCE PIN: GET /debug/kv/fleet on the routed 2-replica CPU
+    fleet reports the fleet-wide prefix-hit ratio and NONZERO
+    cross-replica duplicate-chain bytes for a deliberately shared
+    prefix, and the router /metrics surface carries the fleet gauges,
+    the per-replica labeled kv gauges, and the health-age staleness
+    gauge qualifying them."""
+    router, servers, tok = fleet
+    # Publish the SAME chain on BOTH replicas: direct per-replica
+    # posts (deterministic — least-loaded tie-breaks depend on what
+    # earlier tests routed), then read the ROUTER's aggregated view.
+    shared = "shared system prompt for chat session A"
+    for s in servers:
+        st, body, _ = _post(
+            s.address, {"text": shared, "max_new_tokens": 4}
+        )
+        assert st == 200
+    router.check_health_now()  # refresh last_health kv summaries
+    st, text = _get(router.address, "/debug/kv/fleet")
+    assert st == 200
+    doc = json.loads(text)
+    fl = doc["fleet"]
+    assert sorted(fl["replicas_scraped"]) == [0, 1]
+    # The deliberately shared prefix is HBM-resident on both replicas:
+    # >= 2 duplicate chain blocks, priced in real pool bytes — the
+    # number that justifies the disaggregation scheduler.
+    assert fl["duplicate_chains"] >= 2
+    assert fl["duplicate_kv_blocks"] >= 2
+    bb = servers[0].batcher.block_bytes
+    assert fl["duplicate_kv_bytes"] >= 2 * bb
+    assert fl["duplicate_kv_bytes"] % bb == 0
+    # Fleet-wide hit ratio aggregates per-replica token counters.
+    assert 0.0 <= fl["prefix_hit_ratio"] <= 1.0
+    assert fl["prompt_tokens_total"] > 0
+    per = {p["replica"]: p for p in doc["replicas"]}
+    assert set(per) == {0, 1}
+    for p in per.values():
+        assert p["summary"]["nodes"] >= 2
+        assert p["hbm_bytes"] >= 2 * bb
+    # Router /metrics: fleet gauges (from the cached computation),
+    # per-replica labeled kv gauges, and the staleness gauge.
+    text = router.metrics_text()
+    assert (
+        f"llm_fleet_duplicate_kv_blocks {fl['duplicate_kv_blocks']}"
+        in text
+    )
+    assert (
+        f"llm_fleet_duplicate_kv_bytes {fl['duplicate_kv_bytes']}"
+        in text
+    )
+    assert "llm_fleet_prefix_hit_ratio" in text
+    for i in (0, 1):
+        assert f'llm_router_replica_kv_nodes{{replica="{i}"}}' in text
+        assert (
+            f'llm_router_replica_kv_digest_version{{replica="{i}"}}'
+            in text
+        )
+        # Freshly scraped: age is present and small (never -1).
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith(f'llm_replica_health_age_s{{replica="{i}"')
+        )
+        assert 0.0 <= float(line.split()[-1]) < 60.0
+    # The aggregate /healthz mirrors the fleet cache view.
+    h = router.health()
+    assert h["fleet_kv"]["duplicate_kv_bytes"] == (
+        fl["duplicate_kv_bytes"]
+    )
+
+
+def test_affinity_stale_route_counted_on_digest_loss(model, fleet):
+    """Digest freshness in the affinity policy: a pinned session whose
+    replica's chain-digest loss_version changed since pin routes
+    anyway, but as a COUNTED stale event (re-pinned at the observed
+    version so one loss counts once)."""
+    _, servers, tok = fleet
+    router = ReplicaRouter(
+        servers, policy="affinity", health_interval_s=0,
+    ).start()
+    try:
+        router.check_health_now()
+        st, _, hdrs = _post(
+            router.address,
+            {"text": "sticky session for staleness", "max_new_tokens": 4},
+        )
+        assert st == 200
+        rep = int(hdrs["X-Replica-Id"])
+        assert router.affinity_stale_routes_total == 0
+        # Simulate the pinned replica losing chains: bump the scraped
+        # loss_version out from under the pin (the real path would be
+        # an eviction/demotion between health scrapes).
+        with router._lock:
+            r = router._replicas[rep]
+            kv = dict(r.last_health.get("kv") or {})
+            dig = dict(kv.get("digest") or {})
+            dig["loss_version"] = (dig.get("loss_version") or 0) + 7
+            kv["digest"] = dig
+            r.last_health = dict(r.last_health, kv=kv)
+        st, _, hdrs = _post(
+            router.address,
+            {"text": "sticky session for staleness", "max_new_tokens": 4},
+        )
+        assert st == 200
+        assert int(hdrs["X-Replica-Id"]) == rep  # still routed there
+        assert router.affinity_stale_routes_total == 1
+        assert (
+            "llm_router_affinity_stale_routes_total 1"
+            in router.metrics_text()
+        )
+        # Re-pinned at the observed version: the SAME loss event does
+        # not count again on the next turn.
+        st, _, _ = _post(
+            router.address,
+            {"text": "sticky session for staleness", "max_new_tokens": 4},
+        )
+        assert st == 200
+        assert router.affinity_stale_routes_total == 1
+        # A session pinned BEFORE the replica's first digest scrape
+        # (None baseline) backfills at the first observed version —
+        # staleness detection works for its later turns (review fix:
+        # a permanent None would disable it for the session's life).
+        with router._lock:
+            router._affinity[b"t:pre-scrape session pin"] = [rep, None]
+        st, _, _ = _post(
+            router.address,
+            {"text": "pre-scrape session pin", "max_new_tokens": 4},
+        )
+        assert st == 200
+        with router._lock:
+            backfilled = router._affinity[b"t:pre-scrape session pin"][1]
+        assert backfilled is not None  # baseline adopted
+        with router._lock:
+            r = router._replicas[rep]
+            kv = dict(r.last_health.get("kv") or {})
+            dig = dict(kv.get("digest") or {})
+            dig["loss_version"] = (dig.get("loss_version") or 0) + 3
+            kv["digest"] = dig
+            r.last_health = dict(r.last_health, kv=kv)
+        st, _, _ = _post(
+            router.address,
+            {"text": "pre-scrape session pin", "max_new_tokens": 4},
+        )
+        assert st == 200
+        assert router.affinity_stale_routes_total == 2
+    finally:
+        router.stop()  # fleet servers stay up for the module
+
+
 def test_router_input_validation(model, fleet):
     import urllib.error
 

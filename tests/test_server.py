@@ -788,10 +788,26 @@ def test_metrics_exposition_valid_prometheus(model):
             assert kind == "counter", family
     assert types["llm_radix_nodes_total"] == "gauge"
     assert types["llm_active_slots"] == "gauge"
-    # The serving histograms are exposed and internally consistent.
+    # KV chain-digest scalar families (PR 13) are registered and
+    # typed: versions as gauges, the event ledger as counters.
+    assert types["llm_kv_digest_version"] == "gauge"
+    assert types["llm_kv_digest_loss_version"] == "gauge"
+    assert types["llm_kv_block_bytes"] == "gauge"
+    for fam in ("llm_kv_publish_events_total",
+                "llm_kv_evict_events_total",
+                "llm_kv_demote_events_total",
+                "llm_kv_restore_events_total",
+                "llm_kv_host_evict_events_total",
+                "llm_kv_export_events_total",
+                "llm_kv_import_events_total"):
+        assert types[fam] == "counter", fam
+    assert samples["llm_kv_block_bytes"] > 0
+    # The serving histograms are exposed and internally consistent —
+    # including the two non-latency KV families (token/block buckets).
     for fam in ("llm_ttft_ms", "llm_itl_ms", "llm_queue_wait_ms",
                 "llm_prefill_chunk_ms", "llm_swap_in_ms",
-                "llm_compile_ms"):
+                "llm_compile_ms", "llm_prefix_hit_depth_tokens",
+                "llm_session_kv_blocks"):
         assert types[fam] == "histogram"
         buckets = [
             (n, v) for n, v in samples.items()
@@ -984,6 +1000,78 @@ def test_debug_endpoints_and_slo_gauges(model):
         assert samples["llm_slo_attainment"] == 1.0
         assert samples["llm_requests_slo_ok_total"] >= 1
         assert samples["llm_goodput_tokens_total"] >= 5
+
+
+@pytest.mark.obs
+def test_debug_kv_endpoint_and_healthz_digest(model):
+    """GET /debug/kv (the chain-digest tree walk: summary + bounded
+    node list, depth cap honored) and the /healthz kv.digest compact
+    summary the router poller scrapes; /debug/requests/<id> carries
+    the per-session kv accounting fields."""
+    params, config = model
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64, block_size=16,
+    )
+    with LLMServer(cb, tokenizer=ByteTokenizer()) as srv:
+        prompt = list(range(2, 40))  # 2 full keyed blocks
+        req = urllib.request.Request(
+            srv.address + "/generate",
+            data=json.dumps(
+                {"prompt": prompt, "max_new_tokens": 4}
+            ).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "kv-dbg-1"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            assert r.status == 200
+
+        status, body = _get(srv.address, "/debug/kv")
+        assert status == 200
+        doc = json.loads(body)
+        summ = doc["summary"]
+        assert summ["prefix_index"] == "radix"
+        assert summ["nodes"] == len(doc["nodes"]) == 2
+        assert summ["version"] >= 2
+        assert summ["block_bytes"] > 0
+        assert summ["prompt_tokens_total"] == len(prompt)
+        assert [n["depth"] for n in doc["nodes"]] == [1, 2]
+        assert all(n["tier"] == "hbm" for n in doc["nodes"])
+        # Finished request: chain retained idle -> refcount False.
+        assert all(n["refcount"] is False for n in doc["nodes"])
+        # Depth/node caps bound the payload.
+        status, body = _get(srv.address, "/debug/kv?depth=1")
+        assert json.loads(body)["nodes"][-1]["depth"] == 1
+        status, body = _get(srv.address, "/debug/kv?n=1")
+        capped = json.loads(body)
+        assert len(capped["nodes"]) == 1 and capped["truncated"] == 1
+
+        # /healthz piggybacks the compact digest summary.
+        status, body = _get(srv.address, "/healthz")
+        kv = json.loads(body)["kv"]
+        assert kv["digest"]["version"] == summ["version"]
+        assert kv["digest"]["hash"] == summ["hash"]
+        assert kv["block_bytes"] == summ["block_bytes"]
+        assert kv["total_blocks"] == cb.n_blocks
+        assert kv["prompt_tokens_total"] == len(prompt)
+
+        # Per-session KV accounting on the timeline.
+        status, body = _get(srv.address, "/debug/requests/kv-dbg-1")
+        tl = json.loads(body)
+        assert tl["kv"]["blocks_held"] >= 3
+        assert tl["kv"]["prefix_hit_tokens"] == 0
+        # A revisit of the same prompt is a counted prefix hit.
+        req = urllib.request.Request(
+            srv.address + "/generate",
+            data=json.dumps(
+                {"prompt": prompt, "max_new_tokens": 4}
+            ).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "kv-dbg-2"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            assert r.status == 200
+        status, body = _get(srv.address, "/debug/requests/kv-dbg-2")
+        assert json.loads(body)["kv"]["prefix_hit_tokens"] == 32
 
 
 @pytest.mark.obs
