@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve bench-compare check
+.PHONY: tier1 faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve fleet bench-compare check
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -78,6 +78,20 @@ mesh-serve:
 	$(PYTEST) tests/test_serve_mesh.py tests/test_router.py -q
 	$(PYTEST) tests/test_faults.py -q -k router
 	$(PYTEST) tests/test_run_cli.py -q -k serve_mesh
+	env JAX_PLATFORMS=cpu python bench.py --multichip-serving
+
+# Globally cache-aware routing (router.py RouterRadixIndex + handoff
+# scheduler + prefill/decode disaggregation): the full cache-routing
+# suite (index/journal units, export/import bounds + demote-after-
+# export, the routed deep-hit / spill-migration / stale-digest /
+# mid-handoff-fault acceptance drills), the slow-marked CLI
+# disaggregation smoke (--route cache-aware --replica-roles), and the
+# fleet-TTFT A/B round (cache-aware vs least-loaded hit ratio +
+# dedup-by-migration — what MULTICHIP_r08.json records; add
+# `--record MULTICHIP_rNN.json` to roll a new round).
+fleet:
+	$(PYTEST) tests/test_cache_routing.py -q
+	$(PYTEST) tests/test_run_cli.py -q -k 'cache_aware or replica'
 	env JAX_PLATFORMS=cpu python bench.py --multichip-serving
 
 # Invariant auditor (jax_llama_tpu/analysis): host-boundary lint,

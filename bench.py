@@ -588,7 +588,138 @@ def multichip_serving_main(record_path=None) -> None:
         f"{fleet_scrape_ms} ms"
     )
 
-    ok = parity_ok and lowering_ok and routed_ok and fleet_ok
+    # -- 4. fleet-TTFT A/B: cache-aware vs least-loaded (r08) ---------------
+    # Deterministic revisit-heavy workload: 4 sessions, each visited
+    # 3 times with a growing prompt (the chat shape), posted
+    # SEQUENTIALLY so routing policy is the only variable.  Under
+    # least-loaded the revisits alternate replicas (half the turns
+    # re-prefill cold); cache-aware routes each turn to the replica
+    # holding the session's chain — the fleet prefix-hit-tokens ratio
+    # is the headline, per-request wall time the TTFT proxy (CPU
+    # behavior round; max_new=2 keeps the measurement
+    # prefill-dominated).
+    rng2 = np.random.RandomState(7)
+    bases = [rng2.randint(1, 512, size=48).tolist() for _ in range(4)]
+    turns = [
+        [b + rng2.randint(1, 512, size=16 * k).tolist()
+         for k in range(3)]
+        for b in bases
+    ]
+
+    def fleet_ab(policy):
+        servers = [
+            LLMServer(
+                ContinuousBatcher(
+                    params, config, n_slots=2, max_len=256,
+                    decode_chunk=8,
+                ),
+                replica_id=i,
+            ).start()
+            for i in range(2)
+        ]
+        router = ReplicaRouter(
+            servers, policy=policy, health_interval_s=0,
+            block_size=servers[0].batcher.block_size,
+        ).start()
+        lat: list = []
+        try:
+            # Warmup (compile paths) off the clock.
+            post(router.address,
+                 {"prompt": bases[0][:20], "max_new_tokens": 2})
+            router.check_health_now()
+            for round_i in range(3):
+                for s, session_turns in enumerate(turns):
+                    t0 = time.time()
+                    post(router.address, {
+                        "prompt": session_turns[round_i],
+                        "max_new_tokens": 2, "seed": s,
+                    })
+                    lat.append((time.time() - t0) * 1000.0)
+                router.check_health_now()
+            router.wait_handoffs(30.0)
+            hit = sum(
+                s.batcher.prefix_hit_tokens_total for s in servers
+            )
+            prompt_t = sum(
+                s.batcher.prompt_tokens_total for s in servers
+            )
+            with router._lock:
+                handoffs = router.handoffs_completed_total
+                stale = router.cache_stale_routes_total
+            lat.sort()
+            return {
+                "fleet_prefix_hit_ratio": round(
+                    hit / max(1, prompt_t), 6
+                ),
+                "prefix_hit_tokens_total": int(hit),
+                "prompt_tokens_total": int(prompt_t),
+                "ttft_ms_p50": round(lat[len(lat) // 2], 2),
+                "ttft_ms_p99": round(lat[-1], 2),
+                "handoffs_completed": int(handoffs),
+                "stale_routes": int(stale),
+            }, router, servers
+        except BaseException:
+            router.stop()
+            for s in servers:
+                s.stop()
+            raise
+
+    ll, ll_router, ll_servers = fleet_ab("least-loaded")
+    ll_router.stop()
+    for s in ll_servers:
+        s.stop()
+    ca, ca_router, ca_servers = fleet_ab("cache-aware")
+    try:
+        # Dedup-by-migration drill (the demote-after-export
+        # acceptance): publish one FRESH chain on BOTH replicas
+        # directly (fresh = no deeper session suffix hangs off it, so
+        # the leaves-first source drop can actually release it), then
+        # migrate it — fleet duplicate bytes must DECREASE (the
+        # source demotes/drops its copy; the destination already
+        # holding it makes the import a benign no-op).
+        dup_tokens = rng2.randint(1, 512, size=48).tolist()
+        dup_prompt = {"prompt": dup_tokens, "max_new_tokens": 2,
+                      "seed": 99}
+        for s in ca_servers:
+            post(s.address, dup_prompt)
+        ca_router.check_health_now()
+        dup_before = ca_router.fleet_kv_json()["fleet"][
+            "duplicate_kv_bytes"
+        ]
+        from jax_llama_tpu.router import chain_keys as _ck
+
+        # "prompt" payloads admit the raw token list verbatim — the
+        # chain keys recompute exactly.
+        keys_hex = [
+            k.hex() for k in _ck(
+                dup_tokens, ca_servers[0].batcher.block_size,
+            )
+        ]
+        ca_router.migrate_chain(keys_hex, src=0, dst=1)
+        assert ca_router.wait_handoffs(30.0)
+        dup_after = ca_router.fleet_kv_json()["fleet"][
+            "duplicate_kv_bytes"
+        ]
+    finally:
+        ca_router.stop()
+        for s in ca_servers:
+            s.stop()
+    ab_ok = (
+        ca["fleet_prefix_hit_ratio"] >= ll["fleet_prefix_hit_ratio"]
+        and dup_after < dup_before
+    )
+    tail.append(
+        f"dryrun_multichip_serving ok: fleet-TTFT A/B cache-aware "
+        f"hit ratio={ca['fleet_prefix_hit_ratio']} vs least-loaded "
+        f"{ll['fleet_prefix_hit_ratio']} (>= required: {ab_ok}), "
+        f"ttft p50 {ca['ttft_ms_p50']} vs {ll['ttft_ms_p50']} ms, "
+        f"duplicate bytes {dup_before} -> {dup_after} after "
+        f"demote-after-export handoff"
+    )
+
+    ok = (
+        parity_ok and lowering_ok and routed_ok and fleet_ok and ab_ok
+    )
     result = {
         "n_devices": n_devices,
         "rc": 0 if ok else 1,
@@ -616,6 +747,23 @@ def multichip_serving_main(record_path=None) -> None:
                 "per_replica_hit_ratio": per_replica_hit,
                 "digest_scrape_ms": fleet_scrape_ms,
                 "fleet_view_nonzero_duplicates": fleet_ok,
+            },
+            # r08: globally cache-aware routing A/B on the
+            # deterministic revisit-heavy workload — the hit-ratio
+            # delta is the router-side radix index earning its keep;
+            # the duplicate-bytes drop is the demote-after-export
+            # handoff deduplicating the fleet.  CPU behavior round —
+            # TTFT ms roll forward at the next TPU round.
+            "fleet_ab_r08": {
+                "workload": (
+                    "4 sessions x 3 growing turns, sequential, "
+                    "max_new=2"
+                ),
+                "cache_aware": ca,
+                "least_loaded": ll,
+                "duplicate_kv_bytes_before_handoff": dup_before,
+                "duplicate_kv_bytes_after_handoff": dup_after,
+                "cache_aware_ge_least_loaded": ab_ok,
             },
         },
     }

@@ -127,11 +127,12 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
         }),
     ),
     # Replica router (router.py): HTTP handler threads (forward /
-    # metrics / healthz) and the health-poller thread share the replica
-    # table, sticky-session map, routing counters, the router-local
-    # trace ring, the request-id routing record, and the cached fleet
-    # cache view — every access goes under the one lock.  The router
-    # holds no jax state.
+    # metrics / healthz), the health-poller thread, and the handoff
+    # worker share the replica table, sticky-session map, routing
+    # counters, the router-local trace ring, the request-id routing
+    # record, the handoff scheduler's dedup/bounds/outcome state, and
+    # the cached fleet cache view — every access goes under the one
+    # lock.  The router holds no jax state.
     LockGuard(
         module="router", cls="ReplicaRouter", lock="_lock",
         fields=frozenset({
@@ -139,6 +140,25 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
             "reroutes_total", "replica_failures_total",
             "kv_handoffs_total", "_trace", "_routes",
             "affinity_stale_routes_total", "_fleet_kv",
+            "cache_stale_routes_total",
+            "cache_hit_depth_blocks_total",
+            "_handoff_chains", "_handoff_bytes_inflight",
+            "handoffs_scheduled_total", "handoffs_completed_total",
+            "handoffs_aborted_total", "handoffs_skipped_total",
+            "handoffs_empty_total", "handoff_blocks_total",
+            "handoff_bytes_total", "_role_handoffs_pending",
+        }),
+    ),
+    # Router-side global radix index (router.py): the health poller
+    # writes syncs, handler threads read lookups at pick time, the
+    # handoff worker applies optimistic post-migration updates — all
+    # under the index's own leaf lock (lock order router -> index,
+    # never inverted: the sync/lookup paths take only this lock).
+    LockGuard(
+        module="router", cls="RouterRadixIndex", lock="_lock",
+        fields=frozenset({
+            "_by_replica", "_synced", "_epoch", "_block_bytes",
+            "syncs_total", "resyncs_total", "events_applied_total",
         }),
     ),
     # KV chain digest (kvcache.py): the serving loop mutates it at
@@ -153,7 +173,7 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
             "_entries", "_seq", "_hash", "_hbm", "_host", "_idle",
             "version", "loss_version", "depth_max",
             "publishes_total", "evictions_total", "demotions_total",
-            "restores_total", "host_evictions_total",
+            "restores_total", "host_evictions_total", "_journal",
         }),
     ),
 )
@@ -182,7 +202,7 @@ CONFINEMENTS: Tuple[ThreadConfinement, ...] = (
         # Methods documented/observed to run on HTTP-handler threads.
         foreign_methods=frozenset({
             "stats", "_window_acceptance", "acceptance_rate",
-            "kv_debug_json",
+            "kv_debug_json", "_kv_summary",
         }),
         holders=frozenset({"batcher"}),
     ),
@@ -202,6 +222,10 @@ CONFINEMENTS: Tuple[ThreadConfinement, ...] = (
             "_watchdog", "_health", "_metrics_text",
             "_handle_profiler", "_retry_after_s", "begin_drain",
             "wait_drained", "draining", "address", "stop", "start",
+            # The handoff scheduler's control path: queues work for
+            # the loop thread (thread-safe queue) and waits on the
+            # call's own event — no confined field is touched.
+            "call_on_loop",
         }),
         holders=frozenset({"server"}),
     ),

@@ -412,6 +412,7 @@ def _make_batcher_stub():
         _window_acceptance = ContinuousBatcher._window_acceptance
         acceptance_rate = ContinuousBatcher.acceptance_rate
         kv_debug_json = ContinuousBatcher.kv_debug_json
+        _kv_summary = ContinuousBatcher._kv_summary
 
     s = _StubBatcher()
     s.fault_injector = None
@@ -465,6 +466,9 @@ def _make_batcher_stub():
     s.block_size = 16
     s.kv_export_events_total = 0
     s.kv_import_events_total = 0
+    # Handoff hardening (r14): the abort/demote ledger stats() reads.
+    s.kv_handoff_aborted_total = 0
+    s.kv_export_demoted_blocks_total = 0
     return s
 
 
@@ -584,7 +588,10 @@ def _model_kv_debug() -> ScheduleModel:
 
     return ScheduleModel(
         name="kv-debug-digest-snapshot",
-        module="serving", func="kv_debug_json", claim="snapshot",
+        # The pragma site lives in _kv_summary (the factored summary
+        # helper kv_debug_json and the incremental ?since= reply both
+        # call); the reader still drives the full public entry point.
+        module="serving", func="_kv_summary", claim="snapshot",
         make=_make_batcher_stub,
         writers={"loop": (
             Op("publish", loop_publish, frozenset({

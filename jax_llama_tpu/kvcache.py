@@ -65,7 +65,8 @@ import dataclasses
 import functools
 import hashlib
 import threading
-from collections import OrderedDict
+import uuid
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -115,10 +116,31 @@ class KvDigest:
 
     ``hash`` is an order-independent XOR set-hash over (chain key,
     residency tier): equal for equal published content, cheap to
-    maintain under removals (XOR is its own inverse)."""
+    maintain under removals (XOR is its own inverse).
+
+    The **event journal** (``_journal``, bounded deque) records every
+    content mutation as ``(version, op, key_hex, depth, tier)`` so a
+    consumer holding version V can catch up INCREMENTALLY
+    (:meth:`events_since`) instead of re-walking the whole tree — the
+    router-side global radix index syncs off it, paying O(changes)
+    per poll instead of O(nodes).  A consumer whose V fell out of the
+    bounded window (or predates a rebuild) gets ``None`` and must
+    full-resync via :meth:`nodes_json`."""
+
+    # Journal window: at ~60 B/event this bounds the journal at a few
+    # hundred KB while covering thousands of mutations between health
+    # polls — a poller more than JOURNAL_MAX versions behind resyncs.
+    JOURNAL_MAX = 4096
 
     def __init__(self):
         self._lock = threading.Lock()
+        # Instance identity: versions RESET on rebuild, so a consumer
+        # comparing versions alone can be fooled when a rebuild's
+        # replay re-advances past its synced version (version
+        # aliasing across histories).  The epoch is ctor-stable and
+        # unique per digest instance — a consumer that sees it change
+        # must full-resync regardless of version arithmetic.
+        self.epoch = uuid.uuid4().hex[:16]
         # key -> [depth, tier("hbm"|"host"), idle(bool), seq]
         self._entries: Dict[bytes, List[Any]] = {}
         self._seq = 0
@@ -134,6 +156,16 @@ class KvDigest:
         self.demotions_total = 0
         self.restores_total = 0
         self.host_evictions_total = 0
+        # (version, op, key_hex, depth, tier) content-mutation journal.
+        self._journal: "deque[Tuple[int, str, str, int, str]]" = deque(
+            maxlen=self.JOURNAL_MAX
+        )
+
+    def _journal_locked(self, op: str, key: bytes, depth: int,
+                        tier: str) -> None:
+        self._journal.append(
+            (self.version, op, key.hex(), int(depth), tier)
+        )
 
     # -- mutation hooks (store/serving-loop thread) -------------------------
 
@@ -170,6 +202,7 @@ class KvDigest:
                 self._set_tier_locked(ent, key, "hbm")
             self.publishes_total += 1
             self.version += 1
+            self._journal_locked("publish", key, int(depth), "hbm")
 
     def on_remove(self, key: bytes) -> None:
         """``key`` left the index entirely (eviction drop, non-finite
@@ -188,6 +221,7 @@ class KvDigest:
             self.evictions_total += 1
             self.version += 1
             self.loss_version += 1
+            self._journal_locked("remove", key, ent[0], ent[1])
 
     def on_demote(self, key: bytes) -> None:
         """HBM -> host-tier demotion (stays matchable, loses HBM)."""
@@ -199,6 +233,7 @@ class KvDigest:
             self.demotions_total += 1
             self.version += 1
             self.loss_version += 1
+            self._journal_locked("demote", key, ent[0], "host")
 
     def on_restore(self, key: bytes) -> None:
         """Host-tier -> HBM swap-in landed."""
@@ -209,6 +244,7 @@ class KvDigest:
             self._set_tier_locked(ent, key, "hbm")
             self.restores_total += 1
             self.version += 1
+            self._journal_locked("restore", key, ent[0], "hbm")
 
     def on_host_evict(self, key: bytes) -> None:
         """The host tier's LRU dropped ``key``'s slab (the node itself
@@ -217,6 +253,11 @@ class KvDigest:
             self.host_evictions_total += 1
             self.version += 1
             self.loss_version += 1
+            # Journaled so every version bump has a row (exact gap
+            # detection in events_since); index consumers ignore the
+            # op — the node's REMOVAL, when the slab loss strands it,
+            # journals separately via on_remove.
+            self._journal_locked("host_evict", key, 0, "host")
 
     def on_idle(self, key: bytes, idle: bool) -> None:
         """Refcount-boundary flip: idle (refcount 0, evictable) vs
@@ -239,6 +280,7 @@ class KvDigest:
         it for free; no new poll endpoint)."""
         with self._lock:
             return {
+                "epoch": self.epoch,
                 "version": self.version,
                 "loss_version": self.loss_version,
                 "hash": format(self._hash, "016x"),
@@ -253,6 +295,33 @@ class KvDigest:
                 "restores_total": self.restores_total,
                 "host_evictions_total": self.host_evictions_total,
             }
+
+    def events_since(
+        self, since: int,
+    ) -> Optional[Tuple[List[Dict[str, Any]], int]]:
+        """``(events, version)``: content mutations with
+        ``version > since`` (oldest first) plus the digest version they
+        bring the consumer to, captured under ONE lock hold so the
+        pair is never torn — the incremental-sync payload behind
+        ``GET /debug/kv?since=V``.
+
+        Returns ``None`` when the journal cannot prove completeness
+        and the consumer must full-resync via :meth:`nodes_json`:
+        ``since`` beyond the current version (a rebuild reset the
+        digest), or the bounded journal already dropped events the
+        consumer needs."""
+        with self._lock:
+            if since > self.version:
+                return None  # rebuild reset: consumer is from the past
+            if since == self.version:
+                return [], self.version
+            if not self._journal or self._journal[0][0] > since + 1:
+                return None  # window lost events the consumer needs
+            return [
+                {"version": v, "op": op, "key": k, "depth": d,
+                 "tier": t}
+                for v, op, k, d, t in self._journal if v > since
+            ], self.version
 
     def nodes_json(self, depth: Optional[int] = None,
                    max_nodes: int = 2048) -> Dict[str, Any]:
@@ -570,28 +639,8 @@ class RadixPrefixStore:
             return None, []
         if self.tier is not None and demote is not None:
             key, node = next(iter(self._idle.items()))
-            blk = node.block
-            slab = demote(blk)
-            del self._idle[key]
-            del self._by_block[blk]
-            node.block = None
-            node.host = slab
-            self.digest.on_demote(key)
-            self._event("kv_demote", block=blk, depth=node.depth)
-            extra: List[int] = []
-            for ekey in self.tier.put(key, slab):
-                # Host-LRU victim: its node loses the slab; if that
-                # leaves it unreachable, drop its (now unreachable)
-                # subtree too.
-                enode = self._by_key.get(ekey)
-                if enode is None:
-                    continue
-                enode.host = None
-                self.digest.on_host_evict(ekey)
-                self._event("kv_host_evict", depth=enode.depth)
-                if enode.block is None:
-                    extra.extend(self._drop_subtree(enode))
-            return blk, extra
+            blk = self._demote_node(key, node, demote)
+            return blk, self._host_put(key, node.host)
         # Drop path (no tier): leaves first.
         chosen = None
         for key, node in self._idle.items():
@@ -606,6 +655,84 @@ class RadixPrefixStore:
         extra = self._drop_subtree(chosen)
         extra.remove(blk)
         return blk, extra
+
+    def _demote_node(
+        self, key: bytes, node: RadixNode,
+        demote: Callable[[int], Dict[str, np.ndarray]],
+    ) -> int:
+        """Demote one idle HBM-resident node into a host slab (caller
+        guarantees idleness and residency); returns the freed block.
+        The slab lands on ``node.host`` — the caller feeds it to
+        :meth:`_host_put` for tier insertion + LRU fallout."""
+        blk = node.block
+        slab = demote(blk)
+        del self._idle[key]
+        del self._by_block[blk]
+        node.block = None
+        node.host = slab
+        self.digest.on_demote(key)
+        self._event("kv_demote", block=blk, depth=node.depth)
+        return blk
+
+    def _host_put(
+        self, key: bytes, slab: Dict[str, np.ndarray],
+    ) -> List[int]:
+        """Insert a demoted slab into the host tier; host-LRU victims
+        lose their slab (and their now-unreachable subtrees drop),
+        returning any idle blocks that strands for the caller to
+        free."""
+        extra: List[int] = []
+        for ekey in self.tier.put(key, slab):
+            enode = self._by_key.get(ekey)
+            if enode is None:
+                continue
+            enode.host = None
+            self.digest.on_host_evict(ekey)
+            self._event("kv_host_evict", depth=enode.depth)
+            if enode.block is None:
+                extra.extend(self._drop_subtree(enode))
+        return extra
+
+    def demote_keys(
+        self,
+        keys: Sequence[bytes],
+        demote: Optional[
+            Callable[[int], Dict[str, np.ndarray]]
+        ] = None,
+    ) -> List[int]:
+        """TARGETED demotion of one exported chain (the
+        demote-after-export half of a cross-replica handoff): each
+        key's node, if idle and HBM-resident, demotes into the host
+        tier (stays matchable) — or, with no tier, DROPS when nothing
+        reachable hangs below it (leaves-first; an interior node with
+        a resident suffix is kept so the drop never strands it).
+        Claimed (refcount>0) nodes are skipped — a live session's KV
+        never moves under it.  Returns the freed HBM blocks (plus any
+        host-LRU fallout) for the caller to invalidate+free.  Walks
+        deepest-first so the no-tier drop path sees leaves before
+        their parents."""
+        freed: List[int] = []
+        for key in reversed(list(keys)):
+            node = self._by_key.get(key)
+            if (
+                node is None or node.block is None
+                or key not in self._idle
+            ):
+                continue
+            if self.tier is not None and demote is not None:
+                blk = self._demote_node(key, node, demote)
+                freed.append(blk)
+                freed.extend(self._host_put(key, node.host))
+            else:
+                if any(c.reachable or c.restoring
+                       for c in node.children.values()):
+                    continue  # resident suffix below: keep the node
+                blk = node.block
+                self._event(
+                    "kv_evict", block=blk, depth=node.depth
+                )
+                freed.extend(self._drop_subtree(node))
+        return freed
 
     # -- swap-in lifecycle --------------------------------------------------
 
@@ -736,6 +863,11 @@ class ExactPrefixStore:
         self.unpublish(blk)
         return blk, []
 
+    def demote_keys(self, keys, demote=None) -> List[int]:
+        """Demote-after-export is a radix/tier feature; the exact
+        oracle keeps its published chains in place."""
+        return []
+
     def pin_restoring(self, nodes) -> None:  # pragma: no cover - no tier
         raise AssertionError("exact store has no host tier")
 
@@ -783,6 +915,9 @@ class NullPrefixStore:
 
     def pop_evictable(self, demote=None) -> Tuple[Optional[int], List[int]]:
         return None, []
+
+    def demote_keys(self, keys, demote=None) -> List[int]:
+        return []
 
     def cached_blocks(self) -> int:
         return 0

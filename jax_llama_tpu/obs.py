@@ -325,6 +325,14 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "counter", "Prefix handoff exports that moved >= 1 block"),
     "kv_import_events_total": _reg(
         "counter", "Prefix handoff imports that landed >= 1 block"),
+    "kv_handoff_aborted_total": _reg(
+        "counter", "Prefix handoff imports that hit the wall timeout "
+                   "and unwound cleanly (blocks freed, nothing "
+                   "published)"),
+    "kv_export_demoted_blocks_total": _reg(
+        "counter", "Exported prefix blocks demoted/dropped at the "
+                   "source after a handoff (demote-after-export: the "
+                   "migration deduplicates fleet HBM)"),
     "serve_mesh_data": _reg(
         "gauge", "Serving-mesh row shards (data*fsdp axes; 1 off-mesh)"),
     "serve_mesh_tensor": _reg(

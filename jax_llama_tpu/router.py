@@ -17,6 +17,55 @@ to one of them:
     local instead of re-prefilling cold on a random replica.  New
     sessions fall back to least-loaded; a dead replica's sessions
     re-pin wherever their next turn lands.
+  * **cache-aware**: GLOBALLY cache-aware routing off the router-side
+    radix index (below) — each request routes to the replica holding
+    the DEEPEST matching chain prefix fleet-wide, spilling to
+    least-loaded past the occupancy watermark (``spill_occupancy``,
+    in-flight/slots).  Replaces affinity's single-pinned-replica LRU
+    with exact fleet-wide knowledge: N replicas behave as ONE
+    coherent prefix cache, so fleet TTFT tracks the global hit depth,
+    not per-replica luck.
+
+**Global radix index** (:class:`RouterRadixIndex`, cache-aware
+policy): every replica's chain digest folded into one map
+``chain-prefix key -> {replica: (depth, tier)}``.  Kept fresh
+INCREMENTALLY off the /healthz poller — a digest ``version`` delta in
+the scrape triggers ``GET /debug/kv?since=<synced>``, whose journaled
+events (publish/remove/demote/restore) replay into the index at
+O(changes); the bounded journal falling short (rebuild reset, poller
+too far behind) falls back to one full node-walk replace.  The
+request's own chain keys come from :func:`chain_keys` (the ONE shared
+key schema; tokenization happens on the router thread OUTSIDE the
+routing lock, mirroring the replica's own /generate-/chat encoding).
+A hit whose holder's LIVE digest version has moved past the synced
+one routes anyway but counts ``llm_router_cache_stale_routes_total``
+— it degrades to a cold prefill, never to wrong tokens.
+
+**Handoff scheduler**: when the deepest-prefix replica sits past the
+occupancy watermark the request spills to least-loaded, and — when
+``depth x (occupancy gap)`` clears ``handoff_threshold`` (and depth
+>= ``handoff_min_depth``) — the chain MIGRATES to where the request
+landed: a background worker drives ``export_prefix`` (with
+``demote_after_export``, so the move deduplicates fleet HBM) on the
+source's serving-loop thread and ``import_prefix`` on the
+destination's, through ``LLMServer.call_on_loop`` (the batchers stay
+thread-confined).  Bounds: at most ONE in-flight handoff per chain,
+``handoff_max_bytes_inflight`` total estimated bytes moving,
+``handoff_timeout_s`` wall budget per job (timeouts unwind cleanly on
+both sides and count as aborted; the serving side owns the
+no-partial-publish contract).  The triggering request NEVER waits —
+its first token rides a cold prefill on the spill target; the next
+turn hits warm.
+
+**Prefill/decode disaggregation** (``roles=("prefill", "decode",
+...)``, run.py ``--replica-roles``; requires cache-aware): cold
+prompts route to the least-loaded PREFILL replica; a request
+completing there streams its freshly published chain to the
+least-loaded decode replica via the same export->import path, and the
+session's routing record re-pins at the destination — so first turns
+prefill on the prefill pool and every revisit decodes warm on the
+decode pool.  Deep index hits route to the holding replica regardless
+of role (the KV is there).
 
 **Health / quarantine.**  A poller thread scrapes each replica's
 ``/healthz`` (the server's own ok/draining/degraded verdict — a replica
@@ -29,16 +78,10 @@ replica are that replica's own crash-recovery problem (rebuild + replay
 — the PR-1 machinery), not the router's: the router never duplicates a
 request it may have half-delivered.
 
-**Prefill/decode disaggregation (skeleton).**  :func:`handoff_prefix`
-moves a session's cached prefix blocks between two batchers through the
-existing host-tier primitives (``export_prefix`` D2H slab fetch on the
-prefill side, ``import_prefix`` stage+adopt+publish on the decode
-side), so an admission can prefill on one replica and decode on
-another that receives its KV as a plain prefix hit.  The router counts
-handoffs; scheduling WHEN to disaggregate (prefill-heavy vs
-decode-heavy replica pools) is the open half — both batcher calls must
-run on their owning serving-loop threads, so a live-traffic router
-drives them through the replicas' control paths, not directly.
+:func:`handoff_prefix` remains the direct two-batcher handoff helper
+(tests/drills drive it on the owning threads); live traffic goes
+through the scheduler above, which reaches each batcher via its
+server's control path (``call_on_loop``).
 
 HTTP surface (the router speaks the same protocol as a single server,
 so clients need no changes):
@@ -125,28 +168,218 @@ still routes there, but as a counted, logged stale event
 observed version so one loss event counts once) instead of a silent
 cache miss.
 
-Thread discipline: handler threads (forward) and the health poller
-share the replica table, counters, routing record, trace ring, and
-the cached fleet cache view — every access goes under ``_lock``
-(registered in analysis/lockcheck.py).  The router holds no jax state
-at all; it is pure host-side HTTP."""
+Thread discipline: handler threads (forward), the health poller, and
+the handoff worker share the replica table, counters, routing record,
+trace ring, the handoff scheduler's dedup/bounds state, and the
+cached fleet cache view — every access goes under ``_lock``
+(registered in analysis/lockcheck.py).  The global radix index keeps
+its own leaf lock (lock order router -> index, never inverted).  The
+router holds no jax state at all; it is pure host-side HTTP — batcher
+work it schedules runs on the replicas' own serving-loop threads via
+``LLMServer.call_on_loop``."""
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import json
+import queue
 import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 from urllib.parse import parse_qs, unquote, urlsplit
+
+import numpy as np
 
 from .faults import FaultInjector, InjectedFault
 from .obs import StructuredLogger
 
-POLICIES = ("least-loaded", "affinity")
+POLICIES = ("least-loaded", "affinity", "cache-aware")
+ROLES = ("prefill", "decode")
+
+
+def chain_keys(tokens: Sequence[int], block_size: int) -> List[bytes]:
+    """Chain hash per FULL prompt block: ``key_j = H(key_{j-1},
+    block-j tokens)``, so a hit at block j certifies the whole prefix
+    up to it.  Only blocks strictly before the last token are keyed
+    (at least one token must run through the model to produce the
+    first sample).
+
+    THE one shared key schema of the prefix-cache stack: the batcher's
+    radix index, the KvDigest journal, and the router's global radix
+    index all speak these keys — ``ContinuousBatcher._chain_keys``
+    delegates here (the helper lives in this module because the
+    router must stay jax-free)."""
+    m = (len(tokens) - 1) // block_size
+    keys: List[bytes] = []
+    h = hashlib.blake2b(digest_size=16)
+    for j in range(m):
+        h.update(
+            np.asarray(
+                tokens[j * block_size:(j + 1) * block_size], np.int32
+            ).tobytes()
+        )
+        keys.append(h.digest())  # digest() is non-destructive
+    return keys
+
+
+class RouterRadixIndex:
+    """The router-side GLOBAL radix index: every replica's published
+    chain digest folded into one map ``chain-prefix key -> {replica:
+    (depth, tier)}``, so the cache-aware policy can route each request
+    to the replica holding the DEEPEST matching prefix fleet-wide.
+
+    Kept fresh INCREMENTALLY off the health poller: each successful
+    ``/healthz`` scrape carries the replica's O(1) digest summary;
+    when its ``version`` differs from the index's last synced version
+    the poller fetches ``GET /debug/kv?since=<synced>`` and applies
+    the journaled events (``publish``/``remove``/``demote``/
+    ``restore`` — ``host_evict`` is a counter-only bump), falling back
+    to a full node-walk replace when the bounded journal cannot prove
+    completeness (consumer too far behind, or a crash-recovery rebuild
+    reset the digest).  O(changes) per poll, not O(nodes).
+
+    Thread discipline: own leaf ``_lock`` (registered in
+    analysis/lockcheck.py) — the health poller writes, handler threads
+    read at pick time, the handoff worker applies optimistic updates.
+    The router's ``_lock`` may be held while calling in (lock order
+    router -> index, never inverted: sync paths take only this
+    lock)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # replica -> {key_hex: (depth, tier)}
+        self._by_replica: Dict[int, Dict[str, Tuple[int, str]]] = {}
+        # replica -> last applied digest version
+        self._synced: Dict[int, int] = {}
+        # replica -> digest epoch the synced version belongs to: a
+        # rebuild resets versions AND mints a new epoch, so version
+        # arithmetic across epochs is meaningless (a replay can
+        # re-advance past the synced version — version aliasing) and
+        # the consumer must full-resync on any epoch change.
+        self._epoch: Dict[int, Any] = {}
+        # replica -> block_bytes (handoff byte-budget pricing)
+        self._block_bytes: Dict[int, int] = {}
+        self.syncs_total = 0
+        self.resyncs_total = 0
+        self.events_applied_total = 0
+
+    def synced_version(self, replica: int) -> Optional[int]:
+        with self._lock:
+            return self._synced.get(replica)
+
+    def synced_epoch(self, replica: int) -> Optional[Any]:
+        with self._lock:
+            return self._epoch.get(replica)
+
+    def block_bytes(self, replica: int) -> int:
+        with self._lock:
+            return self._block_bytes.get(replica, 0)
+
+    def replace(self, replica: int, nodes: Sequence[Dict[str, Any]],
+                version: int, block_bytes: int = 0,
+                epoch: Any = None) -> None:
+        """Full resync: adopt a replica's complete node walk."""
+        table = {
+            str(n["key"]): (int(n.get("depth", 0)),
+                            str(n.get("tier", "hbm")))
+            for n in nodes if isinstance(n, dict) and n.get("key")
+        }
+        with self._lock:
+            self._by_replica[replica] = table
+            self._synced[replica] = int(version)
+            if epoch is not None:
+                self._epoch[replica] = epoch
+            if block_bytes:
+                self._block_bytes[replica] = int(block_bytes)
+            self.syncs_total += 1
+            self.resyncs_total += 1
+
+    def apply_events(self, replica: int,
+                     events: Sequence[Dict[str, Any]],
+                     version: int, block_bytes: int = 0,
+                     epoch: Any = None) -> None:
+        """Incremental sync: apply journaled digest mutations in
+        order (idempotent per event — optimistic handoff updates may
+        have pre-applied some)."""
+        with self._lock:
+            table = self._by_replica.setdefault(replica, {})
+            for ev in events:
+                op = ev.get("op")
+                key = str(ev.get("key"))
+                if op == "publish":
+                    table[key] = (int(ev.get("depth", 0)), "hbm")
+                elif op == "remove":
+                    table.pop(key, None)
+                elif op in ("demote", "restore"):
+                    ent = table.get(key)
+                    depth = (
+                        ent[0] if ent is not None
+                        else int(ev.get("depth", 0))
+                    )
+                    table[key] = (
+                        depth, "host" if op == "demote" else "hbm"
+                    )
+                # host_evict: counter-only (removal journals itself)
+            self._synced[replica] = int(version)
+            if epoch is not None:
+                self._epoch[replica] = epoch
+            if block_bytes:
+                self._block_bytes[replica] = int(block_bytes)
+            self.syncs_total += 1
+            self.events_applied_total += len(events)
+
+    def note_handoff(self, src: int, dst: int,
+                     keys_hex: Sequence[str]) -> None:
+        """Optimistic post-handoff update so the NEXT request routes
+        to the chain's new home immediately (the poller's sync
+        confirms/corrects at the next scrape): the destination gains
+        the chain HBM-resident, the demoted-after-export source drops
+        to host tier."""
+        with self._lock:
+            dmap = self._by_replica.setdefault(dst, {})
+            smap = self._by_replica.setdefault(src, {})
+            for i, k in enumerate(keys_hex):
+                ent = smap.get(k)
+                depth = ent[0] if ent is not None else i + 1
+                dmap[k] = (depth, "hbm")
+                if ent is not None:
+                    smap[k] = (depth, "host")
+
+    def lookup(
+        self, keys_hex: Sequence[str], replicas: Set[int],
+    ) -> Optional[Tuple[int, List[Tuple[int, str]]]]:
+        """Deepest fleet-wide prefix match: walk the chain keys from
+        the leaf back toward the root; the first key held by any of
+        ``replicas`` wins.  Returns ``(depth, [(replica, tier),...])``
+        — depth in blocks (1-based), holders of that deepest key —
+        or None on a fleet-wide miss."""
+        with self._lock:
+            for i in range(len(keys_hex) - 1, -1, -1):
+                k = keys_hex[i]
+                holders = [
+                    (r, self._by_replica[r][k][1])
+                    for r in replicas
+                    if k in self._by_replica.get(r, {})
+                ]
+                if holders:
+                    return i + 1, holders
+        return None
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "nodes": sum(
+                    len(t) for t in self._by_replica.values()
+                ),
+                "replicas_synced": len(self._synced),
+                "syncs_total": self.syncs_total,
+                "resyncs_total": self.resyncs_total,
+                "events_applied_total": self.events_applied_total,
+            }
 
 
 class _ClientDisconnect(Exception):
@@ -251,6 +484,19 @@ class ReplicaRouter:
         affinity_max_sessions: int = 4096,
         fault_injector: Optional[FaultInjector] = None,
         logger: Optional[StructuredLogger] = None,
+        # -- cache-aware routing (policy="cache-aware") -----------------
+        tokenizer: Any = None,
+        block_size: Optional[int] = None,
+        chat_format: Any = None,
+        roles: Optional[Sequence[str]] = None,
+        spill_occupancy: float = 1.0,
+        # -- handoff scheduler ------------------------------------------
+        handoff_threshold: float = 1.0,
+        handoff_min_depth: int = 1,
+        handoff_max_bytes: int = 256 << 20,
+        handoff_max_bytes_inflight: int = 64 << 20,
+        handoff_timeout_s: float = 30.0,
+        demote_after_export: bool = True,
     ):
         if policy not in POLICIES:
             raise ValueError(
@@ -258,12 +504,53 @@ class ReplicaRouter:
             )
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
+        if roles is not None:
+            roles = tuple(str(r) for r in roles)
+            if len(roles) != len(replicas):
+                raise ValueError(
+                    f"roles ({len(roles)}) must name every replica "
+                    f"({len(replicas)})"
+                )
+            bad = sorted(set(roles) - set(ROLES))
+            if bad:
+                raise ValueError(
+                    f"unknown replica roles {bad}; have {ROLES}"
+                )
+            if not ("prefill" in roles and "decode" in roles):
+                raise ValueError(
+                    "prefill/decode disaggregation needs at least one "
+                    "replica of EACH role"
+                )
+            if policy != "cache-aware":
+                raise ValueError(
+                    "replica roles require the cache-aware policy "
+                    "(the disaggregation scheduler routes off the "
+                    "global radix index)"
+                )
+        if policy == "cache-aware" and block_size is None:
+            raise ValueError(
+                "cache-aware routing needs block_size (the chain-key "
+                "granularity every replica's radix index uses)"
+            )
         self.policy = policy
         self.fault_injector = fault_injector
         self.logger = logger
         self.health_interval_s = float(health_interval_s)
         self.proxy_timeout_s = float(proxy_timeout_s)
         self.affinity_max_sessions = int(affinity_max_sessions)
+        # Cache-aware routing + handoff scheduling knobs (ctor-stable).
+        self.tokenizer = tokenizer
+        self.block_size = block_size
+        self.chat_format = chat_format
+        self.roles = roles
+        self.spill_occupancy = float(spill_occupancy)
+        self.handoff_threshold = float(handoff_threshold)
+        self.handoff_min_depth = int(handoff_min_depth)
+        self.handoff_max_bytes = int(handoff_max_bytes)
+        self.handoff_max_bytes_inflight = int(handoff_max_bytes_inflight)
+        self.handoff_timeout_s = float(handoff_timeout_s)
+        self.demote_after_export = bool(demote_after_export)
+        self.index = RouterRadixIndex()
         self._lock = threading.Lock()
         self._replicas: List[_Replica] = []
         for i, rep in enumerate(replicas):
@@ -290,11 +577,37 @@ class ReplicaRouter:
         self._affinity: "OrderedDict[bytes, List[Any]]" = OrderedDict()
         self.routed_by_policy: Dict[str, int] = {
             "least-loaded": 0, "affinity": 0, "reroute": 0,
+            "cache-aware": 0, "spill": 0, "prefill-role": 0,
         }
         self.reroutes_total = 0
         self.replica_failures_total = 0
         self.kv_handoffs_total = 0
         self.affinity_stale_routes_total = 0
+        # Cache-aware routing counters: stale = the index said HIT but
+        # the holder's live digest version moved past the synced one
+        # (eviction / rebuild mid-flight) — routed anyway, counted,
+        # degrades to a cold prefill, never to wrong tokens.
+        self.cache_stale_routes_total = 0
+        self.cache_hit_depth_blocks_total = 0
+        # Handoff scheduler state: per-chain in-flight dedup (at most
+        # ONE in-flight handoff per chain), bytes-in-flight bound, and
+        # the outcome ledger.  The job queue itself is a thread-safe
+        # queue drained by the router-handoff worker.
+        self._handoff_chains: Set[str] = set()
+        self._handoff_bytes_inflight = 0
+        # Role-handoff intents registered at route time, cleared once
+        # _maybe_role_handoff ran (or the attempt failed) — lets
+        # wait_handoffs() see a migration that a just-completed reply
+        # is about to schedule.
+        self._role_handoffs_pending = 0
+        self.handoffs_scheduled_total = 0
+        self.handoffs_completed_total = 0
+        self.handoffs_aborted_total = 0
+        self.handoffs_skipped_total = 0
+        self.handoffs_empty_total = 0
+        self.handoff_blocks_total = 0
+        self.handoff_bytes_total = 0
+        self._handoff_q: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         # Last computed fleet cache view (fleet_kv_json fills it; the
         # /metrics fleet gauges read it) — None until the first
         # GET /debug/kv/fleet.
@@ -334,6 +647,10 @@ class ReplicaRouter:
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="router-health",
         )
+        self._handoff_thread = threading.Thread(
+            target=self._handoff_loop, daemon=True,
+            name="router-handoff",
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -345,6 +662,7 @@ class ReplicaRouter:
     def start(self) -> "ReplicaRouter":
         self._http_thread.start()
         self._health_thread.start()
+        self._handoff_thread.start()
         return self
 
     def stop(self) -> None:
@@ -353,6 +671,8 @@ class ReplicaRouter:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._health_thread.join(timeout=5)
+        if self._handoff_thread.is_alive():
+            self._handoff_thread.join(timeout=5)
 
     def __enter__(self) -> "ReplicaRouter":
         return self.start()
@@ -428,6 +748,11 @@ class ReplicaRouter:
                     if payload:
                         rep.last_health = payload
                         rep.last_health_t = time.monotonic()
+                if payload:
+                    # Global radix index sync rides the poll for free:
+                    # only a digest-version DELTA triggers the (mostly
+                    # incremental) /debug/kv fetch.
+                    self._sync_index(rep, payload)
                 if was != ok:
                     self._log(
                         "router_replica_health",
@@ -449,6 +774,78 @@ class ReplicaRouter:
                 if payload:
                     rep.last_health = payload
                     rep.last_health_t = time.monotonic()
+            if payload:
+                self._sync_index(rep, payload)
+
+    def _sync_index(self, rep: _Replica,
+                    payload: Dict[str, Any]) -> None:
+        """Fold ``rep``'s chain digest into the global radix index
+        when its version moved past the last synced one.  Runs on the
+        poller thread (or check_health_now's caller) OUTSIDE the
+        router lock — the /debug/kv fetch is an HTTP round-trip.
+        Incremental (``?since=``, journal replay) whenever the
+        replica's bounded journal covers the gap; full node-walk
+        replace otherwise."""
+        if self.policy != "cache-aware":
+            return  # least-loaded/affinity never read the index
+        dig = (payload.get("kv") or {}).get("digest") or {}
+        ver = dig.get("version")
+        if ver is None:
+            return  # pre-digest replica
+        since = self.index.synced_version(rep.index)
+        if dig.get("epoch") != self.index.synced_epoch(rep.index):
+            # A rebuild minted a new digest instance: versions live in
+            # a different history (a replay can re-advance PAST the
+            # synced version — version aliasing), so incremental
+            # deltas are meaningless.  Full resync.
+            since = None
+        if since is not None and since == ver:
+            return
+        # Both forms ask for an effectively unbounded node count: the
+        # ``since`` form can FALL BACK to the full walk server-side
+        # (journal gap), and the server default (2048) would silently
+        # truncate large pools — adopting a truncated walk as the
+        # replica's complete table would hide exactly the deepest
+        # (most valuable) chains from the index with no later repair
+        # (occupancy is bounded by the replica's pool blocks, so the
+        # payload stays sane).
+        path = (
+            "/debug/kv?n=1000000" if since is None
+            else f"/debug/kv?since={since}&n=1000000"
+        )
+        got = self._get_replica_json(rep, path)
+        if got is None or got[0] != 200:
+            return
+        doc = got[1]
+        summ = doc.get("summary") or {}
+        bb = int(summ.get("block_bytes") or 0)
+        epoch = summ.get("epoch", dig.get("epoch"))
+        applied = doc.get("version", ver)
+        if "events" in doc:
+            self.index.apply_events(
+                rep.index, doc["events"], applied, bb, epoch=epoch,
+            )
+        else:
+            if doc.get("truncated"):
+                self._log(
+                    "router_index_truncated_sync",
+                    replica=rep.index,
+                    truncated=doc.get("truncated"),
+                )
+            self.index.replace(
+                rep.index, doc.get("nodes", []), applied, bb,
+                epoch=epoch,
+            )
+        if applied != ver:
+            # The digest moved between the /healthz scrape and the
+            # /debug/kv fetch: the index is now FRESHER than the
+            # stored health snapshot.  Refresh the snapshot's version
+            # so the pick-time staleness check (synced != live) does
+            # not miscount every hit as stale until the next poll.
+            with self._lock:
+                dig2 = (rep.last_health.get("kv") or {}).get("digest")
+                if isinstance(dig2, dict):
+                    dig2["version"] = applied
 
     # -- routing -------------------------------------------------------------
 
@@ -473,26 +870,163 @@ class ReplicaRouter:
             return None
         return None
 
-    def _pick_locked(
-        self, key: Optional[bytes], exclude: frozenset
-    ) -> Tuple[Optional[_Replica], str, bool]:
-        """Choose a replica (caller holds ``_lock``): sticky key first
-        (affinity policy), else least-loaded among healthy replicas not
-        in ``exclude`` (prior failed attempts for this request).
+    def _routing_keys(
+        self, path: str, payload: Dict[str, Any],
+    ) -> Optional[List[str]]:
+        """The request's chain-prefix keys (hex) for the cache-aware
+        index lookup — computed OUTSIDE the routing lock (tokenizing
+        is the expensive part).  Mirrors exactly what the replica's
+        ``_submit`` will encode: /chat dialogs through the chat
+        format, ``prompt`` token lists verbatim, ``text`` through the
+        tokenizer (bos, no eos).  None = unroutable-by-cache (no
+        tokenizer for text, malformed payload, policy not
+        cache-aware): the pick falls back to load/role routing."""
+        if self.policy != "cache-aware" or self.block_size is None:
+            return None
+        try:
+            if path == "/chat":
+                if self.chat_format is None:
+                    return None
+                msgs = payload.get("messages")
+                if not isinstance(msgs, list) or not msgs:
+                    return None
+                tokens = self.chat_format.encode_dialog_prompt(msgs)
+            elif isinstance(payload.get("prompt"), list):
+                tokens = [int(t) for t in payload["prompt"]]
+            elif (
+                isinstance(payload.get("text"), str)
+                and self.tokenizer is not None
+            ):
+                tokens = self.tokenizer.encode(
+                    payload["text"], bos=True, eos=False
+                )
+            else:
+                return None
+        except (TypeError, ValueError, KeyError, AttributeError):
+            return None  # the replica will 400 it; route by load
+        return [k.hex() for k in chain_keys(tokens, self.block_size)]
 
-        Returns ``(replica, how, stale)``.  ``stale`` is True for an
-        affinity hit whose replica's chain-digest ``loss_version`` has
-        changed since the session pinned — the pinned chain may have
-        been evicted or demoted, so the route is a CACHE GAMBLE rather
-        than a known hit.  Compared with ``!=`` (not ``>``): a
-        crash-recovery rebuild resets the digest to version 0 and
-        empties the cache — exactly a staleness event."""
+    def _occupancy_locked(self, rep: _Replica) -> float:
+        """Replica load as a slot fraction (caller holds ``_lock``):
+        router-tracked in-flight requests over the replica's slot
+        count from its last health scrape.  An unscraped replica
+        reports its raw in-flight count — any load reads as past the
+        watermark, so cache-aware routing stays conservative until
+        the poller has numbers."""
+        h = (rep.last_health.get("replica") or {})
+        slots = int(h.get("n_slots") or 0)
+        if slots <= 0:
+            return float(rep.inflight)
+        return rep.inflight / slots
+
+    def _cache_pick_locked(
+        self, chain: Optional[List[str]],
+        candidates: List[_Replica],
+    ) -> Tuple[_Replica, str, bool, Optional[Dict[str, Any]]]:
+        """The cache-aware decision (caller holds ``_lock``): route to
+        the replica holding the DEEPEST matching prefix fleet-wide,
+        spilling to least-loaded past the occupancy watermark;
+        returns ``(replica, how, stale, handoff_plan)`` where a
+        non-None plan asks the scheduler to migrate the chain to
+        where the request landed (depth x load disagreement past the
+        configured threshold).  Cold prompts route least-loaded — or
+        to the least-loaded PREFILL replica under role
+        disaggregation."""
+        least = min(
+            candidates, key=lambda r: (r.inflight, r.routed_total)
+        )
+        hit = (
+            self.index.lookup(
+                chain, {r.index for r in candidates}
+            ) if chain else None
+        )
+        if hit is None:
+            if self.roles is not None:
+                pre = [
+                    r for r in candidates
+                    if self.roles[r.index] == "prefill"
+                ]
+                if pre:
+                    chosen = min(
+                        pre,
+                        key=lambda r: (r.inflight, r.routed_total),
+                    )
+                    return chosen, "prefill-role", False, None
+            return least, "least-loaded", False, None
+        depth, holders = hit
+        by_idx = {r.index: r for r in candidates}
+        best_idx, _tier = min(
+            holders,
+            key=lambda h: (
+                h[1] != "hbm",
+                by_idx[h[0]].inflight,
+                by_idx[h[0]].routed_total,
+            ),
+        )
+        rep = by_idx[best_idx]
+        # Digest freshness: the holder's LIVE digest version (last
+        # health scrape) vs the version the index synced at.  A delta
+        # means the chain may have moved/evicted since — routed
+        # anyway (locality hint), counted, degrades to a cold
+        # prefill, never to wrong tokens.
+        stale = (
+            self.index.synced_version(rep.index)
+            != rep.kv_digest().get("version")
+        )
+        occ = self._occupancy_locked(rep)
+        if rep is least or occ < self.spill_occupancy:
+            self.cache_hit_depth_blocks_total += depth
+            if stale:
+                self.cache_stale_routes_total += 1
+            return rep, "cache-aware", stale, None
+        # Spill: the deepest-prefix holder is past the watermark.
+        # Schedule the chain's migration to where the request lands
+        # when depth x load-disagreement clears the threshold — the
+        # request itself NEVER waits on the handoff (first token
+        # rides a cold prefill on the spill target; the next turn
+        # hits warm).
+        plan = None
+        score = depth * max(
+            0.0, occ - self._occupancy_locked(least)
+        )
+        if (
+            depth >= self.handoff_min_depth
+            and score >= self.handoff_threshold
+        ):
+            plan = {
+                "src": rep.index, "dst": least.index,
+                "keys_hex": list(chain[:depth]), "depth": depth,
+            }
+        return least, "spill", False, plan
+
+    def _pick_locked(
+        self, key: Optional[bytes], exclude: frozenset,
+        chain: Optional[List[str]] = None,
+    ) -> Tuple[Optional[_Replica], str, bool,
+               Optional[Dict[str, Any]]]:
+        """Choose a replica (caller holds ``_lock``): the global-
+        radix-index decision under the cache-aware policy, sticky key
+        first under affinity, else least-loaded among healthy
+        replicas not in ``exclude`` (prior failed attempts for this
+        request).
+
+        Returns ``(replica, how, stale, handoff_plan)``.  ``stale`` is
+        True for an affinity/cache hit whose replica's chain digest
+        has changed since the decision's information was current — the
+        chain may have been evicted or demoted, so the route is a
+        CACHE GAMBLE rather than a known hit.  Compared with ``!=``
+        (not ``>``): a crash-recovery rebuild resets the digest and
+        empties the cache — exactly a staleness event.
+        ``handoff_plan`` (cache-aware spill only) asks the scheduler
+        to migrate the chain to the routed replica."""
         candidates = [
             r for r in self._replicas
             if r.healthy and r.index not in exclude
         ]
         if not candidates:
-            return None, "none", False
+            return None, "none", False, None
+        if self.policy == "cache-aware":
+            return self._cache_pick_locked(chain, candidates)
         if self.policy == "affinity" and key is not None:
             ent = self._affinity.get(key)
             if ent is not None:
@@ -516,7 +1050,7 @@ class ReplicaRouter:
                             # or the None would disable staleness
                             # detection for the session's whole life.
                             ent[1] = cur
-                        return r, "affinity", stale
+                        return r, "affinity", stale, None
         chosen = min(
             candidates, key=lambda r: (r.inflight, r.routed_total)
         )
@@ -526,7 +1060,7 @@ class ReplicaRouter:
             self._affinity[key] = [
                 chosen.index, chosen.kv_digest().get("loss_version"),
             ]
-        return chosen, "least-loaded", False
+        return chosen, "least-loaded", False, None
 
     # -- proxying ------------------------------------------------------------
 
@@ -546,6 +1080,9 @@ class ReplicaRouter:
         except ValueError:
             payload = {}
         key = self._affinity_key(payload)
+        # Chain-prefix keys for the cache-aware index lookup —
+        # tokenization happens HERE, outside the routing lock.
+        chain = self._routing_keys(handler.path, payload)
         fwd_headers = {
             "Content-Type": "application/json",
             "Content-Length": str(len(body)),
@@ -559,9 +1096,10 @@ class ReplicaRouter:
         client_rid = handler.headers.get("X-Request-Id") or None
         while True:
             t_pick = self._now_ms()
+            role_pending = False
             with self._lock:
-                rep, how, stale = self._pick_locked(
-                    key, frozenset(tried)
+                rep, how, stale, plan = self._pick_locked(
+                    key, frozenset(tried), chain
                 )
                 if rep is not None:
                     rep.inflight += 1
@@ -571,6 +1109,19 @@ class ReplicaRouter:
                     self.routed_by_policy[how] = (
                         self.routed_by_policy.get(how, 0) + 1
                     )
+                    # A completed request on a prefill-role replica
+                    # WILL schedule a disaggregation handoff after the
+                    # relay; registering the intent here (cleared in
+                    # this attempt's finally, after _maybe_role_handoff
+                    # ran) closes the window where wait_handoffs()
+                    # could report idle between the client seeing its
+                    # reply and the job entering the queue.
+                    role_pending = bool(
+                        self.roles is not None and chain
+                        and self.roles[rep.index] == "prefill"
+                    )
+                    if role_pending:
+                        self._role_handoffs_pending += 1
             if rep is None:
                 self._reply_json(
                     handler, 503,
@@ -600,6 +1151,11 @@ class ReplicaRouter:
                 path=handler.path, request_id=client_rid,
                 stale_chain=stale or None,
             )
+            if plan is not None:
+                # Spill disagreement: migrate the chain to where the
+                # request landed — asynchronously; the relay below
+                # never waits on it.
+                self._schedule_handoff(plan, client_rid)
             t_fwd = self._now_ms()
             try:
                 if self.fault_injector is not None:
@@ -613,6 +1169,13 @@ class ReplicaRouter:
                     "forward", t_fwd, replica=rep.index,
                     path=handler.path,
                     request_id=rid_seen or client_rid,
+                )
+                # Disaggregation: a completed request on a PREFILL
+                # replica streams its freshly published chain to a
+                # decode replica, re-pinning the session's routing
+                # record there at handoff completion.
+                self._maybe_role_handoff(
+                    rep, chain, rid_seen or client_rid
                 )
                 return
             except _ClientDisconnect:
@@ -686,6 +1249,8 @@ class ReplicaRouter:
             finally:
                 with self._lock:
                     rep.inflight -= 1
+                    if role_pending:
+                        self._role_handoffs_pending -= 1
 
     def _relay(
         self, handler: BaseHTTPRequestHandler, rep: _Replica,
@@ -754,6 +1319,240 @@ class ReplicaRouter:
             raise
         finally:
             conn.close()
+
+    # -- handoff scheduler ---------------------------------------------------
+
+    def _maybe_role_handoff(
+        self, rep: _Replica, chain: Optional[List[str]],
+        request_id: Optional[str],
+    ) -> None:
+        """Prefill/decode disaggregation: after a request COMPLETES on
+        a prefill-role replica, stream its published chain to the
+        least-loaded decode replica (export -> import), so the
+        session's next turn admits there as a plain prefix hit."""
+        if self.roles is None or not chain:
+            return
+        if self.roles[rep.index] != "prefill":
+            return
+        with self._lock:
+            decode = [
+                r for r in self._replicas
+                if r.healthy and self.roles[r.index] == "decode"
+            ]
+            if not decode:
+                return
+            dst = min(
+                decode, key=lambda r: (r.inflight, r.routed_total)
+            )
+            dst_index = dst.index
+        if dst_index == rep.index:
+            return
+        self._schedule_handoff(
+            {"src": rep.index, "dst": dst_index,
+             "keys_hex": list(chain), "depth": len(chain)},
+            request_id,
+        )
+
+    def _schedule_handoff(
+        self, plan: Dict[str, Any], request_id: Optional[str],
+    ) -> None:
+        """Admit a migration job into the handoff queue under the
+        scheduler's bounds: at most ONE in-flight handoff per chain
+        (keyed by its deepest prefix key), total estimated bytes in
+        flight capped (a skipped job is counted, never queued — the
+        chain stays where it is and the next disagreement re-tries),
+        and only in-process replicas participate (the control path
+        runs on their serving-loop threads)."""
+        if not plan.get("keys_hex"):
+            return
+        # Chain identity = the ROOT key: plans for the same chain at
+        # different matched depths (growing multi-turn prompts, spill
+        # vs role triggers) must dedup against each other — a leaf
+        # key would admit one job per depth and burn the source's
+        # loop on empty re-exports after the first demote.
+        head = plan["keys_hex"][0]
+        with self._lock:
+            src = self._replicas[plan["src"]]
+            dst = self._replicas[plan["dst"]]
+            if src.server is None or dst.server is None:
+                self.handoffs_skipped_total += 1
+                return
+            if head in self._handoff_chains:
+                # One in-flight handoff per chain: the duplicate is
+                # refused, and counted — a silently vanishing
+                # migrate_chain() would read as accepted.
+                self.handoffs_skipped_total += 1
+                return
+            est = plan["depth"] * self.index.block_bytes(plan["src"])
+            if (
+                self._handoff_bytes_inflight > 0
+                and self._handoff_bytes_inflight + est
+                > self.handoff_max_bytes_inflight
+            ):
+                self.handoffs_skipped_total += 1
+                return
+            self._handoff_chains.add(head)
+            self._handoff_bytes_inflight += est
+            self.handoffs_scheduled_total += 1
+        job = dict(plan, head=head, est=est, request_id=request_id)
+        self._log(
+            "router_handoff_scheduled", src=plan["src"],
+            dst=plan["dst"], depth=plan["depth"],
+            request_id=request_id,
+        )
+        self._handoff_q.put(job)
+
+    def _handoff_loop(self) -> None:
+        """The router-handoff worker: executes migration jobs one at
+        a time through the replicas' control paths.  A failed or
+        timed-out job counts as aborted and UNWINDS its scheduler
+        accounting — the chain is re-eligible immediately and the
+        worker never dies."""
+        while not self._closed.is_set():
+            try:
+                job = self._handoff_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                self._run_handoff(job)
+            except Exception as e:
+                with self._lock:
+                    self.handoffs_aborted_total += 1
+                self._log(
+                    "router_handoff_failed", str(e),
+                    src=job["src"], dst=job["dst"],
+                    request_id=job.get("request_id"),
+                )
+            finally:
+                with self._lock:
+                    self._handoff_chains.discard(job["head"])
+                    self._handoff_bytes_inflight = max(
+                        0, self._handoff_bytes_inflight - job["est"]
+                    )
+
+    def _run_handoff(self, job: Dict[str, Any]) -> None:
+        """One migration: export on the source's serving-loop thread
+        (demoting the exported chain so the move DEDUPLICATES),
+        import on the destination's with the remaining wall budget
+        (the import unwinds cleanly on timeout — serving.py owns that
+        contract), then count + trace + re-pin the session's routing
+        record at the destination and optimistically fold the move
+        into the global index."""
+        with self._lock:
+            src = self._replicas[job["src"]]
+            dst = self._replicas[job["dst"]]
+        rid = job.get("request_id")
+        keys = [bytes.fromhex(k) for k in job["keys_hex"]]
+        t0 = self._now_ms()
+        deadline = time.monotonic() + self.handoff_timeout_s
+        # Export WITHOUT demoting: the source gives up its copy only
+        # AFTER the destination provably holds the chain (below) — an
+        # abandoned/timed-out/failed handoff must never cost the
+        # fleet its only HBM-resident copy.
+        keys_out, slabs = src.server.call_on_loop(
+            lambda b: b.export_prefix(
+                keys=keys, request_id=rid,
+                max_bytes=self.handoff_max_bytes,
+            ),
+            timeout_s=self.handoff_timeout_s,
+        )
+        if not slabs:
+            with self._lock:
+                self.handoffs_empty_total += 1
+            return  # nothing resident anymore: nothing to move
+        remaining = max(0.1, deadline - time.monotonic())
+        n = dst.server.call_on_loop(
+            lambda b: b.import_prefix(
+                keys_out, slabs, request_id=rid,
+                timeout_s=remaining,
+            ),
+            timeout_s=remaining + 1.0,
+        )
+        # The source gives up its copy only for the prefix the
+        # destination PROVABLY holds HBM-resident now: an import can
+        # return 0 both benignly (the spilled request's own prefill
+        # won the race) and because the destination had no capacity,
+        # and a capacity-truncated import lands a shorter prefix than
+        # was exported — demoting past the landed depth would cost
+        # the fleet its only copy of the tail.  One cheap host-side
+        # residency probe resolves all cases exactly.
+        if self.demote_after_export:
+            try:
+                resident = dst.server.call_on_loop(
+                    lambda b: len(
+                        b._match_prefix(list(keys_out)).blocks
+                    ),
+                    timeout_s=min(5.0, self.handoff_timeout_s),
+                )
+                if resident > 0:
+                    # Reuses the exported slabs (no second D2H
+                    # fetch); best-effort — a busy source keeps its
+                    # copy and the next disagreement re-tries.
+                    src.server.call_on_loop(
+                        lambda b: b.demote_exported(
+                            keys_out[:resident], slabs[:resident],
+                            request_id=rid,
+                        ),
+                        timeout_s=self.handoff_timeout_s,
+                    )
+            except (TimeoutError, RuntimeError):
+                pass
+        if n <= 0:
+            # Benign no-op: the chain is already resident on the
+            # destination (the spilled request prefilled it before
+            # the slabs arrived) or capacity was zero — either way
+            # nothing landed, and the demote above only ran for
+            # prefixes the destination actually holds.  A TIMEOUT
+            # raises instead (counted aborted by the worker).
+            with self._lock:
+                self.handoffs_empty_total += 1
+            return
+        bb = self.index.block_bytes(job["src"])
+        with self._lock:
+            self.handoffs_completed_total += 1
+            self.handoff_blocks_total += n
+            self.handoff_bytes_total += n * bb
+        # note_handoff counts kv_handoffs_total, drops the linked
+        # handoff span, and re-pins the routing record at dst.
+        self.note_handoff(
+            n, request_id=rid, src=job["src"], dst=job["dst"],
+        )
+        self.index.note_handoff(
+            job["src"], job["dst"], job["keys_hex"][:n],
+        )
+        self._span(
+            "handoff_exec", t0, src=job["src"], dst=job["dst"],
+            blocks=n, request_id=rid,
+        )
+
+    def migrate_chain(
+        self, keys_hex: Sequence[str], src: int, dst: int,
+        request_id: Optional[str] = None,
+    ) -> None:
+        """Operator/bench entry point: schedule one chain migration
+        src -> dst through the same bounded scheduler the spill path
+        uses (dedup, bytes-in-flight cap, demote-after-export)."""
+        self._schedule_handoff(
+            {"src": int(src), "dst": int(dst),
+             "keys_hex": list(keys_hex), "depth": len(keys_hex)},
+            request_id,
+        )
+
+    def wait_handoffs(self, timeout_s: float = 10.0) -> bool:
+        """Block until the handoff queue is drained and no job is in
+        flight (tests / bench determinism); True when idle."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = (
+                    not self._handoff_chains
+                    and self._handoff_q.empty()
+                    and self._role_handoffs_pending == 0
+                )
+            if idle:
+                return True
+            time.sleep(0.01)
+        return False
 
     # -- GET surface ---------------------------------------------------------
 
@@ -1099,13 +1898,38 @@ class ReplicaRouter:
                 dict(self._fleet_kv)
                 if self._fleet_kv is not None else None
             )
+            scheduler = {
+                "scheduled_total": self.handoffs_scheduled_total,
+                "completed_total": self.handoffs_completed_total,
+                "aborted_total": self.handoffs_aborted_total,
+                "skipped_total": self.handoffs_skipped_total,
+                "empty_total": self.handoffs_empty_total,
+                "blocks_total": self.handoff_blocks_total,
+                "bytes_total": self.handoff_bytes_total,
+                "bytes_inflight": self._handoff_bytes_inflight,
+                "chains_inflight": len(self._handoff_chains),
+                "role_pending": self._role_handoffs_pending,
+            }
+            cache = {
+                "stale_routes_total": self.cache_stale_routes_total,
+                "hit_depth_blocks_total": (
+                    self.cache_hit_depth_blocks_total
+                ),
+            }
+        cache.update(self.index.stats())
         return {
             "ok": any(s["healthy"] for s in snaps),
             "policy": self.policy,
+            "roles": list(self.roles) if self.roles else None,
             "replicas": snaps,
             "affinity_sessions": affinity_sessions,
             "kv_handoffs_total": handoffs,
             "affinity_stale_routes_total": stale_routes,
+            # Cache-aware routing state: the global radix index's
+            # sync/size counters + routing outcomes.
+            "cache_index": cache,
+            # Handoff scheduler ledger (bounds + outcomes).
+            "handoff": scheduler,
             # Last computed fleet cache aggregate (None until the
             # first GET /debug/kv/fleet).
             "fleet_kv": fleet_kv,
@@ -1127,6 +1951,17 @@ class ReplicaRouter:
                 dict(self._fleet_kv)
                 if self._fleet_kv is not None else None
             )
+            ho = {
+                "scheduled": self.handoffs_scheduled_total,
+                "completed": self.handoffs_completed_total,
+                "aborted": self.handoffs_aborted_total,
+                "skipped": self.handoffs_skipped_total,
+                "bytes_inflight": self._handoff_bytes_inflight,
+                "bytes_total": self.handoff_bytes_total,
+            }
+            cache_stale = self.cache_stale_routes_total
+            cache_depth = self.cache_hit_depth_blocks_total
+        idx = self.index.stats()
         lines: List[str] = []
 
         def fam(name: str, kind: str, help_text: str) -> None:
@@ -1164,6 +1999,85 @@ class ReplicaRouter:
             "counted, no longer silent)")
         lines.append(
             f"llm_router_affinity_stale_routes_total {stale_routes}"
+        )
+        # Cache-aware routing: the global radix index + decision
+        # outcome counters (policy="cache-aware" only; families are
+        # always exposed for dashboard discovery).
+        fam("cache_index_nodes", "gauge",
+            "Chain-prefix keys in the router's global radix index, "
+            "summed over replicas")
+        lines.append(f"llm_router_cache_index_nodes {idx['nodes']}")
+        fam("cache_index_replicas_synced", "gauge",
+            "Replicas whose chain digest has been folded into the "
+            "global index")
+        lines.append(
+            "llm_router_cache_index_replicas_synced "
+            f"{idx['replicas_synced']}"
+        )
+        fam("cache_index_syncs_total", "counter",
+            "Digest syncs applied to the global index (incremental + "
+            "full)")
+        lines.append(
+            f"llm_router_cache_index_syncs_total {idx['syncs_total']}"
+        )
+        fam("cache_index_resyncs_total", "counter",
+            "Full node-walk resyncs (journal could not prove "
+            "completeness — rebuilds, or a poller too far behind)")
+        lines.append(
+            "llm_router_cache_index_resyncs_total "
+            f"{idx['resyncs_total']}"
+        )
+        fam("cache_index_events_applied_total", "counter",
+            "Journaled digest events applied incrementally")
+        lines.append(
+            "llm_router_cache_index_events_applied_total "
+            f"{idx['events_applied_total']}"
+        )
+        fam("cache_stale_routes_total", "counter",
+            "Cache-aware routes taken onto a holder whose live digest "
+            "version moved past the index's synced one (possible "
+            "cold prefill — counted, never wrong tokens)")
+        lines.append(
+            f"llm_router_cache_stale_routes_total {cache_stale}"
+        )
+        fam("cache_hit_depth_blocks_total", "counter",
+            "Cumulative matched prefix depth (blocks) over cache-"
+            "aware routed requests")
+        lines.append(
+            f"llm_router_cache_hit_depth_blocks_total {cache_depth}"
+        )
+        # Handoff scheduler ledger.
+        fam("handoffs_scheduled_total", "counter",
+            "Chain migrations admitted into the handoff queue")
+        lines.append(
+            f"llm_router_handoffs_scheduled_total {ho['scheduled']}"
+        )
+        fam("handoffs_completed_total", "counter",
+            "Chain migrations that landed blocks on the destination")
+        lines.append(
+            f"llm_router_handoffs_completed_total {ho['completed']}"
+        )
+        fam("handoffs_aborted_total", "counter",
+            "Chain migrations that failed or timed out (unwound "
+            "cleanly; chain re-eligible)")
+        lines.append(
+            f"llm_router_handoffs_aborted_total {ho['aborted']}"
+        )
+        fam("handoffs_skipped_total", "counter",
+            "Chain migrations refused at admission (bytes-in-flight "
+            "bound, or an out-of-process replica)")
+        lines.append(
+            f"llm_router_handoffs_skipped_total {ho['skipped']}"
+        )
+        fam("handoff_bytes_inflight", "gauge",
+            "Estimated slab bytes currently moving between replicas")
+        lines.append(
+            f"llm_router_handoff_bytes_inflight {ho['bytes_inflight']}"
+        )
+        fam("handoff_bytes_total", "counter",
+            "Slab bytes landed on destinations by completed handoffs")
+        lines.append(
+            f"llm_router_handoff_bytes_total {ho['bytes_total']}"
         )
         # Fleet cache aggregate (last GET /debug/kv/fleet computation;
         # headers always present for dashboard discovery, samples only

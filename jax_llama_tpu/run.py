@@ -76,12 +76,33 @@ def main() -> None:
                          "(router.py) that exposes the same protocol "
                          "on the --http port")
     ap.add_argument("--route", default="least-loaded",
-                    choices=("least-loaded", "affinity"),
+                    choices=("least-loaded", "affinity", "cache-aware"),
                     help="replica routing policy: 'least-loaded' "
-                         "(fewest in-flight requests) or 'affinity' "
+                         "(fewest in-flight requests), 'affinity' "
                          "(sticky sessions by prompt prefix, so "
                          "revisited chats land on the replica holding "
-                         "their radix prefix chain)")
+                         "their radix prefix chain), or 'cache-aware' "
+                         "(GLOBALLY cache-aware: the router folds "
+                         "every replica's chain digest into one radix "
+                         "index and routes each request to the "
+                         "replica holding the deepest matching "
+                         "prefix, spilling to least-loaded past an "
+                         "occupancy watermark and migrating chains "
+                         "to where traffic lands via the handoff "
+                         "scheduler)")
+    ap.add_argument("--replica-roles", default=None, metavar="R,R,...",
+                    help="prefill/decode disaggregation for "
+                         "--replicas N: a comma list of one role per "
+                         "replica ('prefill' | 'decode').  Cold "
+                         "prompts route to the least-loaded prefill "
+                         "replica; a request finishing there streams "
+                         "its prefix KV to a decode replica "
+                         "(export->import handoff) and the session "
+                         "re-pins there, so revisits decode warm.  "
+                         "Requires --route cache-aware (the "
+                         "scheduler routes off the global radix "
+                         "index); needs at least one replica of "
+                         "each role")
     ap.add_argument("--decode-chunk", type=int, default=8,
                     help="fuse up to this many decode iterations per "
                          "jitted dispatch in --serve / --http "
@@ -353,6 +374,38 @@ def main() -> None:
             "--replicas > 1 needs the HTTP front-end (--http PORT): "
             "the ReplicaRouter speaks HTTP to its replicas"
         )
+    if args.replica_roles is not None:
+        roles = tuple(
+            r.strip() for r in args.replica_roles.split(",") if r.strip()
+        )
+        if args.replicas < 2:
+            raise SystemExit(
+                "--replica-roles needs --replicas >= 2 (one prefill "
+                "and one decode replica at minimum)"
+            )
+        if len(roles) != args.replicas:
+            raise SystemExit(
+                f"--replica-roles names {len(roles)} roles for "
+                f"--replicas {args.replicas}; give one role per replica"
+            )
+        bad = sorted(set(roles) - {"prefill", "decode"})
+        if bad:
+            raise SystemExit(
+                f"--replica-roles: unknown role(s) {bad}; valid roles "
+                "are 'prefill' and 'decode'"
+            )
+        if not ("prefill" in roles and "decode" in roles):
+            raise SystemExit(
+                "--replica-roles needs at least one replica of EACH "
+                "role (prefill and decode)"
+            )
+        if args.route != "cache-aware":
+            raise SystemExit(
+                "--replica-roles requires --route cache-aware (the "
+                "disaggregation scheduler routes off the router's "
+                "global radix index)"
+            )
+        args.replica_roles = roles
     serve_spec = None
     if args.serve_mesh is not None:
         if args.http is None and not args.serve:
@@ -439,6 +492,20 @@ def main() -> None:
     for p, o in zip(prompts, outs):
         print(f"\n=== {p!r}\n{o}")
     print(f"\n[{stats.summary()}] (incl. compile)")
+
+
+def _chat_format_for(tokenizer):
+    """The ONE 'is this a llama-3 chat tokenizer' heuristic: both the
+    single-server /chat endpoint and the router's cache-aware /chat
+    chain-key encoding must resolve the SAME ChatFormat, or the
+    router's routing keys drift from what the replicas admit."""
+    if hasattr(tokenizer, "special_tokens") and hasattr(
+        tokenizer, "eot_id"
+    ):
+        from .tokenizers.llama3 import ChatFormat
+
+        return ChatFormat(tokenizer)
+    return None
 
 
 def _load_draft(args, mesh):
@@ -547,11 +614,7 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None,
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).
-    chat_format = None
-    if hasattr(tokenizer, "special_tokens") and hasattr(tokenizer, "eot_id"):
-        from .tokenizers.llama3 import ChatFormat
-
-        chat_format = ChatFormat(tokenizer)
+    chat_format = _chat_format_for(tokenizer)
     watchdog_s = getattr(args, "watchdog_s", 60.0)
     drain_timeout_s = getattr(args, "drain_timeout_s", 30.0)
     try:
@@ -796,10 +859,20 @@ def _serve_router(params, config, tokenizer, mesh, args,
                 ),
             )
             servers.append(srv.start())
+        # Cache-aware routing needs the router to speak the replicas'
+        # chain-key schema: the tokenizer + chat format mirror each
+        # replica's own /generate- and /chat-encoding, block_size is
+        # the chain-key granularity (identical across replicas — same
+        # config), and --replica-roles turns on the prefill/decode
+        # disaggregation scheduler.
         router = ReplicaRouter(
             servers, host=args.host, port=args.http,
             policy=getattr(args, "route", "least-loaded"),
             fault_injector=injector, logger=logger,
+            tokenizer=tokenizer,
+            block_size=servers[0].batcher.block_size,
+            chat_format=_chat_format_for(tokenizer),
+            roles=getattr(args, "replica_roles", None),
         ).start()
         try:
             logger.log(

@@ -270,7 +270,14 @@ lock-guarded ``kvcache.KvDigest``, never the thread-confined store)::
 
 Nodes sort (depth, key) so equal content serializes identically; the
 walk is depth-capped by ``depth`` and truncated past ``n`` (default
-2048), so the payload stays bounded at max radix occupancy.  Per-
+2048), so the payload stays bounded at max radix occupancy.  With
+``?since=V`` (r14) the reply is the INCREMENTAL form — ``{"version":
+int, "since": V, "events": [{"version", "op": "publish"|"remove"|
+"demote"|"restore"|"host_evict", "key", "depth", "tier"}, ...],
+"summary": {...}}`` from the digest's bounded journal (the router's
+global radix index syncs off it at O(changes) per poll); when the
+journal cannot prove completeness (rebuild reset, consumer too far
+behind) the full walk returns instead, tagged ``"resync": true``.  Per-
 session KV accounting rides ``/debug/requests/<id>`` as a ``kv`` dict
 (``blocks_held`` / ``prefix_hit_tokens`` / ``swap_in_bytes`` /
 ``evictions_suffered``), the ``prefix_hit_depth_tokens`` (pow2 token
@@ -407,6 +414,26 @@ _DONE = object()  # stream sentinel
 _SUBMIT_DEFAULT_MAX_NEW = inspect.signature(
     ContinuousBatcher.submit
 ).parameters["max_new_tokens"].default
+
+
+class _ControlCall:
+    """One unit of batcher work scheduled onto the serving-loop thread
+    by a foreign thread (``LLMServer.call_on_loop``): the batcher is
+    thread-confined, so the router's handoff scheduler drives
+    ``export_prefix`` / ``import_prefix`` through this control path
+    instead of touching the batcher directly.  ``cancelled`` makes the
+    caller's timeout safe: a call abandoned before the loop picked it
+    up never runs; one abandoned mid-run completes harmlessly (its
+    result is simply dropped)."""
+
+    __slots__ = ("fn", "done", "cancelled", "result", "error")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.done = threading.Event()
+        self.cancelled = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
 
 
 @dataclass
@@ -644,6 +671,10 @@ class LLMServer:
         self._heartbeat = time.monotonic()
         self._stalled = False
         self._inbox: "queue.Queue[_Pending]" = queue.Queue()
+        # Control path (thread-safe queue): foreign threads schedule
+        # batcher work (handoff export/import) the loop executes
+        # between steps — see call_on_loop.
+        self._control: "queue.Queue[_ControlCall]" = queue.Queue()
         self._active: Dict[int, _Pending] = {}
         self._stop = threading.Event()
         self._closed = threading.Event()  # set once the loop has drained
@@ -721,12 +752,17 @@ class LLMServer:
                     # Full (depth-capped, node-bounded) chain-digest
                     # walk — reads only the lock-guarded KvDigest, so
                     # handler threads never touch the confined store.
+                    # ?since=V answers the INCREMENTAL form (journaled
+                    # digest events past version V) for the router's
+                    # global radix index sync.
                     depth = qint("depth", 0)
+                    since = qint("since", -1)
                     self._reply_json(
                         200,
                         server.batcher.kv_debug_json(
                             depth=depth if depth > 0 else None,
                             max_nodes=qint("n", 2048),
+                            since=since if since >= 0 else None,
                         ),
                     )
                 elif route == "/debug/trace":
@@ -1178,6 +1214,48 @@ class LLMServer:
         self._loop_thread.join(timeout=30)
         if self._watchdog_thread is not None:
             self._watchdog_thread.join(timeout=10)
+
+    def call_on_loop(self, fn, timeout_s: float = 30.0):
+        """Run ``fn(batcher)`` on the serving-loop thread (the
+        batcher's single owner) and return its result — the control
+        path the router's cache-aware handoff scheduler uses to drive
+        ``export_prefix`` / ``import_prefix`` without violating thread
+        confinement.  Blocks the CALLING thread up to ``timeout_s``;
+        past it the call is cancelled (never runs if the loop had not
+        picked it up; a call already mid-run completes and its result
+        drops) and :class:`TimeoutError` raises — so a wedged or
+        heavily loaded loop bounds the scheduler instead of hanging
+        it.  Raises ``TimeoutError`` immediately when the loop is not
+        running (stopped / crashed / never started)."""
+        if self._closed.is_set() or not self._loop_thread.is_alive():
+            raise TimeoutError("serving loop is not running")
+        call = _ControlCall(fn)
+        self._control.put(call)
+        if not call.done.wait(timeout_s):
+            call.cancelled.set()
+            raise TimeoutError(
+                f"control call did not complete within {timeout_s}s"
+            )
+        if call.error is not None:
+            raise call.error
+        return call.result
+
+    def _drain_control(self) -> None:
+        """Execute queued control calls (loop thread only).  Errors
+        are CAPTURED into the call — a failed handoff export must
+        never take down the device-owning thread."""
+        while True:
+            try:
+                call = self._control.get_nowait()
+            except queue.Empty:
+                return
+            if call.cancelled.is_set():
+                continue
+            try:
+                call.result = call.fn(self.batcher)
+            except BaseException as e:
+                call.error = e
+            call.done.set()
 
     def begin_drain(self, timeout_s: Optional[float] = None) -> None:
         """Flip the server into drain mode (the SIGTERM/SIGINT path):
@@ -1825,6 +1903,10 @@ class LLMServer:
         try:
             while not self._stop.is_set():
                 self._heartbeat = time.monotonic()
+                # Control path: scheduled batcher work (handoff
+                # export/import) runs HERE, between steps, on the
+                # batcher's owning thread.
+                self._drain_control()
                 if self._draining.is_set():
                     # Drain mode: finish in-flight work, then exit
                     # cleanly; past the deadline fail the stragglers
@@ -2036,6 +2118,16 @@ class LLMServer:
             while not self._inbox.empty():
                 p = self._inbox.get_nowait()
                 p.fail(reason, code)
+            # Pending control calls fail too (their callers' own
+            # timeouts bound them anyway, but an immediate error beats
+            # a silent timeout).
+            while True:
+                try:
+                    call = self._control.get_nowait()
+                except queue.Empty:
+                    break
+                call.error = RuntimeError(reason)
+                call.done.set()
 
     # -- metrics ------------------------------------------------------------
 

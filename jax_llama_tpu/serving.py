@@ -175,7 +175,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import hashlib
 import itertools
 import time
 from collections import deque
@@ -217,6 +216,7 @@ from .ops.attention import NEG_INF
 from .ops.sampling import stop_token_hits
 from .parallel.mesh import use_mesh
 from .parallel import serve_mesh as smesh
+from .router import chain_keys as _router_chain_keys
 from .spec_decode import (
     accepted_emit_counts,
     draft_categorical,
@@ -2072,6 +2072,11 @@ class ContinuousBatcher:
         self.kv_import_blocks_total = 0
         self.kv_export_events_total = 0
         self.kv_import_events_total = 0
+        # Handoff hardening (r14): imports that hit the wall timeout
+        # and unwound cleanly, and exported blocks demoted/dropped at
+        # the source so the migration deduplicates instead of copying.
+        self.kv_handoff_aborted_total = 0
+        self.kv_export_demoted_blocks_total = 0
         # Host-side numpy mirrors of the per-slot decode state — the
         # AUTHORITATIVE copy for all host bookkeeping (admission
         # capacity, slot frees, replay).  The chunked decode path keeps
@@ -2496,6 +2501,10 @@ class ContinuousBatcher:
             "kv_import_blocks_total": self.kv_import_blocks_total,
             "kv_export_events_total": self.kv_export_events_total,
             "kv_import_events_total": self.kv_import_events_total,
+            "kv_handoff_aborted_total": self.kv_handoff_aborted_total,
+            "kv_export_demoted_blocks_total": (
+                self.kv_export_demoted_blocks_total
+            ),
             "serve_mesh_data": (
                 smesh.mesh_shape(self.mesh)["data"]
                 if self._mesh_placed else 1
@@ -2561,17 +2570,43 @@ class ContinuousBatcher:
         return sum(a for _, a in window) / proposed
 
     def kv_debug_json(self, depth: Optional[int] = None,
-                      max_nodes: int = 2048) -> Dict[str, Any]:
-        """The ``GET /debug/kv`` payload: the chain digest's bounded
-        tree walk (per-node chain-prefix hash / depth / residency tier
-        / refcount flag / recency) plus the O(1) summary with this
-        replica's cache geometry.  Safe from HTTP handler threads: it
-        reads ONLY the lock-guarded digest (kvcache.KvDigest) and
-        ctor-stable geometry scalars, plus two single-writer token
-        counters whose point-in-time reads are the same /metrics
-        snapshot contract ``stats()`` documents — never the
-        thread-confined store or pool."""
+                      max_nodes: int = 2048,
+                      since: Optional[int] = None) -> Dict[str, Any]:
+        """The ``GET /debug/kv[?since=V]`` payload: the chain digest's
+        bounded tree walk (per-node chain-prefix hash / depth /
+        residency tier / refcount flag / recency) plus the O(1)
+        summary with this replica's cache geometry.  With ``since``,
+        the INCREMENTAL form: the digest's journaled mutations past
+        version V (``{"events": [...], "version": V2}``) so the
+        router's global radix index syncs at O(changes) per poll; when
+        the bounded journal cannot prove completeness (consumer too
+        far behind, or a rebuild reset the digest) the reply falls
+        back to the full walk tagged ``"resync": true``.  Safe from
+        HTTP handler threads: it reads ONLY the lock-guarded digest
+        (kvcache.KvDigest) and ctor-stable geometry scalars, plus two
+        single-writer token counters whose point-in-time reads are the
+        same /metrics snapshot contract ``stats()`` documents — never
+        the thread-confined store or pool."""
+        if since is not None:
+            got = self.kv_digest.events_since(since)
+            if got is not None:
+                events, version = got
+                out: Dict[str, Any] = {
+                    "version": version, "since": since,
+                    "events": events,
+                }
+                out["summary"] = self._kv_summary()
+                return out
         out = self.kv_digest.nodes_json(depth=depth, max_nodes=max_nodes)
+        if since is not None:
+            out["resync"] = True
+        out["summary"] = self._kv_summary()
+        return out
+
+    def _kv_summary(self) -> Dict[str, Any]:
+        """The /debug/kv ``summary`` section: digest aggregates plus
+        ctor-stable cache geometry (same cross-thread safety argument
+        as ``kv_debug_json``)."""
         summary = self.kv_digest.summary()
         summary.update({
             "prefix_index": self.prefix_index,
@@ -2585,8 +2620,7 @@ class ContinuousBatcher:
             "prefix_hit_tokens_total": self.prefix_hit_tokens_total,
             "prompt_tokens_total": self.prompt_tokens_total,
         })
-        out["summary"] = summary
-        return out
+        return summary
 
     def step(self) -> List[Tuple]:
         """One decode dispatch for every active slot.
@@ -3554,8 +3588,12 @@ class ContinuousBatcher:
     # -- prefill/decode disaggregation handoff ------------------------------
 
     def export_prefix(
-        self, tokens: Sequence[int],
+        self, tokens: Optional[Sequence[int]] = None,
         request_id: Optional[str] = None,
+        *,
+        keys: Optional[Sequence[bytes]] = None,
+        max_bytes: Optional[int] = None,
+        demote_after_export: bool = False,
     ) -> Tuple[List[bytes], List[Dict[str, Any]]]:
         """Disaggregation handoff, PREFILL side: the longest
         HBM-resident cached chain prefix of ``tokens`` fetched as host
@@ -3569,15 +3607,33 @@ class ContinuousBatcher:
         orchestration).  Returns ``(chain_keys, slabs)``; empty when
         the prefix cache is off or nothing is resident.
 
+        ``keys`` passes precomputed chain-prefix keys instead of
+        tokens (the router schedules handoffs from its global radix
+        index, which speaks keys — ``router.chain_keys`` is the shared
+        schema).  ``max_bytes`` bounds the slab payload (block-aligned
+        truncation from the root — a partial prefix is still a valid
+        chain).  ``demote_after_export=True`` demotes the exported
+        chain's IDLE blocks to the host tier (or drops idle leaf
+        blocks with no tier) so a migration *reduces* fleet duplicate
+        KV bytes instead of growing them; claimed blocks never move
+        (radix index only — the exact oracle keeps its chains).
+
         Must run on the thread that owns this batcher (the D2H fetch
         is admission-class traffic, like demotion — never on the
         decode hot path)."""
         if not self.prefix_cache_enabled:
             return [], []
-        keys = self._chain_keys(tokens, self.block_size)
+        if keys is None:
+            assert tokens is not None, "export_prefix needs tokens or keys"
+            keys = self._chain_keys(tokens, self.block_size)
+        else:
+            keys = list(keys)
         match = self._match_prefix(keys)
+        blocks = match.blocks
+        if max_bytes is not None and self.block_bytes > 0:
+            blocks = blocks[: max(0, max_bytes // self.block_bytes)]
         slabs: List[Dict[str, Any]] = []
-        for blk in match.blocks:
+        for blk in blocks:
             slab = fetch_slab(self.pool, blk)
             if self.spec:
                 slab.update(fetch_slab(self.draft_pool, blk, prefix="d_"))
@@ -3585,6 +3641,10 @@ class ContinuousBatcher:
         self.kv_export_blocks_total += len(slabs)
         if slabs:
             self.kv_export_events_total += 1
+        if demote_after_export and slabs:
+            self.demote_exported(
+                keys[: len(slabs)], slabs, request_id=request_id,
+            )
         # Fleet-trace link: the instant event carries the EXTERNAL
         # request id (when the handoff orchestrator knows it), so the
         # router's merged /debug/trace ties this replica's export to
@@ -3592,11 +3652,59 @@ class ContinuousBatcher:
         self.obs.annotate(
             "prefix_export", blocks=len(slabs), request_id=request_id,
         )
-        return keys[: len(match.blocks)], slabs
+        return list(keys[: len(slabs)]), slabs
+
+    def demote_exported(
+        self, keys: Sequence[bytes],
+        slabs: Optional[Sequence[Dict[str, Any]]] = None,
+        request_id: Optional[str] = None,
+    ) -> int:
+        """Deduplicate after handoff: demote the exported chain's IDLE
+        blocks to the host tier (or drop idle leaf blocks with no
+        tier) so the migration *reduces* fleet duplicate KV bytes.
+        The router's scheduler calls this as its OWN control step only
+        after the copy landed on the peer — decoupled from the export
+        so an abandoned or failed handoff never costs the fleet its
+        only HBM-resident copy.  ``slabs`` are the export's already-
+        fetched host images, reused for tier insertion instead of a
+        second D2H fetch of the identical blocks.  Radix index only
+        (the exact oracle keeps its chains); claimed blocks never
+        move.  Returns the number of blocks that left HBM."""
+        if not self.prefix_cache_enabled or self._store.kind != "radix":
+            return 0
+        keys = list(keys)
+        slab_by_key: Dict[bytes, Dict[str, Any]] = (
+            dict(zip(keys, slabs)) if slabs else {}
+        )
+
+        def fetch(blk: int) -> Dict[str, Any]:
+            node = self._store._by_block.get(blk)
+            slab = (
+                slab_by_key.get(node.key) if node is not None else None
+            )
+            if slab is not None:
+                self.swap_out_blocks_total += 1
+                return slab
+            return self._demote_block(blk)
+
+        freed = self._store.demote_keys(
+            keys, fetch if self.host_kv_blocks > 0 else None,
+        )
+        self.kv_export_demoted_blocks_total += len(freed)
+        self._invalidate_and_free(freed)
+        if freed:
+            self.obs.annotate(
+                "prefix_demote_after_export", blocks=len(freed),
+                request_id=request_id,
+            )
+        return len(freed)
 
     def import_prefix(
         self, keys: Sequence[bytes], slabs: Sequence[Dict[str, Any]],
         request_id: Optional[str] = None,
+        *,
+        max_bytes: Optional[int] = None,
+        timeout_s: Optional[float] = None,
     ) -> int:
         """Disaggregation handoff, DECODE side: land exported slabs in
         this batcher's pool (alloc + ``kvcache.stage_restore`` +
@@ -3604,13 +3712,27 @@ class ContinuousBatcher:
         arriving from a peer instead of this replica's own tier) and
         publish the chain, so the next admission of those tokens is a
         prefix hit.  Blocks already resident here are skipped;
-        truncates to pool capacity.  Synchronous (admission-class, on
-        the owning thread); returns the number of blocks landed."""
+        truncates to pool capacity (and to ``max_bytes`` when given —
+        block-aligned from the root, so a partial landing is still a
+        valid chain prefix).  Synchronous (admission-class, on the
+        owning thread); returns the number of blocks landed.
+
+        ``timeout_s`` bounds the staged H2D transfer wall time: past
+        the deadline the import UNWINDS cleanly — fresh blocks freed
+        with positions invalidated, matched blocks unclaimed, NOTHING
+        published (a partial publish would advertise KV that never
+        landed) — ``kv_handoff_aborted_total`` counts it, and
+        :class:`TimeoutError` raises so the scheduler can tell an
+        abort from the benign already-resident no-op (return 0).
+        Without the bound a wedged transfer would hold allocated
+        blocks indefinitely."""
         if not self.prefix_cache_enabled or not slabs:
             return 0
         keys = list(keys)[: len(slabs)]
         have = self._store.match(keys).blocks
         todo = list(slabs)[len(have):len(keys)]
+        if max_bytes is not None and self.block_bytes > 0:
+            todo = todo[: max(0, max_bytes // self.block_bytes)]
         if not todo:
             return 0
         # Claim the matched resident blocks BEFORE allocating — the
@@ -3633,6 +3755,30 @@ class ContinuousBatcher:
                     if self._mesh_placed else None
                 ),
             )
+            if timeout_s is not None:
+                # Bounded wait: poll the staged transfers (non-blocking
+                # is_ready, the swap-in path's own probe) against the
+                # wall deadline; a wedge unwinds instead of pinning
+                # the allocation forever.  Raises (rather than
+                # returning 0) so the scheduler can tell an ABORT from
+                # the benign already-resident/no-capacity no-op.
+                deadline = time.monotonic() + timeout_s
+                while not restore_ready(staged):
+                    if time.monotonic() >= deadline:
+                        self.kv_handoff_aborted_total += 1
+                        self._invalidate_and_free(fresh)
+                        self.obs.annotate(
+                            "prefix_import_aborted",
+                            blocks=len(todo),
+                            request_id=request_id,
+                            timeout_s=timeout_s,
+                        )
+                        raise TimeoutError(
+                            f"prefix import: staged transfer of "
+                            f"{len(todo)} block(s) not ready within "
+                            f"{timeout_s}s (unwound cleanly)"
+                        )
+                    time.sleep(0.001)
             # audit: host-fetch(blocking handoff import: synchronous
             # admission-class landing of peer slabs — nothing is
             # decoding on behalf of this not-yet-admitted session)
@@ -3668,24 +3814,12 @@ class ContinuousBatcher:
             # call's own allocation).
             self._unclaim_blocks(have)
 
-    @staticmethod
-    def _chain_keys(tokens: Sequence[int], block_size: int) -> List[bytes]:
-        """Chain hash per FULL prompt block: key_j = H(key_{j-1}, block-j
-        tokens), so a hit at block j certifies the whole prefix up to it.
-        Only blocks strictly before the last token are keyed (at least
-        one token must run through the model to produce the first sample).
-        """
-        m = (len(tokens) - 1) // block_size
-        keys: List[bytes] = []
-        h = hashlib.blake2b(digest_size=16)
-        for j in range(m):
-            h.update(
-                np.asarray(
-                    tokens[j * block_size:(j + 1) * block_size], np.int32
-                ).tobytes()
-            )
-            keys.append(h.digest())  # digest() is non-destructive
-        return keys
+    # Chain hash per FULL prompt block: key_j = H(key_{j-1}, block-j
+    # tokens), so a hit at block j certifies the whole prefix up to
+    # it.  The implementation lives in router.chain_keys — the ONE
+    # shared key schema the router-side global radix index must agree
+    # with (router.py stays jax-free, so the pure helper lives there).
+    _chain_keys = staticmethod(_router_chain_keys)
 
     def _match_prefix(self, keys: List[bytes]) -> MatchResult:
         """Longest cached chain prefix across ALL cached chains (the

@@ -1,0 +1,716 @@
+"""Globally cache-aware routing (PR 14): the router-side global radix
+index (incremental digest sync), the cache-aware policy (deepest-prefix
+routing, occupancy spill, stale-digest degradation), the handoff
+scheduler (bounded, deduplicated, cancellation-safe chain migration
+with demote-after-export), and prefill/decode disaggregation roles —
+all token-identical to the single-replica oracle."""
+
+import json
+import urllib.request
+
+import jax
+import pytest
+
+from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.kvcache import KvDigest
+from jax_llama_tpu.router import (
+    ReplicaRouter, RouterRadixIndex, chain_keys,
+)
+from jax_llama_tpu.server import LLMServer
+from jax_llama_tpu.serving import ContinuousBatcher
+from jax_llama_tpu.tokenizers.bytes import ByteTokenizer
+
+pytestmark = pytest.mark.mesh_serving
+
+CFG = dict(
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    multiple_of=32, max_seq_len=128, dtype="float32",
+    param_dtype="float32",
+)
+
+# Long enough for 2 chain-key blocks at block_size=16 (41 tokens with
+# the ByteTokenizer bos) while keeping every prompt + max_new inside
+# the max_len=64 geometry (the SAME geometry test_router.py uses, so
+# the two files share one set of jitted-program compiles in tier-1).
+SESSION = "the quick brown fox jumps over the lazy d"
+REVISIT = SESSION + " next!"
+OTHER = "a completely different conversation starts h"
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = get_config("tiny", **CFG)
+    params = init_params(jax.random.PRNGKey(0), config)
+    return params, config
+
+
+def _mk_batcher(model, tok, **kw):
+    params, config = model
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 64)
+    return ContinuousBatcher(
+        params, config, stop_tokens=tuple(tok.stop_tokens), **kw
+    )
+
+
+def _serve_direct(cb, tok, prompts, max_new=6, seeds=None):
+    rids = [
+        cb.submit(
+            tok.encode(p, bos=True), max_new_tokens=max_new,
+            **({"seed": seeds[i]} if seeds else {}),
+        )
+        for i, p in enumerate(prompts)
+    ]
+    done = cb.run_to_completion()
+    return [done[r] for r in rids]
+
+
+def _post(url, payload, path="/generate", timeout=300):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _stream_tokens(url, payload, timeout=300):
+    req = urllib.request.Request(
+        url + "/generate",
+        data=json.dumps(dict(payload, stream=True)).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    toks = []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        hdrs = dict(r.headers)
+        for line in r:
+            obj = json.loads(line)
+            if "token" in obj:
+                toks.append(obj["token"])
+    return toks, hdrs
+
+
+# ---------------------------------------------------------------------------
+# Host-only units: shared key schema, digest journal, global index
+# ---------------------------------------------------------------------------
+
+def test_chain_key_schema_shared_with_batcher():
+    """router.chain_keys IS the batcher's chain-key schema (the
+    delegation must never drift — the global index routes on it)."""
+    toks = list(range(1, 40))
+    assert chain_keys(toks, 16) == ContinuousBatcher._chain_keys(
+        toks, 16
+    )
+    # Only blocks strictly before the last token are keyed.
+    assert len(chain_keys(toks, 16)) == (len(toks) - 1) // 16
+    assert chain_keys(toks[:10], 16) == []
+
+
+def test_digest_journal_incremental_sync_semantics():
+    d = KvDigest()
+    assert d.events_since(0) == ([], 0)
+    d.on_publish(b"k1", 1)
+    d.on_publish(b"k2", 2)
+    ev, ver = d.events_since(0)
+    assert ver == d.version == 2
+    assert [e["op"] for e in ev] == ["publish", "publish"]
+    assert ev[0]["key"] == b"k1".hex() and ev[0]["depth"] == 1
+    # Tier transitions journal with their target tier.
+    d.on_demote(b"k2")
+    d.on_restore(b"k2")
+    d.on_remove(b"k1")
+    ev, ver = d.events_since(2)
+    assert [(e["op"], e["tier"]) for e in ev] == [
+        ("demote", "host"), ("restore", "hbm"), ("remove", "hbm"),
+    ]
+    # Catching up from the current version is an empty delta.
+    assert d.events_since(ver) == ([], ver)
+    # A consumer from the FUTURE (rebuild reset the digest) resyncs.
+    fresh = KvDigest()
+    fresh.on_publish(b"k1", 1)
+    assert fresh.events_since(ver) is None
+    # A consumer past the bounded window resyncs.
+    big = KvDigest()
+    for i in range(KvDigest.JOURNAL_MAX + 10):
+        big.on_publish(b"key-%d" % i, 1)
+    assert big.events_since(0) is None
+    got = big.events_since(big.version - 5)
+    assert got is not None and len(got[0]) == 5
+
+
+def test_router_radix_index_lookup_and_sync():
+    idx = RouterRadixIndex()
+    k = [bytes([i]).hex() * 2 for i in range(4)]
+    idx.replace(
+        0,
+        [{"key": k[0], "depth": 1, "tier": "hbm"},
+         {"key": k[1], "depth": 2, "tier": "hbm"}],
+        version=5, block_bytes=1024,
+    )
+    idx.replace(
+        1, [{"key": k[0], "depth": 1, "tier": "hbm"}],
+        version=3, block_bytes=1024,
+    )
+    # Deepest prefix wins: replica 0 holds depth 2.
+    depth, holders = idx.lookup(k[:3], {0, 1})
+    assert depth == 2 and holders == [(0, "hbm")]
+    # Restricted to replica 1, the depth-1 key is the best match.
+    depth, holders = idx.lookup(k[:3], {1})
+    assert depth == 1 and holders == [(1, "hbm")]
+    # Fleet-wide miss.
+    assert idx.lookup([k[3]], {0, 1}) is None
+    assert idx.synced_version(0) == 5 and idx.block_bytes(0) == 1024
+    # Incremental events: demote flips the tier, remove drops the key.
+    idx.apply_events(
+        0,
+        [{"op": "demote", "key": k[1], "depth": 2, "tier": "host"},
+         {"op": "remove", "key": k[0]},
+         {"op": "host_evict", "key": k[1]}],  # counter-only: ignored
+        version=8,
+    )
+    depth, holders = idx.lookup(k[:2], {0})
+    assert (depth, holders) == (2, [(0, "host")])
+    assert idx.lookup([k[0]], {0}) is None
+    assert idx.synced_version(0) == 8
+    # Optimistic handoff note: dst gains hbm, src drops to host.
+    idx.note_handoff(1, 0, [k[0]])
+    assert idx.lookup([k[0]], {0}) == (1, [(0, "hbm")])
+    assert idx.lookup([k[0]], {1}) == (1, [(1, "host")])
+    st = idx.stats()
+    assert st["replicas_synced"] == 2 and st["resyncs_total"] == 2
+    assert st["events_applied_total"] == 3
+
+
+def test_epoch_change_forces_full_resync(monkeypatch):
+    """A rebuild mints a new digest epoch; even when the rebuilt
+    replica's replayed version catches up to (or passes) the synced
+    one, the router must FULL-resync — version arithmetic across
+    epochs is meaningless (a bogus incremental delta would keep
+    phantom pre-crash keys in the index forever)."""
+    router = ReplicaRouter(
+        ["127.0.0.1:1"], policy="cache-aware",
+        health_interval_s=0, block_size=16,
+    )
+    router.index.replace(0, [], version=9, epoch="epoch-A")
+    asked = []
+
+    def fake_get(rep, path, timeout=2.0):
+        asked.append(path)
+        return 200, {"version": 9, "nodes": [],
+                     "summary": {"epoch": "epoch-B"}}
+
+    monkeypatch.setattr(router, "_get_replica_json", fake_get)
+    rep = router._replicas[0]
+    # Same version (9) but a NEW epoch: same-version short-circuit
+    # must not fire; the fetch must be the full walk, not ?since=9.
+    router._sync_index(rep, {
+        "kv": {"digest": {"version": 9, "epoch": "epoch-B"}},
+    })
+    assert asked == ["/debug/kv?n=1000000"]
+    assert router.index.synced_epoch(0) == "epoch-B"
+    # Same epoch + same version: no fetch at all.
+    router._sync_index(rep, {
+        "kv": {"digest": {"version": 9, "epoch": "epoch-B"}},
+    })
+    assert len(asked) == 1
+    # Same epoch, newer version: incremental.
+    router._sync_index(rep, {
+        "kv": {"digest": {"version": 11, "epoch": "epoch-B"}},
+    })
+    # Uncapped even on the incremental form: a server-side journal
+    # gap falls back to the full walk, which must not truncate.
+    assert asked[-1] == "/debug/kv?since=9&n=1000000"
+
+
+def test_cache_pick_spill_watermark_and_handoff_plan():
+    """The pick decision table, white-box: deep hit routes to the
+    holder under the watermark, spills to least-loaded past it with a
+    migration plan once depth x load-gap clears the threshold; the
+    scheduler's admission dedups chains and refuses out-of-process
+    replicas."""
+    router = ReplicaRouter(
+        ["127.0.0.1:1", "127.0.0.1:2"], policy="cache-aware",
+        health_interval_s=0, block_size=16,
+        handoff_threshold=1.0, handoff_min_depth=1,
+    )
+    k = [bytes([i]).hex() * 2 for i in range(3)]
+    router.index.replace(
+        0, [{"key": k[0], "depth": 1, "tier": "hbm"},
+            {"key": k[1], "depth": 2, "tier": "hbm"}],
+        version=1, block_bytes=512,
+    )
+    for rep in router._replicas:
+        rep.last_health = {
+            "replica": {"n_slots": 2},
+            "kv": {"digest": {"version": 1 if rep.index == 0 else 0}},
+        }
+    with router._lock:
+        rep, how, stale, plan = router._pick_locked(
+            None, frozenset(), k[:2]
+        )
+    assert (rep.index, how, stale, plan) == (0, "cache-aware", False,
+                                             None)
+    assert router.cache_hit_depth_blocks_total == 2
+    # Holder past the occupancy watermark (2 inflight / 2 slots = 1.0
+    # >= spill_occupancy 1.0): spill to least-loaded + migration plan
+    # (score = depth 2 x gap 1.0 = 2.0 >= threshold 1.0).
+    router._replicas[0].inflight = 2
+    with router._lock:
+        rep, how, stale, plan = router._pick_locked(
+            None, frozenset(), k[:2]
+        )
+    assert (rep.index, how) == (1, "spill")
+    assert plan == {"src": 0, "dst": 1, "keys_hex": k[:2], "depth": 2}
+    # Cold prompts stay least-loaded.
+    with router._lock:
+        rep, how, _, plan = router._pick_locked(
+            None, frozenset(), [k[2]]
+        )
+    assert (rep.index, how, plan) == (1, "least-loaded", None)
+    # Scheduler admission: out-of-process replicas cannot handoff.
+    router._schedule_handoff(
+        {"src": 0, "dst": 1, "keys_hex": k[:2], "depth": 2}, None
+    )
+    assert router.handoffs_skipped_total == 1
+    assert router.handoffs_scheduled_total == 0
+    # Unknown policy/roles refusals.
+    with pytest.raises(ValueError):
+        ReplicaRouter(["127.0.0.1:1"], policy="cache-aware")
+    with pytest.raises(ValueError):
+        ReplicaRouter(
+            ["127.0.0.1:1", "127.0.0.1:2"], policy="cache-aware",
+            block_size=16, roles=("prefill", "prefill"),
+        )
+    with pytest.raises(ValueError):
+        ReplicaRouter(
+            ["127.0.0.1:1", "127.0.0.1:2"], policy="least-loaded",
+            roles=("prefill", "decode"),
+        )
+
+
+def test_stale_digest_detection_counts_and_routes():
+    """An index hit whose holder's LIVE digest version moved past the
+    synced one is a counted stale route — still routed (locality
+    hint), never refused."""
+    router = ReplicaRouter(
+        ["127.0.0.1:1", "127.0.0.1:2"], policy="cache-aware",
+        health_interval_s=0, block_size=16,
+    )
+    k = ["aa" * 8]
+    router.index.replace(
+        0, [{"key": k[0], "depth": 1, "tier": "hbm"}], version=1,
+    )
+    router._replicas[0].last_health = {
+        "replica": {"n_slots": 2},
+        "kv": {"digest": {"version": 7}},  # moved past synced=1
+    }
+    with router._lock:
+        rep, how, stale, _ = router._pick_locked(
+            None, frozenset(), k
+        )
+    assert (rep.index, how, stale) == (0, "cache-aware", True)
+    assert router.cache_stale_routes_total == 1
+
+
+# ---------------------------------------------------------------------------
+# Serving-level handoff hardening: bounds, demote-after-export, unwind
+# ---------------------------------------------------------------------------
+
+def test_export_bounds_and_demote_after_export_digest_delta(model):
+    """Byte-capped export + demote-after-export: the source's digest
+    loses HBM residency for the exported chain (loss_version bumps,
+    hbm drops — THE delta that shrinks fleet duplicate bytes) and the
+    freed blocks return to the allocator."""
+    tok = ByteTokenizer()
+    src = _mk_batcher(model, tok)
+    _serve_direct(src, tok, [SESSION])
+    toks = tok.encode(SESSION, bos=True)
+    keys = src._chain_keys(toks, src.block_size)
+    assert len(keys) == 2
+    # Byte cap truncates block-aligned from the root.
+    capped, slabs = src.export_prefix(
+        toks, max_bytes=src.block_bytes
+    )
+    assert len(slabs) == 1 and capped == keys[:1]
+    before = src.kv_digest.summary()
+    free_before = len(src.free_blocks)
+    full_keys, slabs = src.export_prefix(
+        keys=keys, demote_after_export=True
+    )
+    assert len(slabs) == 2 and full_keys == keys
+    after = src.kv_digest.summary()
+    assert after["hbm_blocks"] == before["hbm_blocks"] - 2
+    assert after["loss_version"] > before["loss_version"]
+    assert src.kv_export_demoted_blocks_total == 2
+    assert len(src.free_blocks) == free_before + 2
+    # Nothing resident: a re-export of the same chain is empty.
+    assert src.export_prefix(keys=keys) == ([], [])
+    # The importing side lands the chain and the next admission is a
+    # prefix hit (token identity THROUGH an import is pinned by the
+    # disaggregation drill below — one less batcher build here keeps
+    # the cell inside the tier-1 budget).
+    dst = _mk_batcher(model, tok)
+    n = dst.import_prefix(full_keys, slabs)
+    assert n == 2
+    hits_before = dst.prefix_requests_hit
+    got = _serve_direct(dst, tok, [REVISIT], seeds=[5])
+    assert len(got[0]) > 0
+    assert dst.prefix_requests_hit == hits_before + 1
+    assert dst.prefix_hit_tokens_total >= 2 * dst.block_size
+
+
+def test_import_timeout_unwinds_cleanly(model, monkeypatch):
+    """A wedged staged transfer unwinds: blocks freed, nothing
+    published, kv_handoff_aborted_total counted — and a later
+    unbounded retry of the SAME slabs lands (cancellation-safe)."""
+    import jax_llama_tpu.serving as serving_mod
+
+    tok = ByteTokenizer()
+    src = _mk_batcher(model, tok)
+    _serve_direct(src, tok, [SESSION])
+    keys, slabs = src.export_prefix(tok.encode(SESSION, bos=True))
+    dst = _mk_batcher(model, tok)
+    free_before = len(dst.free_blocks)
+    monkeypatch.setattr(
+        serving_mod, "restore_ready", lambda staged: False
+    )
+    with pytest.raises(TimeoutError):
+        dst.import_prefix(keys, slabs, timeout_s=0.02)
+    assert dst.kv_handoff_aborted_total == 1
+    assert len(dst.free_blocks) == free_before
+    assert dst.kv_digest.summary()["nodes"] == 0  # no partial publish
+    monkeypatch.undo()
+    assert dst.import_prefix(keys, slabs, timeout_s=30.0) == len(slabs)
+    assert dst.kv_digest.summary()["nodes"] == len(slabs)
+
+
+# ---------------------------------------------------------------------------
+# Routed-fleet acceptance drills
+# ---------------------------------------------------------------------------
+
+def _mk_fleet(model, tok, n=2, **router_kw):
+    servers = []
+    for i in range(n):
+        cb = _mk_batcher(model, tok)
+        servers.append(
+            LLMServer(cb, tokenizer=tok, replica_id=i).start()
+        )
+    router_kw.setdefault("policy", "cache-aware")
+    router_kw.setdefault("health_interval_s", 0)  # manual sync
+    router_kw.setdefault("tokenizer", tok)
+    router_kw.setdefault("block_size", servers[0].batcher.block_size)
+    router = ReplicaRouter(servers, **router_kw).start()
+    return router, servers
+
+
+def test_cache_aware_deep_hit_token_identical_to_oracle(model):
+    """ACCEPTANCE PIN: the revisit of a warm session routes to the
+    digest-matched replica (not the least-loaded one) and is
+    token-identical to the 1-replica oracle — greedy, seeded-sampled,
+    and streaming."""
+    tok = ByteTokenizer()
+    oracle_cb = _mk_batcher(model, tok)
+    want_cold = _serve_direct(oracle_cb, tok, [SESSION])
+    oracle2 = _mk_batcher(model, tok)
+    _serve_direct(oracle2, tok, [SESSION])
+    want_greedy = _serve_direct(oracle2, tok, [REVISIT])
+    want_seeded = _serve_direct(oracle2, tok, [REVISIT], seeds=[11])
+
+    router, servers = _mk_fleet(model, tok)
+    try:
+        # Cold session: least-loaded tie-break lands replica 0.
+        st, body, hdrs = _post(
+            router.address, {"text": SESSION, "max_new_tokens": 6}
+        )
+        assert st == 200 and body["tokens"] == want_cold[0]
+        warm = int(hdrs["X-Replica-Id"])
+        router.check_health_now()  # scrape + index sync
+        assert router.index.stats()["nodes"] >= 2
+        # A different cold prompt balances onto the OTHER replica...
+        st, _, hdrs = _post(
+            router.address, {"text": OTHER, "max_new_tokens": 4}
+        )
+        assert int(hdrs["X-Replica-Id"]) != warm
+        # ...but the revisit routes BACK to the warm one by index hit.
+        st, body, hdrs = _post(
+            router.address, {"text": REVISIT, "max_new_tokens": 6}
+        )
+        assert st == 200
+        assert int(hdrs["X-Replica-Id"]) == warm
+        assert body["tokens"] == want_greedy[0]
+        st, body, hdrs = _post(
+            router.address,
+            {"text": REVISIT, "max_new_tokens": 6, "seed": 11},
+        )
+        assert body["tokens"] == want_seeded[0]
+        assert int(hdrs["X-Replica-Id"]) == warm
+        toks, hdrs = _stream_tokens(
+            router.address, {"text": REVISIT, "max_new_tokens": 6}
+        )
+        assert toks == want_greedy[0]
+        assert int(hdrs["X-Replica-Id"]) == warm
+        with router._lock:
+            assert router.routed_by_policy["cache-aware"] >= 3
+        # The observability surface carries the index + decisions.
+        metrics = router.metrics_text()
+        assert "llm_router_cache_index_nodes" in metrics
+        assert 'policy="cache-aware"' in metrics
+        h = router.health()
+        assert h["cache_index"]["nodes"] >= 2
+        assert h["cache_index"]["syncs_total"] >= 1
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_spill_schedules_handoff_and_chain_migrates(model):
+    """The scheduler half: a loaded deepest-prefix holder spills the
+    request to least-loaded AND migrates the chain there
+    (export -> import through the control paths, demote-after-export
+    deduplicating the source).  After migration the next revisit
+    routes to the new home, token-identically."""
+    tok = ByteTokenizer()
+    oracle = _mk_batcher(model, tok)
+    _serve_direct(oracle, tok, [SESSION])
+    want = _serve_direct(oracle, tok, [REVISIT])
+
+    router, servers = _mk_fleet(
+        model, tok, handoff_threshold=0.5, handoff_min_depth=1,
+    )
+    try:
+        st, _, hdrs = _post(
+            router.address, {"text": SESSION, "max_new_tokens": 6}
+        )
+        src = int(hdrs["X-Replica-Id"])
+        dst = 1 - src
+        router.check_health_now()
+        src_hbm = servers[src].batcher.kv_digest.summary()["hbm_blocks"]
+        assert src_hbm >= 2
+        # Pin the holder past the watermark (white-box: the router
+        # tracks inflight itself; health said n_slots=2).
+        with router._lock:
+            router._replicas[src].inflight = 4
+        st, body, hdrs = _post(
+            router.address, {"text": REVISIT, "max_new_tokens": 6}
+        )
+        assert st == 200 and body["tokens"] == want[0]
+        assert int(hdrs["X-Replica-Id"]) == dst  # spilled
+        with router._lock:
+            assert router.routed_by_policy["spill"] >= 1
+        assert router.wait_handoffs(20.0)
+        with router._lock:
+            completed = router.handoffs_completed_total
+            empty = router.handoffs_empty_total
+            scheduled = router.handoffs_scheduled_total
+            handoffs = router.kv_handoffs_total
+        # Exactly one migration ran: either it landed the slabs
+        # (completed) or the spilled request's own cold prefill beat
+        # them to the destination (empty — the dedup outcome is the
+        # same).  Never aborted, never more than one per chain.
+        assert scheduled == 1 and completed + empty == 1
+        assert handoffs == completed
+        assert router.handoffs_aborted_total == 0
+        # The chain MOVED: destination digest holds it HBM-resident,
+        # the demoted source lost HBM residency (dedup).
+        assert (
+            servers[dst].batcher.kv_digest.summary()["hbm_blocks"] >= 2
+        )
+        assert (
+            servers[src].batcher.kv_digest.summary()["hbm_blocks"]
+            < src_hbm
+        )
+        assert servers[src].batcher.kv_export_demoted_blocks_total > 0
+        # Un-load the old holder and resync; the revisit routes to the
+        # chain's new home, token-identically, as a prefix hit.
+        with router._lock:
+            router._replicas[src].inflight = 0
+        router.check_health_now()
+        hits_before = servers[dst].batcher.prefix_requests_hit
+        st, body, hdrs = _post(
+            router.address, {"text": REVISIT, "max_new_tokens": 6}
+        )
+        assert body["tokens"] == want[0]
+        assert int(hdrs["X-Replica-Id"]) == dst
+        assert (
+            servers[dst].batcher.prefix_requests_hit == hits_before + 1
+        )
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_stale_route_degrades_to_counted_cold_prefill(model):
+    """Mid-flight chain loss (loss_version bump after the index
+    synced): the route still lands on the old holder, the staleness is
+    COUNTED, and the served tokens are identical to the oracle — a
+    cold prefill, never wrong tokens."""
+    tok = ByteTokenizer()
+    oracle = _mk_batcher(model, tok)
+    _serve_direct(oracle, tok, [SESSION])
+    want = _serve_direct(oracle, tok, [REVISIT])
+
+    router, servers = _mk_fleet(model, tok)
+    try:
+        st, _, hdrs = _post(
+            router.address, {"text": SESSION, "max_new_tokens": 6}
+        )
+        warm = int(hdrs["X-Replica-Id"])
+        router.check_health_now()
+
+        # Drop the chain ON the replica (loss_version bumps) without
+        # letting the index resync — then refresh only last_health so
+        # the router can SEE the version moved.
+        def drop_chains(b):
+            freed = []
+            for blk in list(b._store._by_block.keys()):
+                freed.extend(b._store.unpublish(blk))
+            b._invalidate_and_free(freed)
+            return b.kv_digest.summary()["loss_version"]
+
+        lost = servers[warm].call_on_loop(drop_chains)
+        assert lost > 0
+        rep = router._replicas[warm]
+        ok, payload = router._probe(rep)
+        assert ok
+        with router._lock:
+            rep.last_health = payload
+        st, body, hdrs = _post(
+            router.address, {"text": REVISIT, "max_new_tokens": 6}
+        )
+        assert st == 200 and body["tokens"] == want[0]
+        assert int(hdrs["X-Replica-Id"]) == warm
+        with router._lock:
+            assert router.cache_stale_routes_total >= 1
+        assert "llm_router_cache_stale_routes_total" in (
+            router.metrics_text()
+        )
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_mid_handoff_replica_fault_reroutes_losslessly(model):
+    """router_replica fault while a handoff is in flight: the request
+    re-routes losslessly (pre-byte failure stage) and the tokens stay
+    oracle-identical."""
+    from jax_llama_tpu.faults import FaultInjector
+
+    tok = ByteTokenizer()
+    oracle = _mk_batcher(model, tok)
+    want = _serve_direct(oracle, tok, [SESSION])
+
+    router, servers = _mk_fleet(
+        model, tok,
+        fault_injector=FaultInjector("router_replica@1:error"),
+        handoff_threshold=0.5,
+    )
+    try:
+        st, body, hdrs = _post(
+            router.address, {"text": SESSION, "max_new_tokens": 6}
+        )
+        assert st == 200 and body["tokens"] == want[0]
+        router.check_health_now()
+        # Load the holder and schedule a migration; the SECOND forward
+        # (fault index 2) fires mid-handoff and re-routes.
+        src = int(hdrs["X-Replica-Id"])
+        with router._lock:
+            router._replicas[src].inflight = 4
+        st, body, _ = _post(
+            router.address, {"text": SESSION, "max_new_tokens": 6}
+        )
+        assert st == 200 and body["tokens"] == want[0]
+        with router._lock:
+            assert router.reroutes_total == 1
+        assert router.wait_handoffs(20.0)
+        router.check_health_now()  # both replicas healthy again
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_prefill_decode_disaggregation_smoke(model):
+    """--replica-roles semantics end to end: a cold session prefills
+    (and serves) on the prefill replica, its chain streams to the
+    decode replica at completion, and the revisit decodes there warm —
+    token-identical to the oracle throughout."""
+    tok = ByteTokenizer()
+    oracle = _mk_batcher(model, tok)
+    want_cold = _serve_direct(oracle, tok, [SESSION])
+    want_rev = _serve_direct(oracle, tok, [REVISIT])
+
+    router, servers = _mk_fleet(
+        model, tok, roles=("prefill", "decode"),
+    )
+    try:
+        st, body, hdrs = _post(
+            router.address, {"text": SESSION, "max_new_tokens": 6}
+        )
+        assert st == 200 and body["tokens"] == want_cold[0]
+        assert int(hdrs["X-Replica-Id"]) == 0  # prefill role
+        with router._lock:
+            assert router.routed_by_policy["prefill-role"] >= 1
+        # Completion triggers the prefill -> decode chain stream.
+        assert router.wait_handoffs(20.0)
+        with router._lock:
+            assert router.handoffs_completed_total == 1
+        assert (
+            servers[1].batcher.kv_digest.summary()["hbm_blocks"] >= 2
+        )
+        hits_before = servers[1].batcher.prefix_requests_hit
+        st, body, hdrs = _post(
+            router.address, {"text": REVISIT, "max_new_tokens": 6}
+        )
+        assert st == 200 and body["tokens"] == want_rev[0]
+        assert int(hdrs["X-Replica-Id"]) == 1  # decodes warm
+        assert (
+            servers[1].batcher.prefix_requests_hit == hits_before + 1
+        )
+        assert router.health()["roles"] == ["prefill", "decode"]
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_incremental_sync_rides_health_poll(model):
+    """The index syncs INCREMENTALLY: after the initial full walk,
+    later digest deltas arrive as journal events (resyncs_total stays
+    at the initial walks) — and a /debug/kv?since= round-trip through
+    the live server carries the events form."""
+    tok = ByteTokenizer()
+    router, servers = _mk_fleet(model, tok)
+    try:
+        _post(router.address, {"text": SESSION, "max_new_tokens": 4})
+        router.check_health_now()
+        st1 = router.index.stats()
+        assert st1["nodes"] >= 2
+        resyncs_after_first = st1["resyncs_total"]
+        _post(router.address, {"text": OTHER, "max_new_tokens": 4})
+        router.check_health_now()
+        st2 = router.index.stats()
+        assert st2["nodes"] > st1["nodes"]
+        assert st2["events_applied_total"] >= 1
+        assert st2["resyncs_total"] == resyncs_after_first
+        # The wire form: since=<current> is an empty event delta.
+        ver = servers[0].batcher.kv_digest.summary()["version"]
+        with urllib.request.urlopen(
+            servers[0].address + f"/debug/kv?since={ver}", timeout=30
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["events"] == [] and doc["version"] == ver
+        # since far past the version (stale consumer of a rebuilt
+        # digest) falls back to the resync walk.
+        with urllib.request.urlopen(
+            servers[0].address + f"/debug/kv?since={ver + 9999}",
+            timeout=30,
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc.get("resync") is True and "nodes" in doc
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()

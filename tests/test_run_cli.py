@@ -262,3 +262,87 @@ def test_run_cli_serve_mesh_flag_validation(tmp_path, monkeypatch):
     )
     with pytest.raises(SystemExit, match="devices"):
         run_cli.main()
+    # --replica-roles: wrong count, bad role, missing a role class,
+    # and the cache-aware policy requirement — all pre-weight-load.
+    base = ["run", "--ckpt-dir", str(tmp_path), "--byte-tokenizer",
+            "--http", "0", "--replicas", "2"]
+    for extra, msg in (
+        (["--replica-roles", "prefill"], "one role per replica"),
+        (["--replica-roles", "prefill,cook"], "unknown role"),
+        (["--replica-roles", "prefill,prefill",
+          "--route", "cache-aware"], "EACH role"),
+        (["--replica-roles", "prefill,decode"], "cache-aware"),
+    ):
+        monkeypatch.setattr(sys, "argv", base + extra)
+        with pytest.raises(SystemExit, match=msg):
+            run_cli.main()
+
+
+@pytest.mark.slow
+def test_run_cli_cache_aware_disaggregation(
+    tmp_path, capsys, monkeypatch,
+):
+    """--route cache-aware + --replica-roles prefill,decode from the
+    CLI: a cold session prefills on replica 0, its chain streams to
+    the decode replica, and the revisit lands there warm (slow tier;
+    make fleet runs it — the routing/scheduler behavior itself is
+    pinned tier-1 by test_cache_routing.py)."""
+    import json
+    import urllib.request
+
+    config = get_config(
+        "tiny", vocab_size=512, dim=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, multiple_of=32, max_seq_len=96,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    ckpt = tmp_path / "ckpt"
+    save_checkpoint(str(ckpt), params, config)
+
+    hits = {}
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url + "/generate", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.loads(r.read()), r.headers.get("X-Replica-Id")
+
+    session = "the quick brown fox jumps over the lazy d"
+
+    def hook(router, servers):
+        _, rep0 = post(
+            router.address,
+            {"text": session, "max_new_tokens": 4,
+             "temperature": 0.0},
+        )
+        hits["cold_replica"] = rep0
+        hits["handoff_done"] = router.wait_handoffs(20.0)
+        _, rep1 = post(
+            router.address,
+            {"text": session + " and a second turn",
+             "max_new_tokens": 4, "temperature": 0.0},
+        )
+        hits["revisit_replica"] = rep1
+        hits["health"] = router.health()
+
+    orig = run_cli._serve_router
+    monkeypatch.setattr(
+        run_cli, "_serve_router",
+        lambda *a, **kw: orig(*a, **{**kw, "_test_hook": hook}),
+    )
+    monkeypatch.setattr(
+        sys, "argv",
+        ["run", "--ckpt-dir", str(ckpt), "--byte-tokenizer",
+         "--http", "0", "--replicas", "2", "--route", "cache-aware",
+         "--replica-roles", "prefill,decode", "--slots", "2",
+         "--tensor", "1"],
+    )
+    run_cli.main()
+    assert hits["cold_replica"] == "0"  # prefill role
+    assert hits["handoff_done"]
+    assert hits["revisit_replica"] == "1"  # decodes warm
+    h = hits["health"]
+    assert h["policy"] == "cache-aware"
+    assert h["roles"] == ["prefill", "decode"]
+    assert h["handoff"]["completed_total"] >= 1

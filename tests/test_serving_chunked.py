@@ -109,9 +109,16 @@ def test_chunk_token_identity_stop_token_mid_chunk(model, K):
     assert got == want
 
 
+@pytest.mark.slow
 def test_chunk_token_identity_logprobs(model):
     """logprobs mode: the packed (bitcast) per-token logprob block must
-    deliver the same values the K=1 loop reports, token for token."""
+    deliver the same values the K=1 loop reports, token for token.
+
+    Slow tier (r14 budget rebalance, ~11 s of logprobs-program
+    compiles): chunked logprob identity stays tier-1-pinned by
+    test_serving_fused's identity cells, which assert the same packed
+    logprob block allclose against the classic oracle on every
+    tier-1 run."""
     params, config = model
     base, base_lp = _run_matrix(params, config, 1, logprobs=True)
     got, got_lp = _run_matrix(params, config, 4, logprobs=True)
@@ -120,9 +127,15 @@ def test_chunk_token_identity_logprobs(model):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_chunk_token_identity_int8_kv(model):
     """The int8 pool's quantized scan branches (per-iteration scale
-    plane writes inside the chunk) must match their K=1 emissions."""
+    plane writes inside the chunk) must match their K=1 emissions.
+
+    Slow tier (r14 budget rebalance, ~13 s: the int8 config compiles
+    its own oracle AND chunk executables): int8-KV identity stays
+    tier-1-pinned by test_kvcache's int8 chunk-matched-oracle parity
+    cells and test_serving_spec's int8 cell."""
     params, config = model
     import dataclasses
     qconfig = dataclasses.replace(config, kv_cache_dtype="int8")
@@ -131,8 +144,14 @@ def test_chunk_token_identity_int8_kv(model):
     assert got == base
 
 
+@pytest.mark.slow
 def test_chunk_token_identity_gathered_fallback(model):
-    """The gathered-view fallback (use_pallas_kernel=False) chunks
+    """slow (r14 budget rebalance, ~7 s): the quarantine drill
+    test_chunked_paged_kernel_quarantine_falls_back keeps the
+    gathered-fallback-under-chunking contract in tier-1 (it lands on
+    exactly this configuration and checks token identity through it).
+
+    The gathered-view fallback (use_pallas_kernel=False) chunks
     identically — the scan body's gather/scatter path is per-iteration
     the same program as one K=1 dispatch."""
     params, config = model

@@ -111,17 +111,20 @@ _SLOW = pytest.mark.slow
 @pytest.mark.parametrize(
     "budget,sampled,prefix",
     [
-        # Tier-1 slice: a pairwise-style pick of the two budget
-        # extremes crossed against policy and prefix-hit — every axis
-        # value appears against every other at least once.  The FULL
+        # Tier-1 slice (r14 budget rebalance, tier-1 measured AT its
+        # 870 s ceiling): the two budget extremes, greedy at the block
+        # budget and sampled at ∞ — both budgets and both policies
+        # stay pinned.  The prefix-hit fused cells ride the slow tier
+        # because fused×prefix-hit token identity is ALREADY tier-1-
+        # pinned by test_kvcache's {fused, classic} × hit-depth parity
+        # matrix (PR 6) — this file's hit cells re-proved the same
+        # contract at ~18 s of compile-bound cost.  The FULL
         # {block, 2·block, ∞} × {greedy, sampled} × {hit, miss} cross
-        # runs in the unfiltered suite (slow marks): each budget
-        # compiles its own fused executables, and tier-1's 870 s
-        # budget cannot absorb 12 compile-bound cells.
+        # runs in the unfiltered suite (slow marks).
         (BLOCK, False, False),
-        (BLOCK, True, True),
         (4096, True, False),
-        (4096, False, True),
+        pytest.param(BLOCK, True, True, marks=_SLOW),
+        pytest.param(4096, False, True, marks=_SLOW),
         pytest.param(BLOCK, True, False, marks=_SLOW),
         pytest.param(BLOCK, False, True, marks=_SLOW),
         pytest.param(4096, False, False, marks=_SLOW),
