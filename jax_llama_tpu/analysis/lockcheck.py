@@ -90,8 +90,21 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
             "compiles", "compiles_total", "compiles_by_program",
             "requests_finished_total", "requests_failed_total",
             "requests_cancelled_total", "requests_slo_ok_total",
-            "goodput_tokens_total",
+            "goodput_tokens_total", "metric_snapshots",
         }),
+    ),
+    # Decision audit log (obs.py): serving-loop / poller / canary /
+    # handler threads record while /debug/decisions snapshots — a
+    # leaf lock never held while calling out.
+    LockGuard(
+        module="obs", cls="DecisionLog", lock="_lock",
+        fields=frozenset({"_ring", "_seq", "counts"}),
+    ),
+    # Structured logger (obs.py): every thread that logs appends to
+    # the flight-recorder tail ring; /debug/bundle snapshots it.
+    LockGuard(
+        module="obs", cls="StructuredLogger", lock="_lock",
+        fields=frozenset({"_ring"}),
     ),
     # Static cost-model cache (obs.py): serving-loop threads of
     # DIFFERENT batchers share the one module-level instance
@@ -147,7 +160,19 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
             "handoffs_aborted_total", "handoffs_skipped_total",
             "handoffs_empty_total", "handoff_blocks_total",
             "handoff_bytes_total", "_role_handoffs_pending",
+            "canary_probes_total", "canary_failures_total",
+            "canary_mismatches_total", "canary_oracle_repins_total",
+            "_canary_oracle", "_canary_seq",
         }),
+    ),
+    # Per-replica health sentinel (router.py): the canary prober and
+    # the health poller feed observations while handler threads read
+    # /debug/fleet and /metrics — all state under the sentinel's own
+    # leaf lock (never held while calling out; the router lock is
+    # never taken inside).
+    LockGuard(
+        module="router", cls="HealthSentinel", lock="_lock",
+        fields=frozenset({"_states", "anomalies_total"}),
     ),
     # Router-side global radix index (router.py): the health poller
     # writes syncs, handler threads read lookups at pick time, the
@@ -203,6 +228,9 @@ CONFINEMENTS: Tuple[ThreadConfinement, ...] = (
         foreign_methods=frozenset({
             "stats", "_window_acceptance", "acceptance_rate",
             "kv_debug_json", "_kv_summary",
+            # Ctor-stable config snapshot for /debug/bundle — touches
+            # no confined field by construction.
+            "describe",
         }),
         holders=frozenset({"batcher"}),
     ),
@@ -213,19 +241,26 @@ CONFINEMENTS: Tuple[ThreadConfinement, ...] = (
             "_active", "_pending_success", "_recovery_times",
         }),
         write_fields=frozenset({
-            "batcher", "ttft_ms_ewma", "recoveries_total",
+            "batcher", "ttft_ms_ewma", "itl_ms_ewma",
+            "recoveries_total",
             "quarantine_rebuilds_total", "probe_rebuilds_total",
             "nonfinite_failed_total", "watchdog_stalls_total",
-            "_stalled", "_heartbeat",
+            "_stalled", "_heartbeat", "canary_requests_total",
+            "_last_flight_t",
         }),
         foreign_methods=frozenset({
             "_watchdog", "_health", "_metrics_text",
+            "_metrics_scalars",
             "_handle_profiler", "_retry_after_s", "begin_drain",
             "wait_drained", "draining", "address", "stop", "start",
             # The handoff scheduler's control path: queues work for
             # the loop thread (thread-safe queue) and waits on the
             # call's own event — no confined field is touched.
             "call_on_loop",
+            # Flight-recorder artifact assembly (handler threads):
+            # snapshot reads through the same racy-read surfaces
+            # /metrics and /healthz already use.
+            "bundle_json", "_config_snapshot",
         }),
         holders=frozenset({"server"}),
     ),

@@ -21,6 +21,13 @@ emits.  This pass closes the loop statically:
 
 String constants inside statements that ASSIGN into ``METRICS`` are
 registration, not emission, and are excluded from the evidence.
+
+The ROUTER's exposition (router.py renders ``llm_router_*`` /
+``llm_fleet_*`` / ``llm_replica_*`` families itself, outside the
+obs.METRICS pipeline, off its own ``ROUTER_METRICS`` registry) gets
+the same two-way audit via :func:`check_router_registry` —
+``router-unemitted-metric`` / ``router-unregistered-metric`` findings,
+run as part of the package pass.
 """
 
 from __future__ import annotations
@@ -41,10 +48,17 @@ PROVIDERS: Tuple[Tuple[str, Optional[str], str], ...] = (
     ("obs", "Observability", "metrics"),
     ("overload", "OverloadController", "stats"),
     ("faults", "FaultInjector", "stats"),
-    ("server", "LLMServer", "_metrics_text"),
+    ("server", "LLMServer", "_metrics_scalars"),
 )
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# Family names the ROUTER's own exposition mints (full names — the
+# router renders outside the obs.METRICS pipeline, with its own
+# ``ROUTER_METRICS`` registry in router.py).
+_ROUTER_FAMILY_RE = re.compile(
+    r"llm_(?:router|fleet|replica)_[a-z0-9_]+"
+)
 
 
 def _is_metrics_assign(stmt: ast.stmt) -> bool:
@@ -178,11 +192,158 @@ def _provider_keys(
     return out
 
 
+def _is_named_assign(stmt: ast.stmt, name: str) -> bool:
+    """Does ``stmt`` assign into the variable ``name``?"""
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for leaf in ast.walk(t):
+            if isinstance(leaf, ast.Name) and leaf.id == name:
+                return True
+    return False
+
+
+def check_router_registry(
+    registry: Optional[Dict[str, Tuple[str, str]]] = None,
+    source: Optional[str] = None,
+    path: str = "jax_llama_tpu/router.py",
+) -> List[Finding]:
+    """Router-exposition audit: the ReplicaRouter renders its own
+    Prometheus text (``llm_router_*`` / ``llm_fleet_*`` /
+    ``llm_replica_*`` families) outside the obs.METRICS pipeline,
+    driven by the ``ROUTER_METRICS`` registry in router.py — so it
+    gets the same two-way contract:
+
+      * **router-unemitted-metric**: every registered family must be
+        emitted in router.py — a ``fam("name")`` header call or a
+        sample-line string mentioning the full name (registry
+        assignment and docstrings are not evidence).
+      * **router-unregistered-metric**: every family router.py emits
+        — a ``fam()`` first argument, or any family-shaped token
+        inside a non-docstring string constant / f-string constant
+        part — must be registered.
+    """
+    findings: List[Finding] = []
+    if registry is None:
+        from .. import router
+
+        registry = router.ROUTER_METRICS
+    if source is None:
+        for p, src in iter_package_sources():
+            if p.replace("\\", "/").endswith("/router.py"):
+                path, source = p, src
+                break
+    if source is None:
+        return [Finding(
+            checker=CHECKER, rule="stale-registry", path=path, line=0,
+            message="router.py not found in the audited package",
+        )]
+    tree, errs = parse_module(path, source, CHECKER)
+    findings.extend(errs)
+    if tree is None:
+        return findings
+    skip_spans: List[Tuple[int, int]] = []
+    for stmt in ast.walk(tree):
+        if isinstance(stmt, ast.stmt) and _is_named_assign(
+            stmt, "ROUTER_METRICS"
+        ):
+            skip_spans.append(
+                (stmt.lineno, stmt.end_lineno or stmt.lineno)
+            )
+        if isinstance(stmt, (ast.Module, ast.ClassDef,
+                             ast.FunctionDef, ast.AsyncFunctionDef)):
+            body = getattr(stmt, "body", [])
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                body[0].value, ast.Constant
+            ) and isinstance(body[0].value.value, str):
+                doc = body[0]
+                skip_spans.append(
+                    (doc.lineno, doc.end_lineno or doc.lineno)
+                )
+
+    def skipped(node: ast.AST) -> bool:
+        line = getattr(node, "lineno", 0)
+        return any(lo <= line <= hi for lo, hi in skip_spans)
+
+    emitted: Dict[str, int] = {}  # family -> first evidence line
+    fam_args: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if skipped(node):
+            continue
+        texts: List[str] = []
+        if isinstance(node, ast.Constant) and isinstance(
+            node.value, str
+        ):
+            texts.append(node.value)
+        elif isinstance(node, ast.JoinedStr):
+            texts.extend(
+                v.value for v in node.values
+                if isinstance(v, ast.Constant)
+                and isinstance(v.value, str)
+            )
+        for text in texts:
+            for name in _ROUTER_FAMILY_RE.findall(text):
+                emitted.setdefault(name, getattr(node, "lineno", 0))
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "fam"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            fam_args.append((node.args[0].value, node.lineno))
+            emitted.setdefault(node.args[0].value, node.lineno)
+    for name in sorted(registry):
+        if name not in emitted:
+            findings.append(Finding(
+                checker=CHECKER, rule="router-unemitted-metric",
+                path=path, line=0,
+                message=(
+                    f"ROUTER_METRICS registers {name!r} but router.py "
+                    "never emits it (no fam() header, no sample line) "
+                    "— dead registration; emit it or delete it"
+                ),
+            ))
+    flagged: set = set()
+    for name, line in fam_args:
+        if name not in registry and name not in flagged:
+            flagged.add(name)
+            findings.append(Finding(
+                checker=CHECKER, rule="router-unregistered-metric",
+                path=path, line=line,
+                message=(
+                    f"router.py declares family {name!r} via fam() "
+                    "but ROUTER_METRICS has no entry — register "
+                    "type + help or the exposition KeyErrors"
+                ),
+            ))
+    for name, line in sorted(emitted.items()):
+        if name not in registry and name not in flagged:
+            flagged.add(name)
+            findings.append(Finding(
+                checker=CHECKER, rule="router-unregistered-metric",
+                path=path, line=line,
+                message=(
+                    f"router.py emits family {name!r} (sample-line "
+                    "string) with no ROUTER_METRICS entry — it "
+                    "renders without HELP/TYPE; register it"
+                ),
+            ))
+    return findings
+
+
 def check_package(
     registry: Optional[Dict[str, Tuple[str, str]]] = None,
     sources: Optional[Sequence[Tuple[str, str]]] = None,
     providers: Tuple[Tuple[str, Optional[str], str], ...] = PROVIDERS,
 ) -> List[Finding]:
+    # Package mode (no fixture registry/sources): the router's own
+    # registry is audited alongside obs.METRICS.
+    package_mode = registry is None and sources is None
     findings: List[Finding] = []
     if registry is None:
         from .. import obs
@@ -267,4 +428,8 @@ def check_package(
                         "help line; register type + help"
                     ),
                 ))
+
+    # -- router exposition (its own registry, both directions) ---------------
+    if package_mode:
+        findings.extend(check_router_registry())
     return findings

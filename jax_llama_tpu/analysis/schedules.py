@@ -637,6 +637,9 @@ def _make_server_stub():
     s._active = {}
     s.overload = OverloadController(enabled=False)
     s.replica_id = None
+    # Control-plane observability (r15): _health's replica section
+    # reports the ITL EWMA the router's sentinel z-scores.
+    s.itl_ms_ewma = None
     return s
 
 

@@ -68,6 +68,28 @@ questions (and ROADMAP item 5's online chunk controller) need:
     tracks, fault/quarantine/kv-tier annotations as instant events —
     loadable in ``chrome://tracing`` or https://ui.perfetto.dev (the
     server serves it at ``GET /debug/trace``).
+  * **Decision audit log** (:class:`DecisionLog`).  Every control-plane
+    decision — a router's route/reroute/handoff pick (with the
+    candidate set and scores it chose from), a brownout-ladder rung
+    move, a crash-recovery/quarantine/probe rebuild, a shed — lands as
+    one ring-buffered structured event carrying the external request id
+    where one exists, so ``GET /debug/decisions`` answers "why did
+    request X land on replica Y" and joins back to the request's
+    ``/debug/requests/<id>`` timeline by id.  The server's decisions
+    live on its Observability instance (they survive batcher rebuilds
+    like everything else here); the ReplicaRouter owns its own log.
+  * **Flight recorder**.  The bounded rings above (decisions, the
+    annotation/state-transition ring, dispatch spans) plus a periodic
+    :meth:`Observability.record_metrics_snapshot` ring and the
+    :class:`StructuredLogger` tail are the black-box a postmortem
+    needs: ``GET /debug/bundle`` (server.py / router.py) exports them
+    as one artifact — config + metrics + last-N decisions + log tail +
+    Perfetto trace — capturing "the 30 s before the 503 storm".
+  * **Anomaly detection building block** (:class:`EwmaDetector`).  An
+    online EWMA mean/variance z-score detector — the router's
+    per-replica health sentinel (router.py) runs one per latency-class
+    signal; kept here because it is pure host math and unit-testable
+    without HTTP.
 
 Overhead contract: everything here is HOST-side bookkeeping recorded at
 boundaries the serving loop already crosses (admission, the one packed
@@ -83,6 +105,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import math
 import sys
 import threading
 import time
@@ -398,6 +421,19 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "ttft_ms_ewma": _reg(
         "gauge", "EWMA time-to-first-token (ms, alpha 0.2; see the "
                  "ttft_ms histogram for the distribution)"),
+    "itl_ms_ewma": _reg(
+        "gauge", "EWMA inter-token latency (ms, alpha 0.2; the "
+                 "per-replica degradation signal the router's health "
+                 "sentinel z-scores; canary probes excluded)"),
+    "canary_requests_total": _reg(
+        "counter", "Synthetic canary-class probe requests served "
+                   "(reserved class: excluded from SLO attainment, "
+                   "goodput, latency histograms/EWMAs and the "
+                   "brownout ladder's inputs)"),
+    "decision_events_total": _reg(
+        "counter", "Control-plane decisions recorded in the audit log "
+                   "(brownout rung moves, recoveries, quarantines, "
+                   "probes, sheds, drains — GET /debug/decisions)"),
     # -- request outcomes / SLO ---------------------------------------------
     "requests_finished_total": _reg(
         "counter", "Requests that delivered a complete generation"),
@@ -627,6 +663,146 @@ def install_compile_listener() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Decision audit log + anomaly-detection building block
+# ---------------------------------------------------------------------------
+
+class DecisionLog:
+    """Bounded ring of structured control-plane decision events.
+
+    One event per decision the control plane took — route / reroute /
+    handoff (router.py), brownout rung move / recovery / quarantine /
+    probe / shed / drain (server.py), canary result / anomaly /
+    verdict flip (the health sentinel) — each a dict carrying ``seq``
+    (monotonic, survives ring eviction so consumers can detect gaps),
+    ``t_ms`` (relative to the log's epoch), ``unix_s`` (wall clock,
+    for cross-process joins), ``kind``, the external ``request_id``
+    where one exists (the join key back to request timelines), and
+    whatever fields the decision point attached (candidate sets,
+    scores, hit depths, errors).
+
+    Thread-safe under its own leaf lock (registered in
+    analysis/lockcheck.py): decision points record from serving-loop /
+    poller / handler threads while ``/debug/decisions`` snapshots.
+    The lock is never held while calling out."""
+
+    def __init__(self, ring: int = 512, clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._t0 = clock()
+        self._ring: "deque[Dict[str, Any]]" = deque(maxlen=ring)
+        self._seq = 0
+        self.counts: Dict[str, int] = {}
+
+    def record(self, kind: str, request_id: Optional[str] = None,
+               **fields) -> int:
+        """Append one decision event; returns its seq number."""
+        ev: Dict[str, Any] = {
+            "seq": -1,
+            "t_ms": round((self._clock() - self._t0) * 1000.0, 3),
+            "unix_s": round(time.time(), 3),
+            "kind": kind,
+        }
+        if request_id:
+            ev["request_id"] = request_id
+        for k, v in fields.items():
+            if v is not None:
+                ev[k] = v
+        with self._lock:
+            ev["seq"] = self._seq
+            self._seq += 1
+            self._ring.append(ev)
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+            return ev["seq"]
+
+    def total(self) -> int:
+        """Events ever recorded (ring evictions included)."""
+        with self._lock:
+            return self._seq
+
+    def counts_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counts)
+
+    def json(self, n: int = 128, kind: Optional[str] = None,
+             request_id: Optional[str] = None) -> Dict[str, Any]:
+        """The ``GET /debug/decisions[?n=&kind=&request_id=]`` payload:
+        the most recent ``n`` events after filtering (events the ring
+        already evicted are gone — ``events_total`` vs ``len`` tells a
+        consumer how much history survives)."""
+        with self._lock:
+            evs = list(self._ring)
+            total = self._seq
+            counts = dict(self.counts)
+            ring = self._ring.maxlen
+        if kind is not None:
+            evs = [e for e in evs if e["kind"] == kind]
+        if request_id is not None:
+            evs = [e for e in evs if e.get("request_id") == request_id]
+        evs = evs[-n:] if n > 0 else []
+        return {
+            "decisions": [dict(e) for e in evs],
+            "events_total": total,
+            "counts": counts,
+            "ring": ring,
+        }
+
+    def for_request(self, request_id: str,
+                    n: int = 64) -> List[Dict[str, Any]]:
+        """The decision events carrying ``request_id`` — the join the
+        fleet request lookup attaches to a timeline."""
+        return self.json(n=n, request_id=request_id)["decisions"]
+
+
+class EwmaDetector:
+    """Online EWMA mean/variance with z-score anomaly scoring.
+
+    ``update(x)`` returns the z-score of ``x`` against the statistics
+    BEFORE the update (so a spike scores against the healthy baseline,
+    not against itself), or None during warmup (< ``min_samples``
+    observations — no baseline, no verdict).  The variance follows the
+    standard exponentially-weighted recurrence; the divisor is floored
+    (relative to the mean, and absolutely by ``floor``) so a
+    near-constant healthy signal does not turn measurement noise into
+    infinite z.  ``floor`` must be set in the SIGNAL'S OWN UNITS: for
+    millisecond latencies a floor of ~1 ms says "a deviation under a
+    millisecond is never an anomaly, whatever the variance" — without
+    it, a 0.05 ms queue-wait baseline turns one harmless 3 ms blip
+    into z≈500 and a false critical verdict.
+
+    NOT itself synchronized: the health sentinel mutates it under its
+    own lock."""
+
+    def __init__(self, alpha: float = 0.2, min_samples: int = 5,
+                 floor: float = 1e-6):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = float(alpha)
+        self.min_samples = int(min_samples)
+        self.floor = float(floor)
+        self.n = 0
+        self.mean = 0.0
+        self.var = 0.0
+
+    def update(self, x: float) -> Optional[float]:
+        x = float(x)
+        z: Optional[float] = None
+        if self.n >= self.min_samples:
+            sd = math.sqrt(max(self.var, 0.0))
+            z = (x - self.mean) / max(
+                sd, abs(self.mean) * 0.05, self.floor
+            )
+        if self.n == 0:
+            self.mean = x
+        else:
+            a = self.alpha
+            d = x - self.mean
+            self.mean += a * d
+            self.var = (1.0 - a) * (self.var + a * d * d)
+        self.n += 1
+        return z
+
+
+# ---------------------------------------------------------------------------
 # Timeline / dispatch records
 # ---------------------------------------------------------------------------
 
@@ -699,6 +875,8 @@ class Observability:
         peak_flops: float = DEFAULT_PEAK_FLOPS,
         peak_bytes_per_s: float = DEFAULT_PEAK_BYTES_PER_S,
         util_window: int = 64,
+        decision_ring: int = 512,
+        max_snapshots: int = 128,
         clock=time.monotonic,
     ):
         self.slo_ttft_ms = (
@@ -719,6 +897,15 @@ class Observability:
         self._max_timelines = int(max_timelines)
         self._timelines: "OrderedDict[str, _Timeline]" = OrderedDict()
         self._by_rid: Dict[int, _Timeline] = {}
+        # Decision audit log (its own leaf lock — never nested with
+        # self._lock) + the flight recorder's periodic metric-snapshot
+        # ring (server.py feeds it every flight_interval_s; the
+        # /debug/bundle artifact exports it).  Both survive batcher
+        # rebuilds with the rest of this instance.
+        self.decisions = DecisionLog(ring=decision_ring, clock=clock)
+        self.metric_snapshots: "deque[Dict[str, Any]]" = deque(
+            maxlen=max_snapshots
+        )
         # Device-time attribution: hardware peaks (0 disables the
         # corresponding gauge) and a per-kind sliding window of
         # (flops, bytes, wall_ms, device_est_ms) from dispatches that
@@ -1103,6 +1290,32 @@ class Observability:
                 "fields": fields,
             })
 
+    def events_json(self, n: int = 256) -> List[Dict[str, Any]]:
+        """Snapshot of the annotation ring (state transitions, fault
+        injections, kv-tier events) — the flight recorder's
+        state-transition record in ``/debug/bundle``."""
+        with self._lock:
+            items = list(self.events)[-n:] if n > 0 else []
+        return [dict(e) for e in items]
+
+    def record_metrics_snapshot(self, snapshot: Dict[str, Any]) -> None:
+        """Flight recorder: append one periodic metric snapshot (a
+        compact scalar dict the serving loop builds every
+        ``flight_interval_s``) to the bounded ring — pure host
+        bookkeeping, exported by ``/debug/bundle`` so a postmortem can
+        see the trend into the incident, not just the final values."""
+        rec = {
+            "t_ms": round(self._now_ms(), 3),
+            "unix_s": round(time.time(), 3),
+        }
+        rec.update(snapshot)
+        with self._lock:
+            self.metric_snapshots.append(rec)
+
+    def metric_snapshots_json(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(s) for s in self.metric_snapshots]
+
     # -- server-side latency / SLO ------------------------------------------
 
     def observe_ttft(self, ms: float) -> None:
@@ -1148,6 +1361,9 @@ class Observability:
     def metrics(self) -> Dict[str, float]:
         """Scalar gauges/counters for the /metrics exposition (the
         histograms render separately via ``expose_histograms``)."""
+        # Taken BEFORE self._lock: the decision log has its own leaf
+        # lock and the two must never nest.
+        decisions_total = self.decisions.total()
         with self._lock:
             n = len(self._slo_window) or 1
             ttft_ok = sum(1 for a, _, _ in self._slo_window if a)
@@ -1157,6 +1373,7 @@ class Observability:
                 "requests_finished_total": self.requests_finished_total,
                 "requests_failed_total": self.requests_failed_total,
                 "requests_cancelled_total": self.requests_cancelled_total,
+                "decision_events_total": decisions_total,
                 "compiles_total": self.compiles_total,
                 "slo_ttft_ms": self.slo_ttft_ms or 0.0,
                 "slo_itl_ms": self.slo_itl_ms or 0.0,
@@ -1437,11 +1654,22 @@ class StructuredLogger:
     per line with stable ``event`` / ``request_id`` / ``dispatch_seq``
     fields, so a fleet's log pipeline can join server lines to
     ``/debug`` timelines without regexes.  Writes are single ``print``
-    calls (atomic enough under the GIL for line-oriented collectors)."""
+    calls (atomic enough under the GIL for line-oriented collectors).
 
-    def __init__(self, json_mode: bool = False, stream=None):
+    Every formatted line also lands in a bounded in-memory ring — the
+    flight recorder's LOG TAIL, exported by ``/debug/bundle`` so a
+    postmortem artifact carries the last ``ring`` log lines even when
+    nobody captured stdout.  ``quiet=True`` keeps the ring but never
+    prints (the server's default logger when the caller supplied
+    none: the bundle still has a tail, stdout stays silent)."""
+
+    def __init__(self, json_mode: bool = False, stream=None,
+                 ring: int = 256, quiet: bool = False):
         self.json_mode = bool(json_mode)
         self.stream = stream if stream is not None else sys.stdout
+        self.quiet = bool(quiet)
+        self._lock = threading.Lock()
+        self._ring: "deque[str]" = deque(maxlen=ring)
 
     def log(self, event: str, message: str = "", **fields) -> None:
         if self.json_mode:
@@ -1460,4 +1688,13 @@ class StructuredLogger:
                 f"{k}={v}" for k, v in fields.items() if v is not None
             )
             line = " ".join(parts)
-        print(line, file=self.stream, flush=True)
+        with self._lock:
+            self._ring.append(line)
+        if not self.quiet:
+            print(line, file=self.stream, flush=True)
+
+    def tail(self, n: int = 256) -> List[str]:
+        """The most recent formatted log lines (flight-recorder tail)."""
+        with self._lock:
+            out = list(self._ring)
+        return out[-n:] if n > 0 else []

@@ -94,6 +94,15 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 PRIORITIES = ("interactive", "batch")
 
+# Reserved request class for the router's synthetic canary probes
+# (router.py).  NOT a member of PRIORITIES on purpose: canaries ride
+# the interactive queue for ordering (``_priority_of`` maps unknown
+# classes there), but the server excludes the class from SLO
+# attainment, goodput, the latency histograms/EWMAs and the brownout
+# ladder's signal windows — a fleet whose only traffic is its own
+# probes must read healthy and must never brown itself out.
+CANARY = "canary"
+
 # Ladder rungs, mildest first.  RUNG_INDEX is the /metrics gauge value.
 RUNGS = ("normal", "elevated", "brownout-1", "brownout-2", "shed")
 RUNG_INDEX = {name: i for i, name in enumerate(RUNGS)}
@@ -613,6 +622,7 @@ class OverloadController:
         """The /healthz ``overload`` section."""
         now = self._clock()
         with self._lock:
+            _, wait_p90 = self._signals_locked(now)
             return {
                 "enabled": self.enabled,
                 "rung": RUNGS[self._rung],
@@ -633,6 +643,12 @@ class OverloadController:
                 ),
                 "interactive_attainment": round(
                     self._attainment_locked("interactive", now), 4
+                ),
+                # Recent queue-wait p90 (the ladder's second pressure
+                # signal; None with too few recent samples) — the
+                # router's health sentinel reads it off the scrape.
+                "queue_wait_ms_p90": (
+                    round(wait_p90, 3) if wait_p90 is not None else None
                 ),
             }
 

@@ -97,6 +97,52 @@ so clients need no changes):
     GET  /metrics            router gauges + per-replica labeled series
     GET  /debug/kv/fleet     FLEET CACHE VIEW (schema below)
     GET  /debug/trace        FLEET-MERGED Perfetto trace (schema below)
+    GET  /debug/fleet        the health sentinel's fleet view: per-
+                             replica health score in [0,1], verdict
+                             (healthy/suspect/critical), per-signal
+                             subscores + active anomalies, last canary
+                             result, edge-triggered anomaly counters,
+                             and the fleet verdict (worst replica) —
+                             what ROADMAP item 3's autoscaler consults
+                             before killing or draining a replica
+    GET  /debug/decisions    the router's decision audit log
+                             (obs.DecisionLog): route decisions WITH
+                             their candidate sets/scores/hit depths,
+                             reroutes, handoff outcomes, canary
+                             results, anomalies, verdict flips —
+                             ?n=/?kind=/?request_id= filter; the
+                             request_id filter joins a decision trail
+                             to its request timeline
+    GET  /debug/bundle       flight-recorder postmortem artifact:
+                             router config + aggregate health + fleet
+                             sentinel view + last-N decisions + log
+                             tail + fleet-merged trace + (default)
+                             every healthy replica's own bundle
+                             (?replicas=0 / ?trace=0 to slim)
+
+**Synthetic canary probes** (``canary_interval_s > 0``; manual
+``run_canaries_now()`` otherwise): the router periodically POSTs a
+tiny deterministic greedy probe (fixed token prompt/seed, the RESERVED
+``"canary"`` priority class — replicas serve it but exclude it from
+SLO attainment, goodput, latency histograms and the brownout ladder's
+inputs) DIRECTLY to every replica, routable or not.  Each sweep
+probes EVERY replica first and only then judges tokens against the
+fleet ORACLE — the plurality token sequence among the sweep's
+successful probes (same weights + greedy decode ⇒ replica-
+independent, so healthy fleets are unanimous); a pinned oracle
+RE-PINS when a strict majority later agrees on a different sequence
+(counted ``canary_oracle_repins_total`` — a corrupt replica probed
+first, or a legitimate fleet-wide output change), and
+``reset_canary_oracle()`` is the operator hook for planned rollouts.
+A probe disagreeing with the settled oracle is a counted token
+MISMATCH — the wrong-output failure latency metrics cannot see.
+Probe success/latency feeds the per-replica **health sentinel**
+(:class:`HealthSentinel`): EWMA/z-score detectors over canary
+latency, replica ITL EWMA, queue-wait p90, SLO attainment and scrape
+staleness produce a [0,1] health score and a healthy/suspect/critical
+verdict per replica, raising edge-triggered, counted, logged
+``anomaly`` events into the decision log.  The sentinel never acts —
+it is the trustworthy sensor the future autoscaler reads.
     GET  /debug/requests     index aggregated across ALL healthy
                              replicas, each entry tagged ``replica``
     GET  /debug/requests/<id>  resolved through the ROUTING RECORD
@@ -195,10 +241,176 @@ from urllib.parse import parse_qs, unquote, urlsplit
 import numpy as np
 
 from .faults import FaultInjector, InjectedFault
-from .obs import StructuredLogger
+from .obs import DecisionLog, EwmaDetector, StructuredLogger
+from .overload import CANARY
 
 POLICIES = ("least-loaded", "affinity", "cache-aware")
 ROLES = ("prefill", "decode")
+
+# ---------------------------------------------------------------------------
+# Router-side Prometheus registry (analysis/metricscheck.py audits it)
+# ---------------------------------------------------------------------------
+
+# FULL metric name -> (type, help).  The router renders its own text
+# exposition (no obs.METRICS pipeline out here), so this registry is
+# its HELP/TYPE source — and the metrics-registry lint checks it both
+# ways: every registered family must be emitted somewhere in this
+# module, and every emitted ``llm_router_*`` / ``llm_fleet_*`` /
+# ``llm_replica_*`` family must be registered.
+ROUTER_METRICS: Dict[str, Tuple[str, str]] = {
+    "llm_router_replicas": (
+        "gauge", "Replicas behind this router"),
+    "llm_router_replicas_healthy": (
+        "gauge", "Replicas currently routable"),
+    "llm_router_routed_requests_total": (
+        "counter", "Requests routed, by decision policy"),
+    "llm_router_reroutes_total": (
+        "counter", "Requests re-routed off a failed replica"),
+    "llm_router_replica_failures_total": (
+        "counter", "Forward-time replica failures observed"),
+    "llm_router_kv_handoffs_total": (
+        "counter", "Cross-replica prefix-KV handoffs brokered"),
+    "llm_router_affinity_sessions": (
+        "gauge", "Sticky sessions currently pinned"),
+    "llm_router_affinity_stale_routes_total": (
+        "counter",
+        "Affinity routes taken onto a replica whose chain digest "
+        "changed since the session pinned (possible cache miss — "
+        "counted, no longer silent)"),
+    "llm_router_cache_index_nodes": (
+        "gauge", "Chain-prefix keys in the router's global radix "
+                 "index, summed over replicas"),
+    "llm_router_cache_index_replicas_synced": (
+        "gauge", "Replicas whose chain digest has been folded into "
+                 "the global index"),
+    "llm_router_cache_index_syncs_total": (
+        "counter", "Digest syncs applied to the global index "
+                   "(incremental + full)"),
+    "llm_router_cache_index_resyncs_total": (
+        "counter", "Full node-walk resyncs (journal could not prove "
+                   "completeness — rebuilds, or a poller too far "
+                   "behind)"),
+    "llm_router_cache_index_events_applied_total": (
+        "counter", "Journaled digest events applied incrementally"),
+    "llm_router_cache_stale_routes_total": (
+        "counter",
+        "Cache-aware routes taken onto a holder whose live digest "
+        "version moved past the index's synced one (possible cold "
+        "prefill — counted, never wrong tokens)"),
+    "llm_router_cache_hit_depth_blocks_total": (
+        "counter", "Cumulative matched prefix depth (blocks) over "
+                   "cache-aware routed requests"),
+    "llm_router_handoffs_scheduled_total": (
+        "counter", "Chain migrations admitted into the handoff queue"),
+    "llm_router_handoffs_completed_total": (
+        "counter", "Chain migrations that landed blocks on the "
+                   "destination"),
+    "llm_router_handoffs_aborted_total": (
+        "counter", "Chain migrations that failed or timed out "
+                   "(unwound cleanly; chain re-eligible)"),
+    "llm_router_handoffs_skipped_total": (
+        "counter", "Chain migrations refused at admission "
+                   "(bytes-in-flight bound, or an out-of-process "
+                   "replica)"),
+    "llm_router_handoff_bytes_inflight": (
+        "gauge", "Estimated slab bytes currently moving between "
+                 "replicas"),
+    "llm_router_handoff_bytes_total": (
+        "counter", "Slab bytes landed on destinations by completed "
+                   "handoffs"),
+    # -- fleet cache aggregate (last GET /debug/kv/fleet computation) --
+    "llm_fleet_duplicate_kv_blocks": (
+        "gauge", "HBM blocks holding chain prefixes duplicated on "
+                 ">= 2 replicas (copies beyond the first; last "
+                 "fleet-view computation)"),
+    "llm_fleet_duplicate_kv_bytes": (
+        "gauge", "HBM bytes behind the duplicate chain blocks — the "
+                 "disaggregation scheduler's reclaimable redundancy"),
+    "llm_fleet_prefix_hit_ratio": (
+        "gauge", "Fleet-wide fraction of admitted prompt tokens "
+                 "served from cached prefix blocks (last fleet-view "
+                 "computation)"),
+    "llm_fleet_kv_age_s": (
+        "gauge", "Seconds since the fleet cache view was last "
+                 "computed"),
+    # -- control-plane observability (decision log, canaries, sentinel) --
+    "llm_router_decisions_total": (
+        "counter", "Control-plane decisions recorded in the router "
+                   "audit log, by kind (GET /debug/decisions)"),
+    "llm_router_canary_probes_total": (
+        "counter", "Synthetic canary probes sent (reserved canary "
+                   "request class; every replica, routable or not)"),
+    "llm_router_canary_failures_total": (
+        "counter", "Canary probes that failed (connect error, "
+                   "non-200, timeout)"),
+    "llm_router_canary_mismatches_total": (
+        "counter", "Canary probes whose greedy tokens disagreed with "
+                   "the fleet oracle (the wrong-output detector)"),
+    "llm_router_canary_oracle_repins_total": (
+        "counter", "Canary oracle re-pins: a strict majority of a "
+                   "sweep's successful probes agreed on a DIFFERENT "
+                   "token sequence than the pinned oracle (the pin "
+                   "was wrong, or the fleet's output legitimately "
+                   "changed)"),
+    "llm_router_anomalies_total": (
+        "counter", "Health-sentinel anomaly events by signal "
+                   "(edge-triggered: one event per healthy -> "
+                   "anomalous transition per replica)"),
+    "llm_router_fleet_verdict": (
+        "gauge", "Worst replica health verdict (0 healthy / 1 "
+                 "suspect / 2 critical) — the GET /debug/fleet "
+                 "verdict an autoscaler consumes"),
+    # -- per-replica labeled gauges (qualified by health age) ----------
+    "llm_router_replica_healthy": (
+        "gauge", "Replica routable (per replica)"),
+    "llm_router_replica_inflight": (
+        "gauge", "Router-tracked in-flight requests (per replica)"),
+    "llm_router_replica_routed_total": (
+        "counter", "Requests routed to this replica"),
+    "llm_router_replica_active_slots": (
+        "gauge", "Replica batcher slots holding a live request (last "
+                 "health scrape)"),
+    "llm_router_replica_mesh_devices": (
+        "gauge", "Devices in the replica's serving mesh (last health "
+                 "scrape)"),
+    "llm_replica_health_age_s": (
+        "gauge", "Seconds since this replica's labeled gauges were "
+                 "last refreshed from a successful /healthz scrape "
+                 "(-1 = never scraped; stale values persist for "
+                 "unroutable replicas — gate on this)"),
+    "llm_router_replica_kv_nodes": (
+        "gauge", "Chain-digest nodes (keyed blocks) on this replica "
+                 "(last health scrape)"),
+    "llm_router_replica_kv_hbm_blocks": (
+        "gauge", "HBM-resident chain blocks on this replica (last "
+                 "health scrape)"),
+    "llm_router_replica_kv_host_blocks": (
+        "gauge", "Host-tier-resident chain blocks on this replica "
+                 "(last health scrape)"),
+    "llm_router_replica_kv_idle_blocks": (
+        "gauge", "Idle (refcount-0, evictable) chain blocks on this "
+                 "replica (last health scrape)"),
+    "llm_router_replica_kv_digest_version": (
+        "gauge", "Chain-digest content version on this replica (last "
+                 "health scrape)"),
+    "llm_router_replica_kv_hit_ratio": (
+        "gauge", "Replica fraction of admitted prompt tokens served "
+                 "from cached prefix blocks (last health scrape)"),
+    "llm_router_replica_health_score": (
+        "gauge", "Sentinel health score in [0, 1] (per replica: "
+                 "blends canary success, canary-latency / ITL / "
+                 "queue-wait z-scores, SLO attainment, and scrape "
+                 "staleness)"),
+    "llm_router_replica_verdict": (
+        "gauge", "Sentinel verdict per replica (0 healthy / 1 "
+                 "suspect / 2 critical)"),
+    "llm_router_replica_canary_latency_ms": (
+        "gauge", "Last canary probe round-trip latency (per replica; "
+                 "-1 = never probed)"),
+    "llm_router_replica_canary_ok": (
+        "gauge", "Last canary probe outcome (1 ok / 0 failed or "
+                 "mismatched / -1 never probed)"),
+}
 
 
 def chain_keys(tokens: Sequence[int], block_size: int) -> List[bytes]:
@@ -382,6 +594,318 @@ class RouterRadixIndex:
             }
 
 
+# ---------------------------------------------------------------------------
+# Per-replica health score + anomaly sentinel
+# ---------------------------------------------------------------------------
+
+# Sentinel signals, each contributing one [0, 1] subscore per replica:
+#   canary      EWMA of canary probe success; a token MISMATCH pins 0
+#   latency     canary round-trip latency z-score vs its own baseline
+#   itl         replica inter-token-latency EWMA z-score (healthz)
+#   queue_wait  replica queue-wait p90 z-score (healthz overload)
+#   attainment  smoothed interactive SLO attainment (healthz overload)
+#   staleness   age of the last successful health scrape
+SENTINEL_SIGNALS = (
+    "canary", "latency", "itl", "queue_wait", "attainment", "staleness",
+)
+
+VERDICTS = ("healthy", "suspect", "critical")
+VERDICT_INDEX = {v: i for i, v in enumerate(VERDICTS)}
+
+
+class _SentinelState:
+    """One replica's sentinel state (mutated under the sentinel lock)."""
+
+    __slots__ = (
+        "sub", "anomalous", "latency", "itl", "queue_wait",
+        "canary_ok_ewma", "score", "verdict", "last_canary",
+    )
+
+    def __init__(self, alpha: float, min_samples: int,
+                 floor_ms: float):
+        self.sub: Dict[str, float] = {s: 1.0 for s in SENTINEL_SIGNALS}
+        self.anomalous: Dict[str, bool] = {
+            s: False for s in SENTINEL_SIGNALS
+        }
+        # All three z-scored signals are MILLISECOND latencies: the
+        # absolute divisor floor (floor_ms) keeps a sub-ms-baseline
+        # replica's harmless single-digit-ms blip from scoring as a
+        # 500-sigma anomaly.
+        self.latency = EwmaDetector(
+            alpha=alpha, min_samples=min_samples, floor=floor_ms
+        )
+        self.itl = EwmaDetector(
+            alpha=alpha, min_samples=min_samples, floor=floor_ms
+        )
+        self.queue_wait = EwmaDetector(
+            alpha=alpha, min_samples=min_samples, floor=floor_ms
+        )
+        self.canary_ok_ewma = 1.0
+        self.score = 1.0
+        self.verdict = "healthy"
+        self.last_canary: Optional[Dict[str, Any]] = None
+
+
+class HealthSentinel:
+    """Per-replica health score + anomaly detector (module docstring).
+
+    Pure host bookkeeping over the signals the router already has —
+    canary probe results (success, token match, latency) and /healthz
+    scrape values (ITL EWMA, queue-wait p90, interactive attainment,
+    scrape age).  Each signal keeps a [0, 1] subscore (z-scored
+    signals via :class:`~jax_llama_tpu.obs.EwmaDetector` against the
+    replica's OWN baseline, so a uniformly slow fleet is not five
+    anomalies); the replica's health score blends them MIN-biased
+    (``0.5 * min + 0.5 * mean`` — one collapsed signal must drag the
+    score even while five others read 1.0) and maps to a verdict:
+    ``healthy`` / ``suspect`` / ``critical``.
+
+    Anomaly events are EDGE-triggered per (replica, signal): one
+    counted event on the healthy -> anomalous transition (plus a
+    cleared event on recovery), never one per poll — the counters
+    count incidents, not samples.  The sentinel never ACTS: it is the
+    trustworthy sensor layer the future autoscaler (ROADMAP item 3)
+    reads via ``GET /debug/fleet`` before it is allowed to kill or
+    drain a replica; routing keeps its own health/quarantine rules.
+
+    Thread discipline: observe_* are called by the canary prober and
+    the health poller while handler threads read fleet_json — every
+    access goes under the sentinel's own leaf lock (registered in
+    analysis/lockcheck.py; never held while calling out)."""
+
+    def __init__(
+        self,
+        z_threshold: float = 3.0,
+        alpha: float = 0.2,
+        min_samples: int = 5,
+        suspect_below: float = 0.8,
+        critical_below: float = 0.5,
+        attainment_floor: float = 0.75,
+        staleness_allowance_s: float = 10.0,
+        z_floor_ms: float = 5.0,
+    ):
+        self.z_threshold = float(z_threshold)
+        self.alpha = float(alpha)
+        self.min_samples = int(min_samples)
+        # Absolute z-divisor floor for the ms-scale signals (canary
+        # latency / ITL / queue wait): deviations under ~z_threshold x
+        # this never flag, however tight the healthy baseline was.
+        self.z_floor_ms = float(z_floor_ms)
+        self.suspect_below = float(suspect_below)
+        self.critical_below = float(critical_below)
+        self.staleness_allowance_s = float(staleness_allowance_s)
+        # Per-signal anomaly bars on the subscore: canary needs a few
+        # consecutive successes to clear (EWMA alpha 0.5 -> ~3 probes
+        # back above 0.9); attainment uses the SLO-ish floor.
+        self._bars: Dict[str, float] = {
+            "canary": 0.9, "latency": 0.5, "itl": 0.5,
+            "queue_wait": 0.5, "attainment": float(attainment_floor),
+            "staleness": 0.5,
+        }
+        self._lock = threading.Lock()
+        self._states: Dict[int, _SentinelState] = {}
+        self.anomalies_total: Dict[str, int] = {
+            s: 0 for s in SENTINEL_SIGNALS
+        }
+
+    # audit: locked(every caller holds self._lock)
+    def _state_locked(self, replica: int) -> _SentinelState:
+        st = self._states.get(replica)
+        if st is None:
+            st = self._states[replica] = _SentinelState(
+                self.alpha, self.min_samples, self.z_floor_ms
+            )
+        return st
+
+    def _z_subscore(self, z: Optional[float]) -> float:
+        """[0, 1] subscore from a one-sided z-score: 1.0 inside the
+        threshold (or during warmup — no baseline, no verdict),
+        decaying linearly to 0 at twice the threshold.  Only HIGH
+        values are anomalous for every z-scored signal here (latency /
+        ITL / queue wait dropping is good news)."""
+        if z is None or z <= self.z_threshold:
+            return 1.0
+        return max(
+            0.0, 1.0 - (z - self.z_threshold) / self.z_threshold
+        )
+
+    # audit: locked(every caller holds self._lock)
+    def _signal_locked(
+        self, st: _SentinelState, signal: str, sub: float,
+        events: List[Dict[str, Any]], **fields,
+    ) -> None:
+        st.sub[signal] = round(max(0.0, min(1.0, float(sub))), 4)
+        bad = st.sub[signal] < self._bars[signal]
+        if bad and not st.anomalous[signal]:
+            st.anomalous[signal] = True
+            self.anomalies_total[signal] += 1
+            events.append(dict(
+                {"kind": "anomaly", "signal": signal,
+                 "subscore": st.sub[signal]},
+                **{k: v for k, v in fields.items() if v is not None},
+            ))
+        elif st.anomalous[signal] and not bad:
+            st.anomalous[signal] = False
+            events.append({
+                "kind": "anomaly_cleared", "signal": signal,
+                "subscore": st.sub[signal],
+            })
+
+    # audit: locked(every caller holds self._lock)
+    def _rescore_locked(
+        self, st: _SentinelState,
+    ) -> List[Dict[str, Any]]:
+        vals = list(st.sub.values())
+        st.score = round(
+            0.5 * min(vals) + 0.5 * (sum(vals) / len(vals)), 4
+        )
+        v = (
+            "critical" if st.score < self.critical_below
+            else "suspect" if st.score < self.suspect_below
+            else "healthy"
+        )
+        if v == st.verdict:
+            return []
+        prev, st.verdict = st.verdict, v
+        return [{
+            "kind": "verdict", "verdict": v, "previous": prev,
+            "score": st.score,
+        }]
+
+    def observe_canary(
+        self, replica: int, ok: bool,
+        latency_ms: Optional[float] = None, mismatch: bool = False,
+        error: Optional[str] = None,
+    ) -> List[Dict[str, Any]]:
+        """Feed one canary probe result; returns the emitted events
+        (anomaly / anomaly_cleared / verdict) for the caller to log,
+        count and record into its decision log."""
+        events: List[Dict[str, Any]] = []
+        with self._lock:
+            st = self._state_locked(replica)
+            st.last_canary = {
+                "ok": bool(ok), "mismatch": bool(mismatch),
+                "latency_ms": (
+                    round(float(latency_ms), 3)
+                    if latency_ms is not None else None
+                ),
+                "error": error,
+                "unix_s": round(time.time(), 3),
+            }
+            st.canary_ok_ewma = (
+                0.5 * st.canary_ok_ewma + 0.5 * (1.0 if ok else 0.0)
+            )
+            sub = 0.0 if mismatch else st.canary_ok_ewma
+            self._signal_locked(
+                st, "canary", sub, events,
+                mismatch=mismatch or None, error=error,
+            )
+            if ok and latency_ms is not None:
+                z = st.latency.update(float(latency_ms))
+                self._signal_locked(
+                    st, "latency", self._z_subscore(z), events,
+                    z=round(z, 3) if z is not None else None,
+                    latency_ms=round(float(latency_ms), 3),
+                )
+            events.extend(self._rescore_locked(st))
+        return events
+
+    def observe_health(
+        self, replica: int, reachable: bool,
+        attainment: Optional[float] = None,
+        queue_wait_ms: Optional[float] = None,
+        itl_ms: Optional[float] = None,
+        age_s: Optional[float] = None,
+    ) -> List[Dict[str, Any]]:
+        """Feed one /healthz scrape (or scrape failure): attainment /
+        queue-wait p90 / ITL EWMA from the payload, ``age_s`` = time
+        since the last SUCCESSFUL scrape (0 on success; grows while a
+        replica stays unreachable — the digest/telemetry staleness
+        signal)."""
+        events: List[Dict[str, Any]] = []
+        with self._lock:
+            st = self._state_locked(replica)
+            if age_s is not None:
+                allow = self.staleness_allowance_s
+                sub = (
+                    1.0 if age_s <= allow
+                    else max(0.0, 1.0 - (age_s - allow) / (3.0 * allow))
+                )
+                self._signal_locked(
+                    st, "staleness", sub, events,
+                    age_s=round(age_s, 3), reachable=reachable,
+                )
+            if reachable:
+                if attainment is not None:
+                    a = self.alpha
+                    sub = (
+                        (1.0 - a) * st.sub["attainment"]
+                        + a * float(attainment)
+                    )
+                    self._signal_locked(
+                        st, "attainment", sub, events,
+                        attainment=round(float(attainment), 4),
+                    )
+                if queue_wait_ms is not None:
+                    z = st.queue_wait.update(float(queue_wait_ms))
+                    self._signal_locked(
+                        st, "queue_wait", self._z_subscore(z), events,
+                        z=round(z, 3) if z is not None else None,
+                        queue_wait_ms=round(float(queue_wait_ms), 3),
+                    )
+                if itl_ms is not None:
+                    z = st.itl.update(float(itl_ms))
+                    self._signal_locked(
+                        st, "itl", self._z_subscore(z), events,
+                        z=round(z, 3) if z is not None else None,
+                        itl_ms=round(float(itl_ms), 3),
+                    )
+            events.extend(self._rescore_locked(st))
+        return events
+
+    def score(self, replica: int) -> float:
+        with self._lock:
+            st = self._states.get(replica)
+            return st.score if st is not None else 1.0
+
+    def verdict(self, replica: int) -> str:
+        with self._lock:
+            st = self._states.get(replica)
+            return st.verdict if st is not None else "healthy"
+
+    def fleet_json(self) -> Dict[str, Any]:
+        """Per-replica scores/verdicts/signals + the fleet verdict
+        (worst replica) — the core of ``GET /debug/fleet``."""
+        with self._lock:
+            replicas = {
+                i: {
+                    "score": st.score,
+                    "verdict": st.verdict,
+                    "signals": dict(st.sub),
+                    "anomalous": sorted(
+                        s for s, bad in st.anomalous.items() if bad
+                    ),
+                    "last_canary": (
+                        dict(st.last_canary)
+                        if st.last_canary is not None else None
+                    ),
+                }
+                for i, st in self._states.items()
+            }
+            worst = max(
+                (VERDICT_INDEX[st.verdict]
+                 for st in self._states.values()),
+                default=0,
+            )
+            anomalies = dict(self.anomalies_total)
+        return {
+            "verdict": VERDICTS[worst],
+            "verdict_index": worst,
+            "replicas": replicas,
+            "anomalies_total": anomalies,
+        }
+
+
 class _ClientDisconnect(Exception):
     """The CLIENT's socket died while relaying — the replica is fine.
     Distinct from replica-side OSErrors so a disconnecting client never
@@ -497,6 +1021,14 @@ class ReplicaRouter:
         handoff_max_bytes_inflight: int = 64 << 20,
         handoff_timeout_s: float = 30.0,
         demote_after_export: bool = True,
+        # -- control-plane observability --------------------------------
+        canary_interval_s: float = 0.0,  # <= 0: manual (tests) —
+        #                                  run_canaries_now() only
+        canary_prompt: Optional[Sequence[int]] = None,
+        canary_max_new: int = 4,
+        canary_timeout_s: float = 10.0,
+        sentinel: Optional[HealthSentinel] = None,
+        decision_ring: int = 1024,
     ):
         if policy not in POLICIES:
             raise ValueError(
@@ -534,7 +1066,13 @@ class ReplicaRouter:
             )
         self.policy = policy
         self.fault_injector = fault_injector
-        self.logger = logger
+        # No logger supplied -> a QUIET one (ring only): stdout stays
+        # silent but the /debug/bundle flight-recorder log tail still
+        # records every router lifecycle line.
+        self.logger = (
+            logger if logger is not None
+            else StructuredLogger(quiet=True)
+        )
         self.health_interval_s = float(health_interval_s)
         self.proxy_timeout_s = float(proxy_timeout_s)
         self.affinity_max_sessions = int(affinity_max_sessions)
@@ -551,6 +1089,21 @@ class ReplicaRouter:
         self.handoff_timeout_s = float(handoff_timeout_s)
         self.demote_after_export = bool(demote_after_export)
         self.index = RouterRadixIndex()
+        # Control-plane observability: the decision audit log (own
+        # leaf lock; GET /debug/decisions), the per-replica health
+        # sentinel (own leaf lock; GET /debug/fleet), and the
+        # synthetic canary prober's knobs/counters (counters under
+        # self._lock below).
+        self.decisions = DecisionLog(ring=decision_ring)
+        self.sentinel = (
+            sentinel if sentinel is not None else HealthSentinel()
+        )
+        self.canary_interval_s = float(canary_interval_s)
+        self.canary_prompt = [
+            int(t) for t in (canary_prompt or (1, 2, 3))
+        ]
+        self.canary_max_new = int(canary_max_new)
+        self.canary_timeout_s = float(canary_timeout_s)
         self._lock = threading.Lock()
         self._replicas: List[_Replica] = []
         for i, rep in enumerate(replicas):
@@ -583,6 +1136,18 @@ class ReplicaRouter:
         self.replica_failures_total = 0
         self.kv_handoffs_total = 0
         self.affinity_stale_routes_total = 0
+        # Canary prober state: the oracle is the FIRST successful
+        # probe's greedy tokens — every replica serves the same
+        # weights, and greedy decode is replica-independent (mesh
+        # parity pins tokens exact), so later disagreement means a
+        # replica is producing WRONG OUTPUT, the failure no latency
+        # metric can see.
+        self.canary_probes_total = 0
+        self.canary_failures_total = 0
+        self.canary_mismatches_total = 0
+        self.canary_oracle_repins_total = 0
+        self._canary_oracle: Optional[List[int]] = None
+        self._canary_seq = 0
         # Cache-aware routing counters: stale = the index said HIT but
         # the holder's live digest version moved past the synced one
         # (eviction / rebuild mid-flight) — routed anyway, counted,
@@ -651,6 +1216,10 @@ class ReplicaRouter:
             target=self._handoff_loop, daemon=True,
             name="router-handoff",
         )
+        self._canary_thread = threading.Thread(
+            target=self._canary_loop, daemon=True,
+            name="router-canary",
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -663,6 +1232,8 @@ class ReplicaRouter:
         self._http_thread.start()
         self._health_thread.start()
         self._handoff_thread.start()
+        if self.canary_interval_s > 0:
+            self._canary_thread.start()
         return self
 
     def stop(self) -> None:
@@ -673,6 +1244,8 @@ class ReplicaRouter:
         self._health_thread.join(timeout=5)
         if self._handoff_thread.is_alive():
             self._handoff_thread.join(timeout=5)
+        if self._canary_thread.is_alive():
+            self._canary_thread.join(timeout=5)
 
     def __enter__(self) -> "ReplicaRouter":
         return self.start()
@@ -681,8 +1254,9 @@ class ReplicaRouter:
         self.stop()
 
     def _log(self, event: str, message: str = "", **fields) -> None:
-        if self.logger is not None:
-            self.logger.log(event, message, **fields)
+        # self.logger is never None (the ctor substitutes a quiet
+        # ring-only logger), so every event reaches the bundle tail.
+        self.logger.log(event, message, **fields)
 
     # -- router-local tracing / routing record -------------------------------
 
@@ -738,44 +1312,88 @@ class ReplicaRouter:
             with self._lock:
                 reps = list(self._replicas)
             for rep in reps:
-                try:
-                    ok, payload = self._probe(rep)
-                except (OSError, ValueError, http.client.HTTPException):
-                    ok, payload = False, {}
-                with self._lock:
-                    was = rep.healthy
-                    rep.healthy = ok
-                    if payload:
-                        rep.last_health = payload
-                        rep.last_health_t = time.monotonic()
-                if payload:
-                    # Global radix index sync rides the poll for free:
-                    # only a digest-version DELTA triggers the (mostly
-                    # incremental) /debug/kv fetch.
-                    self._sync_index(rep, payload)
-                if was != ok:
-                    self._log(
-                        "router_replica_health",
-                        replica=rep.index, healthy=ok,
-                    )
+                self._scrape_replica(rep)
             self._closed.wait(self.health_interval_s)
 
     def check_health_now(self) -> None:
-        """Synchronous health sweep (tests / deterministic drills)."""
+        """Synchronous health sweep (tests / deterministic drills) —
+        the SAME per-replica step as the poller, so manual-mode drills
+        and production produce identical audit trails."""
         with self._lock:
             reps = list(self._replicas)
         for rep in reps:
-            try:
-                ok, payload = self._probe(rep)
-            except (OSError, ValueError, http.client.HTTPException):
-                ok, payload = False, {}
-            with self._lock:
-                rep.healthy = ok
-                if payload:
-                    rep.last_health = payload
-                    rep.last_health_t = time.monotonic()
+            self._scrape_replica(rep)
+
+    def _scrape_replica(self, rep: _Replica) -> None:
+        """One replica's health step, shared by the poller thread and
+        ``check_health_now``: /healthz probe, lock-held health flip,
+        global radix index sync (digest-version delta only), sentinel
+        feed, and — on a flip — the health log line + the
+        ``replica_health`` decision record."""
+        try:
+            ok, payload = self._probe(rep)
+        except (OSError, ValueError, http.client.HTTPException):
+            ok, payload = False, {}
+        with self._lock:
+            was = rep.healthy
+            rep.healthy = ok
             if payload:
-                self._sync_index(rep, payload)
+                rep.last_health = payload
+                rep.last_health_t = time.monotonic()
+        if payload:
+            # Global radix index sync rides the poll for free: only a
+            # digest-version DELTA triggers the (mostly incremental)
+            # /debug/kv fetch.
+            self._sync_index(rep, payload)
+        self._sentinel_scrape(rep, payload)
+        if was != ok:
+            self._log(
+                "router_replica_health",
+                replica=rep.index, healthy=ok,
+            )
+            self.decisions.record(
+                "replica_health", replica=rep.index, healthy=ok,
+            )
+
+    def _sentinel_scrape(self, rep: _Replica,
+                         payload: Dict[str, Any]) -> None:
+        """Feed one /healthz scrape outcome into the health sentinel
+        (runs on the poller thread / check_health_now's caller, never
+        under the router lock): attainment + queue-wait p90 from the
+        overload section, the ITL EWMA from the replica section, and
+        the scrape age as the staleness signal (0 on success, growing
+        while the replica stays unreachable)."""
+        if payload:
+            age: Optional[float] = 0.0
+        else:
+            with self._lock:
+                lt = rep.last_health_t
+            # Never-scraped replicas have no baseline to be stale
+            # against — the canary (connect failure) covers them.
+            age = (time.monotonic() - lt) if lt > 0 else None
+        ov = (payload.get("overload") or {}) if payload else {}
+        repl = (payload.get("replica") or {}) if payload else {}
+        events = self.sentinel.observe_health(
+            rep.index, reachable=bool(payload),
+            attainment=ov.get("interactive_attainment"),
+            queue_wait_ms=ov.get("queue_wait_ms_p90"),
+            itl_ms=repl.get("itl_ms_ewma"),
+            age_s=age,
+        )
+        self._ingest_sentinel_events(rep.index, events)
+
+    def _ingest_sentinel_events(
+        self, replica: int, events: Sequence[Dict[str, Any]],
+    ) -> None:
+        """Record sentinel-emitted events (anomaly / anomaly_cleared /
+        verdict) into the decision audit log + structured log — the
+        counted, logged ``anomaly_*`` trail the acceptance drill
+        asserts."""
+        for ev in events:
+            kind = ev.get("kind", "anomaly")
+            fields = {k: v for k, v in ev.items() if k != "kind"}
+            self.decisions.record(kind, replica=replica, **fields)
+            self._log(f"router_{kind}", replica=replica, **fields)
 
     def _sync_index(self, rep: _Replica,
                     payload: Dict[str, Any]) -> None:
@@ -919,9 +1537,27 @@ class ReplicaRouter:
             return float(rep.inflight)
         return rep.inflight / slots
 
+    def _candidates_info_locked(
+        self, candidates: List[_Replica],
+    ) -> List[Dict[str, Any]]:
+        """The decision-audit view of the candidate set (caller holds
+        ``_lock``): per candidate, the load facts the pick minimizes
+        over — what lets ``/debug/decisions`` answer "why replica Y"
+        with the alternatives it beat."""
+        return [
+            {
+                "replica": r.index,
+                "inflight": r.inflight,
+                "occupancy": round(self._occupancy_locked(r), 4),
+                "routed_total": r.routed_total,
+            }
+            for r in candidates
+        ]
+
     def _cache_pick_locked(
         self, chain: Optional[List[str]],
         candidates: List[_Replica],
+        decision: Dict[str, Any],
     ) -> Tuple[_Replica, str, bool, Optional[Dict[str, Any]]]:
         """The cache-aware decision (caller holds ``_lock``): route to
         the replica holding the DEEPEST matching prefix fleet-wide,
@@ -931,7 +1567,9 @@ class ReplicaRouter:
         where the request landed (depth x load disagreement past the
         configured threshold).  Cold prompts route least-loaded — or
         to the least-loaded PREFILL replica under role
-        disaggregation."""
+        disaggregation.  ``decision`` (the audit-log record under
+        construction) gains the hit depth, holder set, staleness and
+        spill facts the choice was made from."""
         least = min(
             candidates, key=lambda r: (r.inflight, r.routed_total)
         )
@@ -941,6 +1579,7 @@ class ReplicaRouter:
             ) if chain else None
         )
         if hit is None:
+            decision["hit_depth"] = 0
             if self.roles is not None:
                 pre = [
                     r for r in candidates
@@ -954,6 +1593,10 @@ class ReplicaRouter:
                     return chosen, "prefill-role", False, None
             return least, "least-loaded", False, None
         depth, holders = hit
+        decision["hit_depth"] = depth
+        decision["holders"] = [
+            {"replica": h[0], "tier": h[1]} for h in holders
+        ]
         by_idx = {r.index: r for r in candidates}
         best_idx, _tier = min(
             holders,
@@ -969,10 +1612,11 @@ class ReplicaRouter:
         # means the chain may have moved/evicted since — routed
         # anyway (locality hint), counted, degrades to a cold
         # prefill, never to wrong tokens.
-        stale = (
-            self.index.synced_version(rep.index)
-            != rep.kv_digest().get("version")
-        )
+        synced = self.index.synced_version(rep.index)
+        live = rep.kv_digest().get("version")
+        stale = synced != live
+        decision["synced_version"] = synced
+        decision["live_version"] = live
         occ = self._occupancy_locked(rep)
         if rep is least or occ < self.spill_occupancy:
             self.cache_hit_depth_blocks_total += depth
@@ -989,6 +1633,9 @@ class ReplicaRouter:
         score = depth * max(
             0.0, occ - self._occupancy_locked(least)
         )
+        decision["spill_from"] = rep.index
+        decision["spill_occupancy"] = round(occ, 4)
+        decision["handoff_score"] = round(score, 4)
         if (
             depth >= self.handoff_min_depth
             and score >= self.handoff_threshold
@@ -1003,30 +1650,39 @@ class ReplicaRouter:
         self, key: Optional[bytes], exclude: frozenset,
         chain: Optional[List[str]] = None,
     ) -> Tuple[Optional[_Replica], str, bool,
-               Optional[Dict[str, Any]]]:
+               Optional[Dict[str, Any]], Dict[str, Any]]:
         """Choose a replica (caller holds ``_lock``): the global-
         radix-index decision under the cache-aware policy, sticky key
         first under affinity, else least-loaded among healthy
         replicas not in ``exclude`` (prior failed attempts for this
         request).
 
-        Returns ``(replica, how, stale, handoff_plan)``.  ``stale`` is
-        True for an affinity/cache hit whose replica's chain digest
-        has changed since the decision's information was current — the
-        chain may have been evicted or demoted, so the route is a
-        CACHE GAMBLE rather than a known hit.  Compared with ``!=``
-        (not ``>``): a crash-recovery rebuild resets the digest and
-        empties the cache — exactly a staleness event.
+        Returns ``(replica, how, stale, handoff_plan, decision)``.
+        ``stale`` is True for an affinity/cache hit whose replica's
+        chain digest has changed since the decision's information was
+        current — the chain may have been evicted or demoted, so the
+        route is a CACHE GAMBLE rather than a known hit.  Compared
+        with ``!=`` (not ``>``): a crash-recovery rebuild resets the
+        digest and empties the cache — exactly a staleness event.
         ``handoff_plan`` (cache-aware spill only) asks the scheduler
-        to migrate the chain to the routed replica."""
+        to migrate the chain to the routed replica.  ``decision`` is
+        the audit-log record of the choice — the candidate set with
+        its load facts plus whatever hit/staleness/spill inputs the
+        policy consulted (recorded by the caller OUTSIDE the lock)."""
         candidates = [
             r for r in self._replicas
             if r.healthy and r.index not in exclude
         ]
+        decision: Dict[str, Any] = {
+            "candidates": self._candidates_info_locked(candidates),
+        }
         if not candidates:
-            return None, "none", False, None
+            return None, "none", False, None, decision
         if self.policy == "cache-aware":
-            return self._cache_pick_locked(chain, candidates)
+            rep, how, stale, plan = self._cache_pick_locked(
+                chain, candidates, decision
+            )
+            return rep, how, stale, plan, decision
         if self.policy == "affinity" and key is not None:
             ent = self._affinity.get(key)
             if ent is not None:
@@ -1050,7 +1706,8 @@ class ReplicaRouter:
                             # or the None would disable staleness
                             # detection for the session's whole life.
                             ent[1] = cur
-                        return r, "affinity", stale, None
+                        decision["affinity_hit"] = True
+                        return r, "affinity", stale, None, decision
         chosen = min(
             candidates, key=lambda r: (r.inflight, r.routed_total)
         )
@@ -1060,7 +1717,7 @@ class ReplicaRouter:
             self._affinity[key] = [
                 chosen.index, chosen.kv_digest().get("loss_version"),
             ]
-        return chosen, "least-loaded", False, None
+        return chosen, "least-loaded", False, None, decision
 
     # -- proxying ------------------------------------------------------------
 
@@ -1098,7 +1755,7 @@ class ReplicaRouter:
             t_pick = self._now_ms()
             role_pending = False
             with self._lock:
-                rep, how, stale, plan = self._pick_locked(
+                rep, how, stale, plan, decision = self._pick_locked(
                     key, frozenset(tried), chain
                 )
                 if rep is not None:
@@ -1123,6 +1780,10 @@ class ReplicaRouter:
                     if role_pending:
                         self._role_handoffs_pending += 1
             if rep is None:
+                self.decisions.record(
+                    "no_healthy_replica", request_id=client_rid,
+                    path=handler.path, tried=sorted(tried),
+                )
                 self._reply_json(
                     handler, 503,
                     {"error": "no healthy replica"},
@@ -1130,6 +1791,18 @@ class ReplicaRouter:
                 )
                 return
             tried.add(rep.index)
+            # Decision log: the route WITH the candidate set and the
+            # policy inputs it was chosen from — recorded outside the
+            # routing lock; joinable to the request timeline when the
+            # client supplied an X-Request-Id (replica-minted ids
+            # resolve through the routing record instead).
+            self.decisions.record(
+                "route", request_id=client_rid, replica=rep.index,
+                policy=how, path=handler.path,
+                stale_chain=stale or None,
+                handoff_planned=(plan is not None) or None,
+                **decision,
+            )
             if stale:
                 # Digest freshness said the pinned chain may be gone:
                 # route anyway (locality hint, not a contract), but as
@@ -1230,6 +1903,11 @@ class ReplicaRouter:
                     "reroute", t_fwd, replica=rep.index,
                     path=handler.path, request_id=client_rid,
                     error=str(e), relayed=relayed,
+                )
+                self.decisions.record(
+                    "reroute", request_id=client_rid,
+                    failed_replica=rep.index, error=str(e),
+                    relayed=relayed or None, path=handler.path,
                 )
                 if relayed:
                     # Bytes already reached the client: the router
@@ -1371,34 +2049,51 @@ class ReplicaRouter:
         # key would admit one job per depth and burn the source's
         # loop on empty re-exports after the first demote.
         head = plan["keys_hex"][0]
+        skip_reason = None
         with self._lock:
             src = self._replicas[plan["src"]]
             dst = self._replicas[plan["dst"]]
             if src.server is None or dst.server is None:
                 self.handoffs_skipped_total += 1
-                return
-            if head in self._handoff_chains:
+                skip_reason = "replica-not-in-process"
+            elif head in self._handoff_chains:
                 # One in-flight handoff per chain: the duplicate is
                 # refused, and counted — a silently vanishing
                 # migrate_chain() would read as accepted.
                 self.handoffs_skipped_total += 1
-                return
-            est = plan["depth"] * self.index.block_bytes(plan["src"])
-            if (
-                self._handoff_bytes_inflight > 0
-                and self._handoff_bytes_inflight + est
-                > self.handoff_max_bytes_inflight
-            ):
-                self.handoffs_skipped_total += 1
-                return
-            self._handoff_chains.add(head)
-            self._handoff_bytes_inflight += est
-            self.handoffs_scheduled_total += 1
+                skip_reason = "chain-handoff-inflight"
+            else:
+                est = plan["depth"] * self.index.block_bytes(
+                    plan["src"]
+                )
+                if (
+                    self._handoff_bytes_inflight > 0
+                    and self._handoff_bytes_inflight + est
+                    > self.handoff_max_bytes_inflight
+                ):
+                    self.handoffs_skipped_total += 1
+                    skip_reason = "bytes-inflight-cap"
+                else:
+                    self._handoff_chains.add(head)
+                    self._handoff_bytes_inflight += est
+                    self.handoffs_scheduled_total += 1
+        if skip_reason is not None:
+            self.decisions.record(
+                "handoff_skipped", request_id=request_id,
+                src=plan["src"], dst=plan["dst"],
+                depth=plan["depth"], reason=skip_reason,
+            )
+            return
         job = dict(plan, head=head, est=est, request_id=request_id)
         self._log(
             "router_handoff_scheduled", src=plan["src"],
             dst=plan["dst"], depth=plan["depth"],
             request_id=request_id,
+        )
+        self.decisions.record(
+            "handoff_scheduled", request_id=request_id,
+            src=plan["src"], dst=plan["dst"], depth=plan["depth"],
+            est_bytes=est,
         )
         self._handoff_q.put(job)
 
@@ -1422,6 +2117,11 @@ class ReplicaRouter:
                     "router_handoff_failed", str(e),
                     src=job["src"], dst=job["dst"],
                     request_id=job.get("request_id"),
+                )
+                self.decisions.record(
+                    "handoff_aborted",
+                    request_id=job.get("request_id"),
+                    src=job["src"], dst=job["dst"], error=str(e),
                 )
             finally:
                 with self._lock:
@@ -1459,6 +2159,10 @@ class ReplicaRouter:
         if not slabs:
             with self._lock:
                 self.handoffs_empty_total += 1
+            self.decisions.record(
+                "handoff_empty", request_id=rid, src=job["src"],
+                dst=job["dst"], reason="nothing-resident",
+            )
             return  # nothing resident anymore: nothing to move
         remaining = max(0.1, deadline - time.monotonic())
         n = dst.server.call_on_loop(
@@ -1506,12 +2210,20 @@ class ReplicaRouter:
             # raises instead (counted aborted by the worker).
             with self._lock:
                 self.handoffs_empty_total += 1
+            self.decisions.record(
+                "handoff_empty", request_id=rid, src=job["src"],
+                dst=job["dst"], reason="already-resident-or-no-capacity",
+            )
             return
         bb = self.index.block_bytes(job["src"])
         with self._lock:
             self.handoffs_completed_total += 1
             self.handoff_blocks_total += n
             self.handoff_bytes_total += n * bb
+        self.decisions.record(
+            "handoff_completed", request_id=rid, src=job["src"],
+            dst=job["dst"], blocks=n, bytes=n * bb,
+        )
         # note_handoff counts kv_handoffs_total, drops the linked
         # handoff span, and re-pins the routing record at dst.
         self.note_handoff(
@@ -1554,6 +2266,263 @@ class ReplicaRouter:
             time.sleep(0.01)
         return False
 
+    # -- synthetic canary probes ---------------------------------------------
+
+    def _canary_loop(self) -> None:
+        """The canary prober thread (started when
+        ``canary_interval_s > 0``): one probe per replica per
+        interval.  ``<= 0`` is manual mode — deterministic
+        drills/tests drive :meth:`run_canaries_now`."""
+        while not self._closed.is_set():
+            self.run_canaries_now()
+            self._closed.wait(self.canary_interval_s)
+
+    def run_canaries_now(self) -> None:
+        """One synchronous canary sweep over EVERY replica — routable
+        or not: an unhealthy replica's canary is exactly how its
+        recovery (or continued sickness) is confirmed without risking
+        real traffic.  Two phases: probe everyone FIRST, then resolve
+        the token oracle against the whole sweep (majority rule — see
+        ``_resolve_canary_oracle``) before any mismatch is judged, so
+        a wrong-output replica that happens to be probed first cannot
+        invert the fleet verdict.  Probes run CONCURRENTLY (one short
+        thread per replica): a single hung replica costs its own
+        probe timeout, never the whole fleet's sweep — otherwise one
+        accept-but-never-answer replica would double every healthy
+        replica's effective probe period."""
+        with self._lock:
+            reps = list(self._replicas)
+            if self._closed.is_set():
+                return
+            seq0 = self._canary_seq
+            self._canary_seq += len(reps)
+        slots: List[Optional[Dict[str, Any]]] = [None] * len(reps)
+
+        def probe(i: int, rep: _Replica) -> None:
+            slots[i] = self._canary_probe(rep, seq0 + 1 + i)
+
+        threads = [
+            threading.Thread(
+                target=probe, args=(i, rep), daemon=True,
+                name=f"router-canary-probe-{rep.index}",
+            )
+            for i, rep in enumerate(reps)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.canary_timeout_s + 5.0)
+        results: List[Tuple[_Replica, Dict[str, Any]]] = []
+        for i, (rep, res) in enumerate(zip(reps, slots)):
+            if res is None:
+                # The probe thread outlived even the HTTP timeout (a
+                # wedged resolver/socket): that IS a failed probe —
+                # dropping it would hide exactly the hung replica.
+                res = {
+                    "ok": False,
+                    "error": "canary probe did not complete "
+                             "(probe thread hung past its timeout)",
+                    "latency_ms": self.canary_timeout_s * 1000.0,
+                    "request_id": f"canary-{rep.index}-{seq0 + 1 + i}",
+                }
+            results.append((rep, res))
+        self._resolve_canary_oracle(results)
+        for rep, res in results:
+            self._ingest_canary(rep, res)
+
+    def reset_canary_oracle(self) -> None:
+        """Operator hook: forget the pinned oracle (the next sweep's
+        majority re-establishes it).  Call on a KNOWN fleet-wide
+        output change — a weight rollout, a tokenizer swap — so every
+        replica does not read as mismatched against the old fleet's
+        tokens."""
+        with self._lock:
+            self._canary_oracle = None
+        self.decisions.record("canary_oracle_reset")
+        self._log("router_canary_oracle_reset")
+
+    def _resolve_canary_oracle(
+        self, results: List[Tuple[_Replica, Dict[str, Any]]],
+    ) -> None:
+        """Resolve this sweep's token oracle and mark mismatches.
+
+        The oracle is the plurality token sequence among the sweep's
+        transport-successful probes (same weights + greedy decode ⇒
+        replica-independent, so healthy fleets are unanimous).  An
+        already-pinned oracle is RE-PINNED when a STRICT MAJORITY of
+        this sweep's successful probes agree on a different sequence
+        (counted ``canary_oracle_repins_total``): the pin was wrong —
+        a corrupt replica happened to be probed first, or the whole
+        fleet legitimately changed output (rollout) — and without the
+        re-pin every HEALTHY replica would read as mismatched forever.
+        A split with no majority (1-vs-1 on a 2-replica fleet) can
+        never PIN or RE-PIN: with no pin yet the oracle stays unset
+        (probe order must not crown a corrupt replica — the
+        disagreement is recorded instead), and with a pin it is kept.
+        Only after the oracle settles are individual probes marked
+        ``mismatch`` (ok flips False); with no settled oracle nobody
+        is mismatched — the sentinel cannot tell who is wrong, only
+        that they disagree."""
+        votes = [
+            (rep.index, tuple(res.get("tokens") or ()))
+            for rep, res in results
+            if res.get("ok") and res.get("tokens")
+        ]
+        counts: Dict[Tuple[int, ...], int] = {}
+        for _, t in votes:
+            counts[t] = counts.get(t, 0) + 1
+        repinned = None
+        disagreement = False
+        with self._lock:
+            pinned = (
+                tuple(self._canary_oracle)
+                if self._canary_oracle is not None else None
+            )
+            if counts:
+                best = max(counts, key=lambda t: counts[t])
+                unanimous_or_majority = (
+                    len(counts) == 1 or counts[best] > len(votes) / 2
+                )
+                if pinned is None:
+                    if unanimous_or_majority:
+                        self._canary_oracle = list(best)
+                    else:
+                        disagreement = True
+                elif (
+                    best != pinned
+                    and counts[best] > len(votes) / 2
+                    and counts.get(pinned, 0) < counts[best]
+                ):
+                    self._canary_oracle = list(best)
+                    self.canary_oracle_repins_total += 1
+                    repinned = list(best)
+            oracle = (
+                tuple(self._canary_oracle)
+                if self._canary_oracle is not None else None
+            )
+        if repinned is not None:
+            self.decisions.record(
+                "canary_oracle_repin", oracle_tokens=repinned,
+                votes=len(votes),
+            )
+            self._log(
+                "router_canary_oracle_repin", votes=len(votes),
+            )
+        if disagreement:
+            # No pin and no majority: crowning either side by probe
+            # order would let a corrupt replica permanently invert
+            # the verdict.  Record the split; the next sweep with a
+            # majority (or an operator's eyes on this event) settles.
+            self.decisions.record(
+                "canary_oracle_disagreement", votes=len(votes),
+                sequences=len(counts),
+            )
+            self._log(
+                "router_canary_oracle_disagreement",
+                votes=len(votes), sequences=len(counts),
+            )
+        if oracle is None:
+            return
+        for _, res in results:
+            if res.get("ok") and tuple(res.get("tokens") or ()) != oracle:
+                res["ok"] = False
+                res["mismatch"] = True
+
+    def _canary_payload(self) -> Dict[str, Any]:
+        """The deterministic probe request: a tiny fixed token prompt,
+        greedy (temperature 0), fixed seed, and the RESERVED canary
+        priority class — the replica serves it normally but excludes
+        it from SLO attainment, goodput, latency histograms and the
+        brownout ladder's inputs (no self-triggered brownouts)."""
+        return {
+            "prompt": list(self.canary_prompt),
+            "max_new_tokens": self.canary_max_new,
+            "temperature": 0.0,
+            "seed": 0,
+            "priority": CANARY,
+        }
+
+    def _canary_probe(self, rep: _Replica, seq: int) -> Dict[str, Any]:
+        """One TRANSPORT-level probe against one replica (direct POST
+        — never through the routing path, so a probe can reach a
+        replica the router has stopped routing to).  Returns the raw
+        result (ok = HTTP 200 with a body; tokens; latency); token
+        correctness is judged afterwards against the whole sweep by
+        ``_resolve_canary_oracle``."""
+        rid = f"canary-{rep.index}-{seq}"
+        body = json.dumps(self._canary_payload()).encode()
+        t0 = time.monotonic()
+        try:
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.canary_timeout_s
+            )
+            try:
+                conn.request(
+                    "POST", "/generate", body=body,
+                    headers={
+                        "Content-Type": "application/json",
+                        "X-Request-Id": rid,
+                    },
+                )
+                resp = conn.getresponse()
+                data = json.loads(resp.read() or b"{}")
+            finally:
+                conn.close()
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            return {
+                "ok": False, "error": repr(e),
+                "latency_ms": (time.monotonic() - t0) * 1000.0,
+                "request_id": rid,
+            }
+        lat = (time.monotonic() - t0) * 1000.0
+        if resp.status != 200 or not isinstance(data, dict):
+            return {
+                "ok": False,
+                "error": f"HTTP {resp.status}: "
+                         f"{(data or {}).get('error')}",
+                "latency_ms": lat, "request_id": rid,
+                "status": resp.status,
+            }
+        tokens = [int(t) for t in (data.get("tokens") or [])]
+        return {
+            "ok": True, "latency_ms": lat, "tokens": tokens,
+            "request_id": rid,
+        }
+
+    def _ingest_canary(self, rep: _Replica,
+                       res: Dict[str, Any]) -> None:
+        """Feed one oracle-resolved probe result everywhere it goes:
+        the probe counters, the decision audit log, the structured log
+        (failures only — a healthy fleet's probes are not log
+        traffic), and the health sentinel (whose anomaly/verdict
+        events land in the decision log via the ingest path)."""
+        with self._lock:
+            self.canary_probes_total += 1
+            if res.get("mismatch"):
+                self.canary_mismatches_total += 1
+            elif not res["ok"]:
+                self.canary_failures_total += 1
+        self.decisions.record(
+            "canary", request_id=res.get("request_id"),
+            replica=rep.index, ok=res["ok"],
+            latency_ms=round(res["latency_ms"], 3),
+            mismatch=res.get("mismatch") or None,
+            error=res.get("error"),
+        )
+        if not res["ok"]:
+            self._log(
+                "router_canary_failed", replica=rep.index,
+                error=res.get("error"),
+                mismatch=res.get("mismatch"),
+            )
+        events = self.sentinel.observe_canary(
+            rep.index, ok=res["ok"],
+            latency_ms=res.get("latency_ms"),
+            mismatch=bool(res.get("mismatch")),
+            error=res.get("error"),
+        )
+        self._ingest_sentinel_events(rep.index, events)
+
     # -- GET surface ---------------------------------------------------------
 
     def _handle_get(self, handler: BaseHTTPRequestHandler) -> None:
@@ -1595,6 +2564,36 @@ class ReplicaRouter:
                     )
                     return
             self._reply_json(handler, 200, self.fleet_kv_json(depth))
+        elif route == "/debug/fleet":
+            # The health-score/anomaly sentinel's fleet view — the
+            # verdict surface the future autoscaler consumes.
+            self._reply_json(handler, 200, self.fleet_health_json())
+        elif route == "/debug/decisions":
+            kind = (query.get("kind") or [None])[0]
+            request_id = (query.get("request_id") or [None])[0]
+            try:
+                n = int((query.get("n") or [128])[0])
+            except ValueError:
+                n = 128
+            self._reply_json(
+                handler, 200,
+                self.decisions.json(
+                    n=n, kind=kind, request_id=request_id
+                ),
+            )
+        elif route == "/debug/bundle":
+            def qflag(name: str) -> bool:
+                try:
+                    return int((query.get(name) or [1])[0]) > 0
+                except ValueError:
+                    return True
+            self._reply_json(
+                handler, 200,
+                self.bundle_json(
+                    include_replicas=qflag("replicas"),
+                    trace=qflag("trace"),
+                ),
+            )
         elif route == "/debug/requests":
             self._reply_json(
                 handler, *self._fleet_requests_index(handler.path)
@@ -1695,10 +2694,18 @@ class ReplicaRouter:
             if status != 404:
                 data["replica"] = rep.index
                 data["routed_replica"] = routed
+                # The decision join: every router decision carrying
+                # this external id (route, reroute, handoff, canary)
+                # rides the timeline reply, so "why did this request
+                # land here" reads in one fetch.
+                data["router_decisions"] = self.decisions.for_request(
+                    request_id
+                )
                 return status, data
         return 404, {
             "error": f"request id {request_id!r} unknown fleet-wide",
             "routed_replica": routed,
+            "router_decisions": self.decisions.for_request(request_id),
         }
 
     @staticmethod
@@ -1886,6 +2893,125 @@ class ReplicaRouter:
             self._fleet_kv = dict(fleet, computed_unix_s=time.time())
         return {"fleet": fleet, "replicas": per}
 
+    def fleet_health_json(self) -> Dict[str, Any]:
+        """``GET /debug/fleet`` — the per-replica health-score /
+        verdict view: the sentinel's scores, subscores, active
+        anomalies and last canary result merged with the router's own
+        routing facts (routable, inflight, scrape age), plus the
+        fleet verdict (worst replica) and the edge-triggered anomaly
+        counters.  THE surface ROADMAP item 3's autoscaler consults
+        before it is allowed to kill or drain a replica."""
+        now = time.monotonic()
+        with self._lock:
+            snaps = {
+                r.index: {
+                    "replica": r.index,
+                    "healthy": r.healthy,
+                    "inflight": r.inflight,
+                    "routed_total": r.routed_total,
+                    "failures_total": r.failures_total,
+                    "health_age_s": (
+                        round(now - r.last_health_t, 3)
+                        if r.last_health_t > 0 else None
+                    ),
+                }
+                for r in self._replicas
+            }
+            canary = {
+                "probes_total": self.canary_probes_total,
+                "failures_total": self.canary_failures_total,
+                "mismatches_total": self.canary_mismatches_total,
+                "oracle_repins_total": self.canary_oracle_repins_total,
+                "interval_s": self.canary_interval_s,
+                "prompt": list(self.canary_prompt),
+                "max_new": self.canary_max_new,
+                "oracle_tokens": (
+                    list(self._canary_oracle)
+                    if self._canary_oracle is not None else None
+                ),
+            }
+        fleet = self.sentinel.fleet_json()
+        replicas: List[Dict[str, Any]] = []
+        for idx in sorted(snaps):
+            ent = dict(snaps[idx])
+            sent = fleet["replicas"].get(idx)
+            if sent is None:
+                sent = {
+                    "score": 1.0, "verdict": "healthy",
+                    "signals": {}, "anomalous": [],
+                    "last_canary": None,
+                }
+            ent.update(sent)
+            replicas.append(ent)
+        return {
+            "verdict": fleet["verdict"],
+            "verdict_index": fleet["verdict_index"],
+            "replicas": replicas,
+            "anomalies_total": fleet["anomalies_total"],
+            "canary": canary,
+        }
+
+    def bundle_json(self, include_replicas: bool = True,
+                    trace: bool = True) -> Dict[str, Any]:
+        """``GET /debug/bundle[?replicas=0&trace=0]`` — the router's
+        black-box flight-recorder artifact: config + aggregate health
+        + the fleet health-score view + the last-N control-plane
+        decisions + the structured-log tail + the fleet-merged
+        Perfetto trace, and (by default) every healthy replica's own
+        ``/debug/bundle`` inline — ONE pull for the whole incident.
+        Replica fetches use bounded timeouts so a hung replica costs
+        seconds, not the artifact.  Replica bundles are fetched with
+        ``?trace=0`` ALWAYS: the fleet-merged trace above already
+        carries every replica's tracks (re-tagged, clock-shifted), so
+        shipping each replica's own trace again would double the
+        heaviest section — and with ``trace=0`` the slimming would
+        otherwise not slim the dominant payload at all."""
+        out: Dict[str, Any] = {
+            "kind": "router_bundle",
+            "generated_unix_s": round(time.time(), 3),
+            "config": {
+                "policy": self.policy,
+                "roles": list(self.roles) if self.roles else None,
+                "health_interval_s": self.health_interval_s,
+                "proxy_timeout_s": self.proxy_timeout_s,
+                "spill_occupancy": self.spill_occupancy,
+                "handoff_threshold": self.handoff_threshold,
+                "handoff_min_depth": self.handoff_min_depth,
+                "handoff_max_bytes": self.handoff_max_bytes,
+                "handoff_max_bytes_inflight": (
+                    self.handoff_max_bytes_inflight
+                ),
+                "handoff_timeout_s": self.handoff_timeout_s,
+                "canary_interval_s": self.canary_interval_s,
+                "canary_max_new": self.canary_max_new,
+                "canary_timeout_s": self.canary_timeout_s,
+            },
+            "health": self.health(),
+            "fleet": self.fleet_health_json(),
+            "decisions": self.decisions.json(n=256),
+            "log_tail": self.logger.tail(),
+        }
+        if trace:
+            out["trace"] = self.fleet_trace_json()
+        if include_replicas:
+            with self._lock:
+                reps = [
+                    (r.index, r.host, r.port)
+                    for r in self._replicas if r.healthy
+                ]
+            bundles: List[Dict[str, Any]] = []
+            for index, host, port in reps:
+                got = self._get_replica_json(
+                    _Replica(index=index, host=host, port=port),
+                    "/debug/bundle?trace=0", timeout=5.0,
+                )
+                if got is not None and got[0] == 200:
+                    doc = got[1]
+                    doc["replica"] = index
+                    bundles.append(doc)
+            out["replicas"] = bundles
+        return out
+
     def health(self) -> Dict[str, Any]:
         """Aggregate /healthz: ok while ANY replica is routable, with
         the per-replica snapshots under ``replicas``."""
@@ -1916,7 +3042,15 @@ class ReplicaRouter:
                     self.cache_hit_depth_blocks_total
                 ),
             }
+            canary = {
+                "probes_total": self.canary_probes_total,
+                "failures_total": self.canary_failures_total,
+                "mismatches_total": self.canary_mismatches_total,
+                "oracle_repins_total": self.canary_oracle_repins_total,
+                "interval_s": self.canary_interval_s,
+            }
         cache.update(self.index.stats())
+        sent = self.sentinel.fleet_json()
         return {
             "ok": any(s["healthy"] for s in snaps),
             "policy": self.policy,
@@ -1933,12 +3067,26 @@ class ReplicaRouter:
             # Last computed fleet cache aggregate (None until the
             # first GET /debug/kv/fleet).
             "fleet_kv": fleet_kv,
+            # Control-plane observability: canary prober counters, the
+            # sentinel's fleet verdict + per-signal anomaly counters,
+            # and the decision audit log's size (GET /debug/decisions
+            # for the events, GET /debug/fleet for the full view).
+            "canary": canary,
+            "fleet_health": {
+                "verdict": sent["verdict"],
+                "verdict_index": sent["verdict_index"],
+                "anomalies_total": sent["anomalies_total"],
+            },
+            "decisions_total": self.decisions.total(),
         }
 
     def metrics_text(self) -> str:
         """Router Prometheus exposition: aggregate counters plus
         per-replica labeled gauges (occupancy / inflight / routed /
-        health / mesh shape)."""
+        health / mesh shape / sentinel score).  Every family's
+        HELP/TYPE comes from the :data:`ROUTER_METRICS` registry
+        (``fam``); the metrics-registry lint audits the two against
+        each other both ways."""
         with self._lock:
             snaps = [r.snapshot() for r in self._replicas]
             by_policy = dict(self.routed_by_policy)
@@ -1961,150 +3109,144 @@ class ReplicaRouter:
             }
             cache_stale = self.cache_stale_routes_total
             cache_depth = self.cache_hit_depth_blocks_total
+            canary = {
+                "probes": self.canary_probes_total,
+                "failures": self.canary_failures_total,
+                "mismatches": self.canary_mismatches_total,
+                "repins": self.canary_oracle_repins_total,
+            }
         idx = self.index.stats()
+        decision_counts = self.decisions.counts_snapshot()
+        sent = self.sentinel.fleet_json()
         lines: List[str] = []
 
-        def fam(name: str, kind: str, help_text: str) -> None:
-            lines.append(f"# HELP llm_router_{name} {help_text}")
-            lines.append(f"# TYPE llm_router_{name} {kind}")
+        def fam(name: str) -> None:
+            kind, help_text = ROUTER_METRICS[name]
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
 
-        fam("replicas", "gauge", "Replicas behind this router")
+        fam("llm_router_replicas")
         lines.append(f"llm_router_replicas {len(snaps)}")
-        fam("replicas_healthy", "gauge", "Replicas currently routable")
+        fam("llm_router_replicas_healthy")
         lines.append(
             "llm_router_replicas_healthy "
             f"{sum(s['healthy'] for s in snaps)}"
         )
-        fam("routed_requests_total", "counter",
-            "Requests routed, by decision policy")
+        fam("llm_router_routed_requests_total")
         for pol, n in sorted(by_policy.items()):
             lines.append(
                 f'llm_router_routed_requests_total{{policy="{pol}"}} {n}'
             )
-        fam("reroutes_total", "counter",
-            "Requests re-routed off a failed replica")
+        fam("llm_router_reroutes_total")
         lines.append(f"llm_router_reroutes_total {reroutes}")
-        fam("replica_failures_total", "counter",
-            "Forward-time replica failures observed")
+        fam("llm_router_replica_failures_total")
         lines.append(f"llm_router_replica_failures_total {failures}")
-        fam("kv_handoffs_total", "counter",
-            "Cross-replica prefix-KV handoffs brokered")
+        fam("llm_router_kv_handoffs_total")
         lines.append(f"llm_router_kv_handoffs_total {handoffs}")
-        fam("affinity_sessions", "gauge",
-            "Sticky sessions currently pinned")
+        fam("llm_router_affinity_sessions")
         lines.append(f"llm_router_affinity_sessions {affinity_sessions}")
-        fam("affinity_stale_routes_total", "counter",
-            "Affinity routes taken onto a replica whose chain digest "
-            "changed since the session pinned (possible cache miss — "
-            "counted, no longer silent)")
+        fam("llm_router_affinity_stale_routes_total")
         lines.append(
             f"llm_router_affinity_stale_routes_total {stale_routes}"
         )
         # Cache-aware routing: the global radix index + decision
         # outcome counters (policy="cache-aware" only; families are
         # always exposed for dashboard discovery).
-        fam("cache_index_nodes", "gauge",
-            "Chain-prefix keys in the router's global radix index, "
-            "summed over replicas")
+        fam("llm_router_cache_index_nodes")
         lines.append(f"llm_router_cache_index_nodes {idx['nodes']}")
-        fam("cache_index_replicas_synced", "gauge",
-            "Replicas whose chain digest has been folded into the "
-            "global index")
+        fam("llm_router_cache_index_replicas_synced")
         lines.append(
             "llm_router_cache_index_replicas_synced "
             f"{idx['replicas_synced']}"
         )
-        fam("cache_index_syncs_total", "counter",
-            "Digest syncs applied to the global index (incremental + "
-            "full)")
+        fam("llm_router_cache_index_syncs_total")
         lines.append(
             f"llm_router_cache_index_syncs_total {idx['syncs_total']}"
         )
-        fam("cache_index_resyncs_total", "counter",
-            "Full node-walk resyncs (journal could not prove "
-            "completeness — rebuilds, or a poller too far behind)")
+        fam("llm_router_cache_index_resyncs_total")
         lines.append(
             "llm_router_cache_index_resyncs_total "
             f"{idx['resyncs_total']}"
         )
-        fam("cache_index_events_applied_total", "counter",
-            "Journaled digest events applied incrementally")
+        fam("llm_router_cache_index_events_applied_total")
         lines.append(
             "llm_router_cache_index_events_applied_total "
             f"{idx['events_applied_total']}"
         )
-        fam("cache_stale_routes_total", "counter",
-            "Cache-aware routes taken onto a holder whose live digest "
-            "version moved past the index's synced one (possible "
-            "cold prefill — counted, never wrong tokens)")
+        fam("llm_router_cache_stale_routes_total")
         lines.append(
             f"llm_router_cache_stale_routes_total {cache_stale}"
         )
-        fam("cache_hit_depth_blocks_total", "counter",
-            "Cumulative matched prefix depth (blocks) over cache-"
-            "aware routed requests")
+        fam("llm_router_cache_hit_depth_blocks_total")
         lines.append(
             f"llm_router_cache_hit_depth_blocks_total {cache_depth}"
         )
         # Handoff scheduler ledger.
-        fam("handoffs_scheduled_total", "counter",
-            "Chain migrations admitted into the handoff queue")
+        fam("llm_router_handoffs_scheduled_total")
         lines.append(
             f"llm_router_handoffs_scheduled_total {ho['scheduled']}"
         )
-        fam("handoffs_completed_total", "counter",
-            "Chain migrations that landed blocks on the destination")
+        fam("llm_router_handoffs_completed_total")
         lines.append(
             f"llm_router_handoffs_completed_total {ho['completed']}"
         )
-        fam("handoffs_aborted_total", "counter",
-            "Chain migrations that failed or timed out (unwound "
-            "cleanly; chain re-eligible)")
+        fam("llm_router_handoffs_aborted_total")
         lines.append(
             f"llm_router_handoffs_aborted_total {ho['aborted']}"
         )
-        fam("handoffs_skipped_total", "counter",
-            "Chain migrations refused at admission (bytes-in-flight "
-            "bound, or an out-of-process replica)")
+        fam("llm_router_handoffs_skipped_total")
         lines.append(
             f"llm_router_handoffs_skipped_total {ho['skipped']}"
         )
-        fam("handoff_bytes_inflight", "gauge",
-            "Estimated slab bytes currently moving between replicas")
+        fam("llm_router_handoff_bytes_inflight")
         lines.append(
             f"llm_router_handoff_bytes_inflight {ho['bytes_inflight']}"
         )
-        fam("handoff_bytes_total", "counter",
-            "Slab bytes landed on destinations by completed handoffs")
+        fam("llm_router_handoff_bytes_total")
         lines.append(
             f"llm_router_handoff_bytes_total {ho['bytes_total']}"
+        )
+        # Control-plane observability: the decision audit log (one
+        # labeled series per decision kind), the canary prober's
+        # counters, the sentinel's edge-triggered anomaly counters
+        # (one labeled series per signal) and the fleet verdict.
+        fam("llm_router_decisions_total")
+        for kname, n in sorted(decision_counts.items()):
+            lines.append(
+                f'llm_router_decisions_total{{kind="{kname}"}} {n}'
+            )
+        fam("llm_router_canary_probes_total")
+        lines.append(
+            f"llm_router_canary_probes_total {canary['probes']}"
+        )
+        fam("llm_router_canary_failures_total")
+        lines.append(
+            f"llm_router_canary_failures_total {canary['failures']}"
+        )
+        fam("llm_router_canary_mismatches_total")
+        lines.append(
+            f"llm_router_canary_mismatches_total {canary['mismatches']}"
+        )
+        fam("llm_router_canary_oracle_repins_total")
+        lines.append(
+            f"llm_router_canary_oracle_repins_total {canary['repins']}"
+        )
+        fam("llm_router_anomalies_total")
+        for sig, n in sorted(sent["anomalies_total"].items()):
+            lines.append(
+                f'llm_router_anomalies_total{{signal="{sig}"}} {n}'
+            )
+        fam("llm_router_fleet_verdict")
+        lines.append(
+            f"llm_router_fleet_verdict {sent['verdict_index']}"
         )
         # Fleet cache aggregate (last GET /debug/kv/fleet computation;
         # headers always present for dashboard discovery, samples only
         # once a fleet view has been computed).
-        lines.append(
-            "# HELP llm_fleet_duplicate_kv_blocks HBM blocks holding "
-            "chain prefixes duplicated on >= 2 replicas (copies beyond "
-            "the first; last fleet-view computation)"
-        )
-        lines.append("# TYPE llm_fleet_duplicate_kv_blocks gauge")
-        lines.append(
-            "# HELP llm_fleet_duplicate_kv_bytes HBM bytes behind the "
-            "duplicate chain blocks — the disaggregation scheduler's "
-            "reclaimable redundancy"
-        )
-        lines.append("# TYPE llm_fleet_duplicate_kv_bytes gauge")
-        lines.append(
-            "# HELP llm_fleet_prefix_hit_ratio Fleet-wide fraction of "
-            "admitted prompt tokens served from cached prefix blocks "
-            "(last fleet-view computation)"
-        )
-        lines.append("# TYPE llm_fleet_prefix_hit_ratio gauge")
-        lines.append(
-            "# HELP llm_fleet_kv_age_s Seconds since the fleet cache "
-            "view was last computed"
-        )
-        lines.append("# TYPE llm_fleet_kv_age_s gauge")
+        fam("llm_fleet_duplicate_kv_blocks")
+        fam("llm_fleet_duplicate_kv_bytes")
+        fam("llm_fleet_prefix_hit_ratio")
+        fam("llm_fleet_kv_age_s")
         if fleet_kv is not None:
             lines.append(
                 "llm_fleet_duplicate_kv_blocks "
@@ -2122,47 +3264,29 @@ class ReplicaRouter:
                 "llm_fleet_kv_age_s "
                 f"{round(time.time() - fleet_kv['computed_unix_s'], 3)}"
             )
-        fam("replica_healthy", "gauge", "Replica routable (per replica)")
-        fam("replica_inflight", "gauge",
-            "Router-tracked in-flight requests (per replica)")
-        fam("replica_routed_total", "counter",
-            "Requests routed to this replica")
-        fam("replica_active_slots", "gauge",
-            "Replica batcher slots holding a live request (last "
-            "health scrape)")
-        fam("replica_mesh_devices", "gauge",
-            "Devices in the replica's serving mesh (last health "
-            "scrape)")
+        fam("llm_router_replica_healthy")
+        fam("llm_router_replica_inflight")
+        fam("llm_router_replica_routed_total")
+        fam("llm_router_replica_active_slots")
+        fam("llm_router_replica_mesh_devices")
         # Per-replica cache gauges (from the /healthz kv.digest
         # summary the poller already scrapes) + the staleness gauge
         # that qualifies EVERY per-replica labeled value here: a
         # replica that went unroutable keeps its last-scraped numbers,
         # so dashboards gate on the age instead of trusting them.
-        lines.append(
-            "# HELP llm_replica_health_age_s Seconds since this "
-            "replica's labeled gauges were last refreshed from a "
-            "successful /healthz scrape (-1 = never scraped; stale "
-            "values persist for unroutable replicas — gate on this)"
-        )
-        lines.append("# TYPE llm_replica_health_age_s gauge")
-        fam("replica_kv_nodes", "gauge",
-            "Chain-digest nodes (keyed blocks) on this replica (last "
-            "health scrape)")
-        fam("replica_kv_hbm_blocks", "gauge",
-            "HBM-resident chain blocks on this replica (last health "
-            "scrape)")
-        fam("replica_kv_host_blocks", "gauge",
-            "Host-tier-resident chain blocks on this replica (last "
-            "health scrape)")
-        fam("replica_kv_idle_blocks", "gauge",
-            "Idle (refcount-0, evictable) chain blocks on this "
-            "replica (last health scrape)")
-        fam("replica_kv_digest_version", "gauge",
-            "Chain-digest content version on this replica (last "
-            "health scrape)")
-        fam("replica_kv_hit_ratio", "gauge",
-            "Replica fraction of admitted prompt tokens served from "
-            "cached prefix blocks (last health scrape)")
+        fam("llm_replica_health_age_s")
+        fam("llm_router_replica_kv_nodes")
+        fam("llm_router_replica_kv_hbm_blocks")
+        fam("llm_router_replica_kv_host_blocks")
+        fam("llm_router_replica_kv_idle_blocks")
+        fam("llm_router_replica_kv_digest_version")
+        fam("llm_router_replica_kv_hit_ratio")
+        # Per-replica sentinel gauges (health score / verdict / last
+        # canary) — the labeled twins of the GET /debug/fleet view.
+        fam("llm_router_replica_health_score")
+        fam("llm_router_replica_verdict")
+        fam("llm_router_replica_canary_latency_ms")
+        fam("llm_router_replica_canary_ok")
         for s in snaps:
             lab = f'replica="{s["index"]}"'
             lines.append(
@@ -2218,6 +3342,26 @@ class ReplicaRouter:
             lines.append(
                 f"llm_router_replica_kv_hit_ratio{{{lab}}} "
                 f"{round(hit / max(1, prompt), 6)}"
+            )
+            st = sent["replicas"].get(s["index"]) or {}
+            lines.append(
+                f"llm_router_replica_health_score{{{lab}}} "
+                f"{st.get('score', 1.0)}"
+            )
+            lines.append(
+                f"llm_router_replica_verdict{{{lab}}} "
+                f"{VERDICT_INDEX[st.get('verdict', 'healthy')]}"
+            )
+            lc = st.get("last_canary") or {}
+            lat = lc.get("latency_ms")
+            lines.append(
+                f"llm_router_replica_canary_latency_ms{{{lab}}} "
+                f"{lat if lat is not None else -1}"
+            )
+            ok = lc.get("ok") if lc else None
+            lines.append(
+                f"llm_router_replica_canary_ok{{{lab}}} "
+                f"{int(ok) if ok is not None else -1}"
             )
         return "\n".join(lines) + "\n"
 

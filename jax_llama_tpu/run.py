@@ -90,6 +90,17 @@ def main() -> None:
                          "occupancy watermark and migrating chains "
                          "to where traffic lands via the handoff "
                          "scheduler)")
+    ap.add_argument("--canary-interval-s", type=float, default=10.0,
+                    help="router synthetic-canary period for "
+                         "--replicas N: every interval the router "
+                         "POSTs a tiny deterministic greedy probe "
+                         "(reserved 'canary' priority class — "
+                         "excluded from SLO/goodput/brownout inputs) "
+                         "directly to every replica, token-checks it "
+                         "against the fleet oracle, and feeds "
+                         "latency/correctness into the per-replica "
+                         "health sentinel (GET /debug/fleet).  "
+                         "<= 0 disables the prober")
     ap.add_argument("--replica-roles", default=None, metavar="R,R,...",
                     help="prefill/decode disaggregation for "
                          "--replicas N: a comma list of one role per "
@@ -873,6 +884,7 @@ def _serve_router(params, config, tokenizer, mesh, args,
             block_size=servers[0].batcher.block_size,
             chat_format=_chat_format_for(tokenizer),
             roles=getattr(args, "replica_roles", None),
+            canary_interval_s=getattr(args, "canary_interval_s", 10.0),
         ).start()
         try:
             logger.log(

@@ -363,9 +363,33 @@ Endpoints:
   GET  /debug/dispatches        recent dispatch-span ring.
   GET  /debug/kv                chain-digest tree walk (schema above).
   GET  /debug/trace             Chrome/Perfetto trace_event JSON.
+  GET  /debug/decisions         control-plane decision audit log
+                                (obs.DecisionLog: brownout rung moves,
+                                recoveries, quarantines, probes,
+                                sheds, drains; ?n= / ?kind= /
+                                ?request_id= filter — the request_id
+                                filter joins decisions to the
+                                /debug/requests/<id> timeline).
+  GET  /debug/bundle            flight-recorder postmortem artifact:
+                                config + health + metrics + the
+                                periodic metric-snapshot ring
+                                (flight_interval_s) + last-N decisions
+                                + annotation ring + structured-log
+                                tail + request index + Perfetto trace
+                                (?trace=0 omits the trace).
   POST /debug/profiler          jax.profiler session start/stop.
   GET  /debug/profile/summary   per-program xplane attribution
                                 (schema above).
+
+Control-plane observability (ISSUE 15): the router's synthetic canary
+probes arrive as the RESERVED ``"priority": "canary"`` class — served
+normally (interactive ordering) but excluded from SLO attainment,
+goodput, the ttft/itl histograms + EWMAs, and the brownout ladder's
+attainment/queue-wait windows (a fleet must never brown itself out on
+its own probes); ``llm_canary_requests_total`` counts them.
+``llm_itl_ms_ewma`` exposes the inter-token-latency EWMA the router's
+health sentinel z-scores, and ``llm_decision_events_total`` counts
+audit-log entries.
 """
 
 from __future__ import annotations
@@ -386,7 +410,7 @@ from urllib.parse import parse_qs, unquote, urlsplit
 
 from .degrade import DegradeManager
 from .obs import Observability, StructuredLogger, metric_meta
-from .overload import PRIORITIES, RUNG_INDEX, OverloadController
+from .overload import CANARY, PRIORITIES, RUNG_INDEX, OverloadController
 from .parallel import serve_mesh as smesh
 from . import serving as serving_mod
 from .serving import ContinuousBatcher, _round_up
@@ -559,6 +583,7 @@ class LLMServer:
         brownout_batch_max_new: int = 64,
         brownout_demote_blocks: int = 32,
         replica_id: Optional[int] = None,
+        flight_interval_s: float = 5.0,
     ):
         self.batcher = batcher
         # Replica index behind a ReplicaRouter (router.py); None when
@@ -569,9 +594,14 @@ class LLMServer:
         # Structured logging (obs.StructuredLogger; run.py --log-json):
         # lifecycle events — recoveries, quarantines, per-request
         # failures — go through one formatter carrying request_id /
-        # feature fields.  None (the default) stays silent, matching
-        # the old print-free server.
-        self.logger = logger
+        # feature fields.  With no logger supplied a QUIET one is
+        # created: stdout stays as silent as the old print-free
+        # server, but the flight recorder's /debug/bundle log tail
+        # still records every lifecycle line.
+        self.logger = (
+            logger if logger is not None
+            else StructuredLogger(quiet=True)
+        )
         self.tokenizer = tokenizer
         self.chat_format = chat_format
         self.max_queue = max_queue
@@ -651,6 +681,23 @@ class LLMServer:
         # (serving.py, run.py --prefill-budget) exists to bound; None
         # until the first request delivers.
         self.ttft_ms_ewma: Optional[float] = None
+        # Inter-token-latency EWMA (ms, alpha 0.2) — the per-replica
+        # degradation signal the router's health sentinel z-scores off
+        # the /healthz scrape.  Canary probes are excluded (a tiny
+        # probe's gaps would drag the signal the probe exists to
+        # watch).
+        self.itl_ms_ewma: Optional[float] = None
+        # Synthetic canary probes served (the reserved "canary"
+        # request class — router.py sends them; excluded from SLO /
+        # goodput / ladder inputs, counted here so a replica can
+        # prove its probes are arriving).
+        self.canary_requests_total = 0
+        # Flight recorder: the serving loop appends a compact metric
+        # snapshot to obs.metric_snapshots every flight_interval_s
+        # (<= 0 disables), so /debug/bundle carries the trend into an
+        # incident, not just the final values.
+        self.flight_interval_s = float(flight_interval_s)
+        self._last_flight_t = 0.0
         # Features whose LAST completed step's success is still
         # unconfirmed by a host sync (see the probe-success note in
         # _loop); cleared on every rebuild.
@@ -747,6 +794,26 @@ class LLMServer:
                 elif route == "/debug/dispatches":
                     self._reply_json(
                         200, server.obs.dispatches_json(qint("n", 128))
+                    )
+                elif route == "/debug/decisions":
+                    # Decision audit log: ?kind= filters one decision
+                    # class, ?request_id= joins to a request timeline.
+                    self._reply_json(
+                        200,
+                        server.obs.decisions.json(
+                            n=qint("n", 128),
+                            kind=(query.get("kind") or [None])[0],
+                            request_id=(
+                                query.get("request_id") or [None]
+                            )[0],
+                        ),
+                    )
+                elif route == "/debug/bundle":
+                    # Flight-recorder postmortem artifact (?trace=0
+                    # drops the Perfetto doc for a lighter pull).
+                    self._reply_json(
+                        200,
+                        server.bundle_json(trace=qint("trace", 1) > 0),
                     )
                 elif route == "/debug/kv":
                     # Full (depth-capped, node-bounded) chain-digest
@@ -886,7 +953,10 @@ class LLMServer:
                 # defect (400), not a silent default that would let a
                 # typo'd "interactiv" jump the batch queue.
                 priority = payload.get("priority", "interactive")
-                if priority not in PRIORITIES:
+                if priority not in PRIORITIES and priority != CANARY:
+                    # CANARY is the router's reserved probe class:
+                    # accepted (it rides the interactive queue) but
+                    # excluded from SLO/goodput/ladder accounting.
                     self._reply_json(
                         400,
                         {"error": (
@@ -1161,8 +1231,9 @@ class LLMServer:
         return self.batcher.obs
 
     def _log(self, event: str, message: str = "", **fields) -> None:
-        if self.logger is not None:
-            self.logger.log(event, message, **fields)
+        # self.logger is never None (the ctor substitutes a quiet
+        # ring-only logger), so every event reaches the bundle tail.
+        self.logger.log(event, message, **fields)
 
     def _slo_finalize(self, p: "_Pending", completed: bool) -> None:
         """Score one request against the configured SLOs, exactly once,
@@ -1173,6 +1244,12 @@ class LLMServer:
         if p.slo_accounted:
             return
         p.slo_accounted = True
+        if p.priority == CANARY:
+            # Reserved probe class (overload.CANARY): a canary is the
+            # ROUTER measuring this replica, never workload — scoring
+            # it would let the probe distort the attainment gauges
+            # and (worse) feed the brownout ladder its own probes.
+            return
         self.obs.slo_account(
             p.ttft_ms, p.itl_max_ms, len(p.tokens), completed=completed
         )
@@ -1272,6 +1349,7 @@ class LLMServer:
         t = self.drain_timeout_s if timeout_s is None else float(timeout_s)
         self._drain_deadline = time.monotonic() + max(0.0, t)
         self._draining.set()
+        self.obs.decisions.record("drain", timeout_s=round(t, 3))
 
     def wait_drained(self, timeout: Optional[float] = None) -> bool:
         """Block until the serving loop has exited (drain complete or
@@ -1435,6 +1513,8 @@ class LLMServer:
                 kwargs["stop_tokens"] = tuple(int(t) for t in stops)
         rid = self.batcher.submit(tokens, **kwargs)
         p.request_id = rid
+        if p.priority == CANARY:
+            self.canary_requests_total += 1
         # The batcher opened the timeline under a provisional r<rid>
         # key; attach the END-TO-END id so /debug/requests/<ext_id>
         # resolves (replays re-bind their fresh rid into the same
@@ -1598,9 +1678,16 @@ class LLMServer:
                     "quarantine", f"{feature} quarantined: {exc!r}",
                     feature=feature,
                 )
+                self.obs.decisions.record(
+                    "quarantine", feature=feature, error=repr(exc),
+                )
             self.recoveries_total += 1
             self._log(
                 "crash_recovery", repr(exc), feature=feature,
+                recoveries_total=self.recoveries_total,
+            )
+            self.obs.decisions.record(
+                "recovery", feature=feature, error=repr(exc),
                 recoveries_total=self.recoveries_total,
             )
             self._rebuild_and_replay()
@@ -1611,11 +1698,19 @@ class LLMServer:
             if now - t < self.recovery_window_s
         ]
         if len(self._recovery_times) >= self.max_recoveries:
+            self.obs.decisions.record(
+                "recovery_breaker_tripped", error=repr(exc),
+                recoveries_in_window=len(self._recovery_times),
+            )
             return False
         self._recovery_times.append(now)
         self.recoveries_total += 1
         self._log(
             "crash_recovery", repr(exc),
+            recoveries_total=self.recoveries_total,
+        )
+        self.obs.decisions.record(
+            "recovery", error=repr(exc),
             recoveries_total=self.recoveries_total,
         )
         self._rebuild_and_replay()
@@ -1792,6 +1887,13 @@ class LLMServer:
                     s is not None for s in self.batcher.slots.values()
                 ),
                 "n_slots": self.batcher.n_slots,
+                # Per-replica ITL degradation signal for the router's
+                # health sentinel (None until two non-canary tokens
+                # have been delivered).
+                "itl_ms_ewma": (
+                    round(self.itl_ms_ewma, 3)
+                    if self.itl_ms_ewma is not None else None
+                ),
                 "queued": (
                     self._inbox.qsize() + len(self._active)
                     + self.overload.queued_total()
@@ -1903,6 +2005,18 @@ class LLMServer:
         try:
             while not self._stop.is_set():
                 self._heartbeat = time.monotonic()
+                # Flight recorder: one compact metric snapshot per
+                # flight_interval_s (host-side dict building only) —
+                # the /debug/bundle trend ring.
+                if (
+                    self.flight_interval_s > 0
+                    and self._heartbeat - self._last_flight_t
+                    >= self.flight_interval_s
+                ):
+                    self._last_flight_t = self._heartbeat
+                    self.obs.record_metrics_snapshot(
+                        self._flight_snapshot()
+                    )
                 # Control path: scheduled batcher work (handoff
                 # export/import) runs HERE, between steps, on the
                 # batcher's owning thread.
@@ -1944,6 +2058,9 @@ class LLMServer:
                         self.degrade.start_probe(f)
                     self.probe_rebuilds_total += 1
                     self._log("probe_rebuild", features=",".join(due))
+                    self.obs.decisions.record(
+                        "probe", features=",".join(due)
+                    )
                     self._rebuild_and_replay()
                 # Drain the inbox into the controller's per-class
                 # queues (strict interactive-first ordering lives
@@ -1974,6 +2091,19 @@ class LLMServer:
                     self.obs.annotate(
                         "overload_transition", old=old, state=new
                     )
+                    # Decision log: the rung move WITH the signals
+                    # that drove it, so /debug/decisions explains a
+                    # brownout the way it explains a route.
+                    ov = self.overload.health()
+                    self.obs.decisions.record(
+                        "brownout", old=old, rung=new,
+                        rung_index=RUNG_INDEX[new],
+                        interactive_attainment=(
+                            ov["interactive_attainment"]
+                        ),
+                        queue_wait_ms_p90=ov["queue_wait_ms_p90"],
+                        queued=ov["queued"],
+                    )
                     # The one-shot demotion sweep is an ESCALATION
                     # pressure release only — re-firing it on recovery
                     # steps would evict warm prefix KV exactly as
@@ -1991,6 +2121,11 @@ class LLMServer:
                     self._log(
                         "request_shed", request_id=p.ext_id,
                         priority=p.priority,
+                    )
+                    self.obs.decisions.record(
+                        "shed", request_id=p.ext_id,
+                        priority=p.priority,
+                        retry_after_s=p.retry_after_s,
                     )
                     # Deliberately NOT SLO-scored: a shed is the
                     # controller protecting attainment — counting it
@@ -2012,7 +2147,10 @@ class LLMServer:
                     p = self.overload.pop()
                     if p is None:
                         break
-                    if p.received_at is not None:
+                    if p.received_at is not None and p.priority != CANARY:
+                        # Canary waits are excluded: queue-wait p90 is
+                        # a brownout-ladder pressure signal, and the
+                        # probes must never trigger the ladder.
                         self.overload.observe_queue_wait(
                             (time.monotonic() - p.received_at) * 1000.0
                         )
@@ -2073,23 +2211,36 @@ class LLMServer:
                     if p is None:
                         continue
                     p.tokens.append(tok)
+                    # Canary probes keep their per-request stamps (the
+                    # router reads its own probe latency) but never
+                    # feed the shared histograms/EWMAs — a stream of
+                    # tiny fast probes would skew the very latency
+                    # signals they exist to watch.
+                    canary = p.priority == CANARY
                     if len(p.tokens) == 1:
                         if p.submitted_at is not None:
                             ttft_ms = (now - p.submitted_at) * 1000.0
                             p.ttft_ms = ttft_ms
-                            self.obs.observe_ttft(ttft_ms)
-                            self.ttft_ms_ewma = (
-                                ttft_ms if self.ttft_ms_ewma is None
-                                else 0.8 * self.ttft_ms_ewma
-                                + 0.2 * ttft_ms
-                            )
+                            if not canary:
+                                self.obs.observe_ttft(ttft_ms)
+                                self.ttft_ms_ewma = (
+                                    ttft_ms if self.ttft_ms_ewma is None
+                                    else 0.8 * self.ttft_ms_ewma
+                                    + 0.2 * ttft_ms
+                                )
                     elif p.last_tok_t is not None:
                         # Tokens inside one fused chunk arrive together
                         # (gap ~0); the chunk-period gap lands on the
                         # chunk's first token.  Both are real client-
                         # observed inter-token latencies.
                         itl_ms = (now - p.last_tok_t) * 1000.0
-                        self.obs.observe_itl(itl_ms)
+                        if not canary:
+                            self.obs.observe_itl(itl_ms)
+                            self.itl_ms_ewma = (
+                                itl_ms if self.itl_ms_ewma is None
+                                else 0.8 * self.itl_ms_ewma
+                                + 0.2 * itl_ms
+                            )
                         if p.itl_max_ms is None or itl_ms > p.itl_max_ms:
                             p.itl_max_ms = itl_ms
                     p.last_tok_t = now
@@ -2129,9 +2280,85 @@ class LLMServer:
                 call.error = RuntimeError(reason)
                 call.done.set()
 
+    # -- flight recorder / decision audit (GET /debug/bundle, /debug/decisions)
+
+    def _flight_snapshot(self) -> Dict[str, Any]:
+        """One compact flight-recorder metric snapshot (loop thread —
+        the batcher's owner): the handful of scalars whose trend a
+        postmortem actually reads, not the full exposition (the ring
+        holds ~100 of these)."""
+        st = self.batcher.stats()
+        om = self.obs.metrics()
+        return {
+            "emitted_tokens_total": st["emitted_tokens_total"],
+            "active_slots": st["active_slots"],
+            "queued_requests": st["queued_requests"],
+            "free_blocks": st["free_blocks"],
+            "host_syncs_total": st["host_syncs_total"],
+            "decode_dispatches_total": st["decode_dispatches_total"],
+            "swap_queue_depth": st["swap_queue_depth"],
+            "prefill_tokens_inflight": st["prefill_tokens_inflight"],
+            "requests_finished_total": om["requests_finished_total"],
+            "requests_failed_total": om["requests_failed_total"],
+            "goodput_tokens_total": om["goodput_tokens_total"],
+            "slo_attainment": om["slo_attainment"],
+            "overload_rung": self.overload.rung,
+            "queued_preadmission": self.overload.queued_total(),
+            "recoveries_total": self.recoveries_total,
+            "canary_requests_total": self.canary_requests_total,
+            "draining": self._draining.is_set(),
+        }
+
+    def _config_snapshot(self) -> Dict[str, Any]:
+        """The bundle's ``config`` section: ctor-stable server knobs +
+        the batcher geometry (``ContinuousBatcher.describe``)."""
+        return {
+            "batcher": self.batcher.describe(),
+            "replica_id": self.replica_id,
+            "max_queue": self.max_queue,
+            "max_body_bytes": self.max_body_bytes,
+            "max_recoveries": self.max_recoveries,
+            "recovery_window_s": self.recovery_window_s,
+            "drain_timeout_s": self.drain_timeout_s,
+            "watchdog_deadline_s": self.watchdog_deadline_s,
+            "flight_interval_s": self.flight_interval_s,
+            "slo_ttft_ms": self.obs.slo_ttft_ms,
+            "slo_itl_ms": self.obs.slo_itl_ms,
+        }
+
+    def bundle_json(self, trace: bool = True) -> Dict[str, Any]:
+        """``GET /debug/bundle[?trace=0]`` — the black-box flight
+        recorder's one-shot postmortem artifact: config + current
+        health/metrics + the metric-snapshot trend ring + the last-N
+        control-plane decisions + the annotation (state-transition)
+        ring + the structured-log tail + the request index + the
+        Perfetto trace.  Pure host-side snapshot assembly on the
+        handler thread; the serving loop is never touched beyond the
+        same racy-read surfaces /metrics and /healthz already read."""
+        obs = self.obs
+        out: Dict[str, Any] = {
+            "kind": "replica_bundle",
+            "generated_unix_s": round(time.time(), 3),
+            "replica_id": self.replica_id,
+            "config": self._config_snapshot(),
+            "health": self._health(),
+            "metrics": self._metrics_scalars(),
+            "metric_snapshots": obs.metric_snapshots_json(),
+            "decisions": obs.decisions.json(n=256),
+            "annotations": obs.events_json(),
+            "log_tail": self.logger.tail(),
+            "requests": obs.requests_json(64),
+        }
+        if trace:
+            out["trace"] = obs.trace_json()
+        return out
+
     # -- metrics ------------------------------------------------------------
 
-    def _metrics_text(self) -> str:
+    def _metrics_scalars(self) -> Dict[str, Any]:
+        """Every scalar the /metrics exposition renders (batcher +
+        degrade + obs + overload + server-level), as one dict — shared
+        by ``_metrics_text`` and the /debug/bundle artifact."""
         stats = dict(self.batcher.stats())
         stats.update(self.degrade.stats())
         stats.update(self.obs.metrics())
@@ -2154,12 +2381,23 @@ class LLMServer:
                 round(self.ttft_ms_ewma, 3)
                 if self.ttft_ms_ewma is not None else 0.0
             ),
+            "itl_ms_ewma": (
+                round(self.itl_ms_ewma, 3)
+                if self.itl_ms_ewma is not None else 0.0
+            ),
+            # Control-plane observability: synthetic canary probes
+            # served (the reserved class the router sends).
+            "canary_requests_total": self.canary_requests_total,
             # Scale-out serving: which replica this is (-1 standalone);
             # the serve_mesh_* shape gauges ride batcher.stats().
             "replica_id": (
                 self.replica_id if self.replica_id is not None else -1
             ),
         })
+        return stats
+
+    def _metrics_text(self) -> str:
+        stats = self._metrics_scalars()
         lines = []
         for k, v in stats.items():
             name = f"llm_{k}"

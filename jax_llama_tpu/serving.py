@@ -2425,6 +2425,35 @@ class ContinuousBatcher:
             return 0.0
         return self.drafts_accepted / self.drafts_proposed
 
+    def describe(self) -> Dict[str, Any]:
+        """Ctor-stable configuration snapshot — the ``config`` section
+        of the ``/debug/bundle`` flight-recorder artifact (server.py).
+        Reads only geometry/policy values fixed at construction (the
+        mutable knobs — live prefill_budget under a brownout, live
+        occupancy — belong to stats()/healthz), so it is safe from any
+        thread without a pragma."""
+        kw = self._ctor_kwargs
+        return {
+            "n_slots": self.n_slots,
+            "max_len": self.max_len,
+            "block_size": self.block_size,
+            "n_blocks": self.n_blocks,
+            "block_bytes": self.block_bytes,
+            "decode_chunk": int(kw["decode_chunk"]),
+            "spec_rounds": int(kw["spec_rounds"]),
+            "speculative": self.spec,
+            "n_draft": self.n_draft if self.spec else 0,
+            "prefill_budget": int(kw["prefill_budget"]),
+            "prefix_index": self.prefix_index,
+            "host_kv_blocks": self.host_kv_blocks,
+            "logprobs": self.logprobs,
+            "use_pallas_kernel": bool(kw["use_pallas_kernel"]),
+            "cost_models": self.cost_models,
+            "serve_mesh": smesh.mesh_shape(
+                self.mesh if self._mesh_placed else None
+            ),
+        }
+
     def stats(self) -> Dict[str, float]:
         """Counters for observability (the HTTP /metrics endpoint).
 

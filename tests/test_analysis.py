@@ -1018,6 +1018,69 @@ class TestMetricsLint:
         assert not any(f.rule == "unregistered-metric" for f in fs), \
             [f.render() for f in fs]
 
+    def test_router_registry_package_clean(self):
+        from jax_llama_tpu.analysis.metricscheck import (
+            check_router_registry,
+        )
+
+        fs = check_router_registry()
+        assert fs == [], "\n".join(f.render() for f in fs)
+
+    def test_router_registry_drift_fixtures(self):
+        """Both router-audit directions bite: a registered family
+        nothing emits, a fam() header with no registration, and a raw
+        sample line minting an unregistered family — while the clean
+        family passes and docstring/registry mentions are NOT
+        evidence."""
+        from jax_llama_tpu.analysis.metricscheck import (
+            check_router_registry,
+        )
+
+        src = (
+            '"""Docstring naming llm_router_doc_only_total is not '
+            'emission evidence."""\n'
+            'ROUTER_METRICS = {\n'
+            '    "llm_router_emitted_total": ("counter", "ok"),\n'
+            '    "llm_router_ghost_total": ("counter", "never"),\n'
+            '}\n'
+            'def fam(name):\n'
+            '    pass\n'
+            'def render(lines, n):\n'
+            '    fam("llm_router_emitted_total")\n'
+            '    fam("llm_router_undeclared_total")\n'
+            '    lines.append(f"llm_router_emitted_total {n}")\n'
+            '    lines.append(f"llm_fleet_raw_gauge {n}")\n'
+        )
+        registry = {
+            "llm_router_emitted_total": ("counter", "ok"),
+            "llm_router_ghost_total": ("counter", "never"),
+        }
+        fs = check_router_registry(
+            registry=registry, source=src, path="fixture_router.py"
+        )
+        unemitted = [
+            f for f in fs if f.rule == "router-unemitted-metric"
+        ]
+        unregistered = [
+            f for f in fs if f.rule == "router-unregistered-metric"
+        ]
+        assert len(unemitted) == 1
+        assert "llm_router_ghost_total" in unemitted[0].message
+        names = {
+            n for f in unregistered
+            for n in ("llm_router_undeclared_total",
+                      "llm_fleet_raw_gauge")
+            if n in f.message
+        }
+        assert names == {
+            "llm_router_undeclared_total", "llm_fleet_raw_gauge",
+        }
+        assert not any(
+            "llm_router_emitted_total" in f.message
+            or "llm_router_doc_only_total" in f.message
+            for f in fs
+        )
+
 
 # ---------------------------------------------------------------------------
 # Comms-budget contracts (analysis/comms.py)

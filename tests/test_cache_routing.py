@@ -245,25 +245,29 @@ def test_cache_pick_spill_watermark_and_handoff_plan():
             "kv": {"digest": {"version": 1 if rep.index == 0 else 0}},
         }
     with router._lock:
-        rep, how, stale, plan = router._pick_locked(
+        rep, how, stale, plan, dec = router._pick_locked(
             None, frozenset(), k[:2]
         )
     assert (rep.index, how, stale, plan) == (0, "cache-aware", False,
                                              None)
+    # The decision record carries the audit facts (r15).
+    assert dec["hit_depth"] == 2 and len(dec["candidates"]) == 2
+    assert dec["holders"] == [{"replica": 0, "tier": "hbm"}]
     assert router.cache_hit_depth_blocks_total == 2
     # Holder past the occupancy watermark (2 inflight / 2 slots = 1.0
     # >= spill_occupancy 1.0): spill to least-loaded + migration plan
     # (score = depth 2 x gap 1.0 = 2.0 >= threshold 1.0).
     router._replicas[0].inflight = 2
     with router._lock:
-        rep, how, stale, plan = router._pick_locked(
+        rep, how, stale, plan, dec = router._pick_locked(
             None, frozenset(), k[:2]
         )
     assert (rep.index, how) == (1, "spill")
+    assert dec["spill_from"] == 0 and dec["handoff_score"] >= 1.0
     assert plan == {"src": 0, "dst": 1, "keys_hex": k[:2], "depth": 2}
     # Cold prompts stay least-loaded.
     with router._lock:
-        rep, how, _, plan = router._pick_locked(
+        rep, how, _, plan, _dec = router._pick_locked(
             None, frozenset(), [k[2]]
         )
     assert (rep.index, how, plan) == (1, "least-loaded", None)
@@ -305,7 +309,7 @@ def test_stale_digest_detection_counts_and_routes():
         "kv": {"digest": {"version": 7}},  # moved past synced=1
     }
     with router._lock:
-        rep, how, stale, _ = router._pick_locked(
+        rep, how, stale, _, _dec = router._pick_locked(
             None, frozenset(), k
         )
     assert (rep.index, how, stale) == (0, "cache-aware", True)

@@ -1,0 +1,523 @@
+"""Fleet control-plane observability (ISSUE 15): the decision audit
+log, the black-box flight recorder, synthetic canary probes, and the
+per-replica health-score/anomaly sentinel.
+
+Pure-host units (DecisionLog / EwmaDetector / HealthSentinel) run in
+milliseconds; the server- and fleet-level drills reuse the exact
+tiny-model geometry of tests/test_router.py (n_slots=2 / max_len=64)
+so the jitted-program compiles are shared across files in one tier-1
+process.
+"""
+
+import json
+import urllib.request
+
+import jax
+import pytest
+
+from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.obs import DecisionLog, EwmaDetector
+from jax_llama_tpu.router import (
+    SENTINEL_SIGNALS,
+    HealthSentinel,
+    ReplicaRouter,
+)
+from jax_llama_tpu.server import LLMServer
+from jax_llama_tpu.serving import ContinuousBatcher
+from jax_llama_tpu.tokenizers.bytes import ByteTokenizer
+
+CFG = dict(
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    multiple_of=32, max_seq_len=128, dtype="float32",
+    param_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = get_config("tiny", **CFG)
+    params = init_params(jax.random.PRNGKey(0), config)
+    return params, config
+
+
+def _post(url, payload, path="/generate", rid=None, timeout=300):
+    headers = {"Content-Type": "application/json"}
+    if rid is not None:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(), headers=headers,
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _get_json(url, path, timeout=60):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _metrics(url, timeout=60):
+    """Unlabeled sample lines of a /metrics exposition as a dict."""
+    with urllib.request.urlopen(url + "/metrics", timeout=timeout) as r:
+        text = r.read().decode()
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, val = line.split(" ", 1)
+        try:
+            out[name] = float(val)
+        except ValueError:
+            pass
+    return out, text
+
+
+# ---------------------------------------------------------------------------
+# DecisionLog / EwmaDetector units (pure host)
+# ---------------------------------------------------------------------------
+
+def test_decision_log_records_filters_and_ring():
+    log = DecisionLog(ring=4)
+    for i in range(6):
+        log.record("route", request_id=f"r{i % 2}", replica=i)
+    log.record("reroute", request_id="r0", failed_replica=1)
+    # seq survives ring eviction; counts are lifetime totals
+    assert log.total() == 7
+    assert log.counts_snapshot() == {"route": 6, "reroute": 1}
+    doc = log.json()
+    assert doc["events_total"] == 7 and doc["ring"] == 4
+    assert len(doc["decisions"]) == 4  # ring bound
+    seqs = [d["seq"] for d in doc["decisions"]]
+    assert seqs == sorted(seqs) and seqs[-1] == 6
+    # kind + request_id filters (the timeline join)
+    only = log.json(kind="reroute")["decisions"]
+    assert len(only) == 1 and only[0]["failed_replica"] == 1
+    joined = log.for_request("r0")
+    assert joined and all(d["request_id"] == "r0" for d in joined)
+    # None-valued fields drop from the record
+    log.record("canary", replica=0, error=None, ok=True)
+    last = log.json(n=1)["decisions"][0]
+    assert "error" not in last and last["ok"] is True
+
+
+def test_ewma_detector_warmup_then_flags_spike():
+    det = EwmaDetector(alpha=0.2, min_samples=5)
+    zs = [det.update(10.0) for _ in range(5)]
+    assert all(z is None for z in zs)  # warmup: no baseline, no verdict
+    assert det.update(10.0) is not None  # baseline established
+    z = det.update(1000.0)
+    assert z is not None and z > 100.0  # spike vs near-constant baseline
+    # scoring is against PRE-update stats: a healthy value right after
+    # the spike still reads near the old baseline, not the spike
+    z2 = det.update(10.0)
+    assert z2 is not None and z2 < 0.0
+
+
+# ---------------------------------------------------------------------------
+# HealthSentinel units (pure host)
+# ---------------------------------------------------------------------------
+
+def test_sentinel_canary_failures_drop_score_and_flip_verdict():
+    s = HealthSentinel()
+    evs = s.observe_canary(0, ok=True, latency_ms=10.0)
+    assert s.verdict(0) == "healthy" and s.score(0) == 1.0
+    assert evs == []
+    # one failed probe: counted anomaly edge + verdict drops to suspect
+    evs = s.observe_canary(0, ok=False, error="connect refused")
+    kinds = [e["kind"] for e in evs]
+    assert "anomaly" in kinds and "verdict" in kinds
+    assert s.anomalies_total["canary"] == 1
+    assert s.verdict(0) == "suspect" and s.score(0) < 0.8
+    # sustained failure: NO second anomaly event (edge-triggered),
+    # verdict eventually critical
+    evs = s.observe_canary(0, ok=False, error="connect refused")
+    assert "anomaly" not in [e["kind"] for e in evs]
+    assert s.anomalies_total["canary"] == 1
+    s.observe_canary(0, ok=False, error="connect refused")
+    assert s.verdict(0) == "critical" and s.score(0) < 0.5
+    # recovery: successes clear the anomaly and restore the verdict
+    cleared = False
+    for _ in range(8):
+        evs = s.observe_canary(0, ok=True, latency_ms=10.0)
+        cleared = cleared or "anomaly_cleared" in [
+            e["kind"] for e in evs
+        ]
+    assert cleared and s.verdict(0) == "healthy"
+    assert s.anomalies_total["canary"] == 1  # incidents, not samples
+
+
+def test_sentinel_token_mismatch_is_immediate_anomaly():
+    s = HealthSentinel()
+    s.observe_canary(1, ok=True, latency_ms=5.0)
+    evs = s.observe_canary(1, ok=False, mismatch=True, latency_ms=5.0)
+    assert any(
+        e["kind"] == "anomaly" and e["signal"] == "canary"
+        and e.get("mismatch") for e in evs
+    )
+    # a mismatch pins the canary subscore to 0 — worse than a flake
+    assert s.score(1) < 0.8
+
+
+def test_sentinel_latency_zscore_anomaly():
+    s = HealthSentinel(min_samples=5)
+    for _ in range(6):
+        s.observe_canary(0, ok=True, latency_ms=10.0)
+    before = s.anomalies_total["latency"]
+    evs = s.observe_canary(0, ok=True, latency_ms=5000.0)
+    assert s.anomalies_total["latency"] == before + 1
+    assert any(
+        e["kind"] == "anomaly" and e["signal"] == "latency"
+        for e in evs
+    )
+
+
+def test_sentinel_zscore_floor_suppresses_ms_blips():
+    """A near-zero healthy baseline must not turn a harmless
+    single-digit-ms blip into a 500-sigma anomaly: the absolute
+    z-divisor floor (z_floor_ms) bounds sensitivity in the signal's
+    own units."""
+    det = EwmaDetector(alpha=0.2, min_samples=5, floor=5.0)
+    for _ in range(6):
+        det.update(0.05)
+    z = det.update(3.0)  # a GC-pause-sized blip over a 0.05 ms base
+    assert z is not None and z < 3.0  # under the anomaly threshold
+    s = HealthSentinel(min_samples=5)  # default z_floor_ms
+    for _ in range(6):
+        s.observe_health(0, reachable=True, queue_wait_ms=0.05,
+                         age_s=0.0)
+    s.observe_health(0, reachable=True, queue_wait_ms=3.0, age_s=0.0)
+    assert s.anomalies_total["queue_wait"] == 0
+    assert s.verdict(0) == "healthy"
+
+
+def test_canary_oracle_majority_repin_and_reset():
+    """A wrong-output replica probed first must not invert the fleet
+    verdict: the oracle resolves against the WHOLE sweep, and a
+    strict majority disagreeing with the pin re-pins it (counted);
+    reset_canary_oracle() is the rollout hook."""
+    router = ReplicaRouter(
+        ["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"],
+        health_interval_s=0, canary_interval_s=0,
+    )
+    reps = router._replicas
+    # the corrupt replica 0 was probed first and pinned a bad oracle
+    with router._lock:
+        router._canary_oracle = [9, 9]
+    results = [
+        (reps[0], {"ok": True, "tokens": [9, 9], "latency_ms": 1.0,
+                   "request_id": "c0"}),
+        (reps[1], {"ok": True, "tokens": [1, 2], "latency_ms": 1.0,
+                   "request_id": "c1"}),
+        (reps[2], {"ok": True, "tokens": [1, 2], "latency_ms": 1.0,
+                   "request_id": "c2"}),
+    ]
+    router._resolve_canary_oracle(results)
+    with router._lock:
+        assert router._canary_oracle == [1, 2]  # majority wins
+    assert router.canary_oracle_repins_total == 1
+    # ... and it is the CORRUPT replica that reads mismatched now
+    assert results[0][1]["mismatch"] and not results[0][1]["ok"]
+    assert results[1][1]["ok"] and results[2][1]["ok"]
+    # a 1-vs-1 split keeps the pin (no majority — cannot tell who
+    # is wrong, only that they disagree)
+    split = [
+        (reps[0], {"ok": True, "tokens": [1, 2], "latency_ms": 1.0}),
+        (reps[1], {"ok": True, "tokens": [7, 7], "latency_ms": 1.0}),
+    ]
+    router._resolve_canary_oracle(split)
+    with router._lock:
+        assert router._canary_oracle == [1, 2]
+    assert router.canary_oracle_repins_total == 1
+    assert split[1][1]["mismatch"] and not split[1][1]["ok"]
+    # the rollout hook forgets the pin; the next sweep re-establishes
+    router.reset_canary_oracle()
+    with router._lock:
+        assert router._canary_oracle is None
+    # with NO pin, a tie must not crown either side by probe order —
+    # the oracle stays unset, NOBODY is mismatched, and the split is
+    # recorded as a disagreement decision
+    tie = [
+        (reps[0], {"ok": True, "tokens": [8, 8], "latency_ms": 1.0}),
+        (reps[1], {"ok": True, "tokens": [6, 6], "latency_ms": 1.0}),
+    ]
+    router._resolve_canary_oracle(tie)
+    with router._lock:
+        assert router._canary_oracle is None
+    assert tie[0][1]["ok"] and tie[1][1]["ok"]
+    router._resolve_canary_oracle([
+        (reps[0], {"ok": True, "tokens": [4, 4], "latency_ms": 1.0}),
+    ])
+    with router._lock:
+        assert router._canary_oracle == [4, 4]
+    kinds = {
+        d["kind"] for d in router.decisions.json(n=64)["decisions"]
+    }
+    assert {"canary_oracle_repin", "canary_oracle_reset",
+            "canary_oracle_disagreement"} <= kinds
+
+
+def test_sentinel_health_signals_attainment_and_staleness():
+    s = HealthSentinel()
+    # healthy scrapes keep everything at 1.0
+    evs = s.observe_health(
+        0, reachable=True, attainment=1.0, queue_wait_ms=5.0,
+        itl_ms=20.0, age_s=0.0,
+    )
+    assert evs == [] and s.score(0) == 1.0
+    # collapsing attainment smooths down into an anomaly
+    for _ in range(12):
+        evs = s.observe_health(0, reachable=True, attainment=0.0,
+                               age_s=0.0)
+    assert s.anomalies_total["attainment"] == 1
+    assert s.verdict(0) != "healthy"
+    # a replica gone unreachable: staleness decays with scrape age
+    s2 = HealthSentinel(staleness_allowance_s=1.0)
+    s2.observe_health(1, reachable=True, age_s=0.0)
+    evs = s2.observe_health(1, reachable=False, age_s=10.0)
+    assert s2.anomalies_total["staleness"] == 1
+    assert any(e["kind"] == "anomaly" for e in evs)
+    fleet = s2.fleet_json()
+    assert fleet["replicas"][1]["verdict"] != "healthy"
+    assert "staleness" in fleet["replicas"][1]["anomalous"]
+    assert set(fleet["anomalies_total"]) == set(SENTINEL_SIGNALS)
+
+
+# ---------------------------------------------------------------------------
+# Server level: the reserved canary class + the flight recorder surface
+# ---------------------------------------------------------------------------
+
+def _mk_server(model, tok, **kw):
+    params, config = model
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=64,
+        stop_tokens=tuple(tok.stop_tokens),
+    )
+    return LLMServer(cb, tokenizer=tok, **kw)
+
+
+def test_canary_class_served_but_excluded_from_slo_and_ladder(model):
+    """SATELLITE PIN: the reserved canary request class is served
+    normally but excluded from SLO attainment, goodput, the latency
+    histograms/EWMAs and the brownout ladder's signal windows."""
+    tok = ByteTokenizer()
+    with _mk_server(model, tok) as srv:
+        status, body, _ = _post(srv.address, {
+            "prompt": [1, 2, 3], "max_new_tokens": 4,
+            "temperature": 0.0, "seed": 0, "priority": "canary",
+        })
+        assert status == 200 and body["tokens"]
+        m, _ = _metrics(srv.address)
+        assert m["llm_canary_requests_total"] == 1
+        assert m["llm_requests_finished_total"] == 1  # served...
+        assert m["llm_requests_slo_ok_total"] == 0    # ...never scored
+        assert m["llm_goodput_tokens_total"] == 0
+        assert m["llm_ttft_ms_count"] == 0            # histogram clean
+        assert m["llm_itl_ms_count"] == 0
+        # ladder signal windows untouched (no self-triggered brownouts)
+        with srv.overload._lock:
+            assert all(
+                len(w) == 0
+                for w in srv.overload._slo_windows.values()
+            )
+            assert len(srv.overload._wait_window) == 0
+        # a NORMAL request scores everything the canary skipped
+        status, body, _ = _post(srv.address, {
+            "prompt": [1, 2, 3], "max_new_tokens": 4,
+            "temperature": 0.0, "seed": 0,
+        })
+        assert status == 200
+        m, _ = _metrics(srv.address)
+        assert m["llm_requests_slo_ok_total"] == 1
+        assert m["llm_goodput_tokens_total"] >= len(body["tokens"])
+        assert m["llm_ttft_ms_count"] == 1
+        # junk priority is still the client's defect
+        req = urllib.request.Request(
+            srv.address + "/generate",
+            data=json.dumps({"prompt": [1], "priority": "vip"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "junk priority must 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            e.read()
+
+
+def test_server_debug_decisions_and_bundle(model):
+    """The replica-side decision log + flight-recorder artifact:
+    decisions land with kinds and filters, and /debug/bundle
+    round-trips one parseable postmortem JSON carrying config /
+    health / metrics / snapshot ring / decisions / log tail /
+    trace."""
+    tok = ByteTokenizer()
+    with _mk_server(model, tok, flight_interval_s=0.05) as srv:
+        status, body, _ = _post(srv.address, {
+            "prompt": [9, 8, 7], "max_new_tokens": 4,
+            "temperature": 0.0,
+        }, rid="ctl-1")
+        assert status == 200
+        srv.begin_drain(timeout_s=5.0)
+        status, doc = _get_json(srv.address, "/debug/decisions")
+        assert status == 200
+        kinds = {d["kind"] for d in doc["decisions"]}
+        assert "drain" in kinds
+        assert doc["events_total"] >= 1 and doc["counts"]["drain"] == 1
+        status, only = _get_json(
+            srv.address, "/debug/decisions?kind=drain"
+        )
+        assert {d["kind"] for d in only["decisions"]} == {"drain"}
+        status, bundle = _get_json(srv.address, "/debug/bundle")
+        assert status == 200 and bundle["kind"] == "replica_bundle"
+        for key in ("config", "health", "metrics", "metric_snapshots",
+                    "decisions", "annotations", "log_tail",
+                    "requests", "trace"):
+            assert key in bundle, key
+        assert bundle["config"]["batcher"]["n_slots"] == 2
+        assert bundle["config"]["batcher"]["block_size"] > 0
+        assert bundle["metrics"]["requests_finished_total"] == 1
+        # the loop snapshots at least once (first iteration fires)
+        assert len(bundle["metric_snapshots"]) >= 1
+        snap = bundle["metric_snapshots"][-1]
+        assert "emitted_tokens_total" in snap and "overload_rung" in snap
+        assert isinstance(bundle["log_tail"], list)
+        assert isinstance(bundle["trace"]["traceEvents"], list)
+        # ?trace=0 slims the artifact
+        status, slim = _get_json(srv.address, "/debug/bundle?trace=0")
+        assert "trace" not in slim
+
+
+# ---------------------------------------------------------------------------
+# THE fleet drill (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.mesh_serving
+def test_fleet_drill_canary_flags_degraded_replica(model):
+    """ACCEPTANCE PIN (ISSUE 15): inject a failure on one replica of a
+    routed 2-replica fleet and show the whole control-plane
+    observability story — the canary flags it, its health score drops
+    and the /debug/fleet verdict flips, an anomaly counter fires,
+    GET /debug/decisions explains the subsequent re-routes (candidate
+    sets included, joinable by request id), and GET /debug/bundle
+    round-trips one parseable postmortem artifact."""
+    tok = ByteTokenizer()
+    servers = [
+        _mk_server(model, tok, replica_id=i).start() for i in range(2)
+    ]
+    router = ReplicaRouter(
+        servers, policy="least-loaded",
+        health_interval_s=0, canary_interval_s=0,  # manual drills
+    ).start()
+    try:
+        router.check_health_now()
+        router.run_canaries_now()
+        with router._lock:
+            oracle = router._canary_oracle
+        assert oracle, "first successful probe pins the fleet oracle"
+        assert router.canary_probes_total == 2
+        assert router.canary_failures_total == 0
+        status, fleet = _get_json(router.address, "/debug/fleet")
+        assert status == 200 and fleet["verdict"] == "healthy"
+        assert all(r["verdict"] == "healthy" for r in fleet["replicas"])
+
+        # one real request (client-supplied id → decision join key)
+        status, body, headers = _post(
+            router.address,
+            {"prompt": [5, 6, 7], "max_new_tokens": 4,
+             "temperature": 0.0},
+            rid="drill-1",
+        )
+        assert status == 200 and headers["X-Replica-Id"] == "0"
+
+        # REPLICA 1 DEGRADES: its HTTP front door dies (loop alive —
+        # the half-dead failure mode a liveness probe alone misses).
+        servers[1].httpd.shutdown()
+        servers[1].httpd.server_close()
+
+        # the canary flags it: counted failures, health score drops,
+        # anomaly fires, verdict flips
+        for _ in range(3):
+            router.run_canaries_now()
+        assert router.canary_failures_total >= 3
+        status, fleet = _get_json(router.address, "/debug/fleet")
+        by_idx = {r["replica"]: r for r in fleet["replicas"]}
+        assert by_idx[0]["verdict"] == "healthy"
+        assert by_idx[1]["verdict"] in ("suspect", "critical")
+        assert by_idx[1]["score"] < 0.8
+        assert by_idx[1]["last_canary"]["ok"] is False
+        assert "canary" in by_idx[1]["anomalous"]
+        assert fleet["anomalies_total"]["canary"] >= 1
+        assert fleet["verdict_index"] >= 1  # the autoscaler's signal
+        assert fleet["canary"]["oracle_tokens"] == oracle
+
+        # next request picks replica 1 (least routed), fails, and
+        # re-routes LOSSLESSLY to replica 0
+        status, body, headers = _post(
+            router.address,
+            {"prompt": [5, 6, 7], "max_new_tokens": 4,
+             "temperature": 0.0},
+            rid="drill-2",
+        )
+        assert status == 200 and headers["X-Replica-Id"] == "0"
+
+        # /debug/decisions explains the story
+        status, doc = _get_json(
+            router.address, "/debug/decisions?n=256"
+        )
+        kinds = {d["kind"] for d in doc["decisions"]}
+        assert {"route", "reroute", "canary", "anomaly",
+                "verdict"} <= kinds
+        # ... and joins by request id: route(1) -> reroute -> route(0)
+        status, doc2 = _get_json(
+            router.address, "/debug/decisions?request_id=drill-2"
+        )
+        evs = doc2["decisions"]
+        routes = [d for d in evs if d["kind"] == "route"]
+        assert [d["replica"] for d in routes] == [1, 0]
+        assert all(d["candidates"] for d in routes)
+        assert routes[1]["policy"] == "reroute"
+        rr = [d for d in evs if d["kind"] == "reroute"]
+        assert rr and rr[0]["failed_replica"] == 1
+        # the fleet request lookup carries the same join
+        status, tl = _get_json(
+            router.address, "/debug/requests/drill-2"
+        )
+        assert status == 200 and tl["router_decisions"]
+
+        # the postmortem artifact round-trips as one parseable doc
+        status, bundle = _get_json(router.address, "/debug/bundle")
+        assert status == 200 and bundle["kind"] == "router_bundle"
+        assert bundle["fleet"]["verdict_index"] >= 1
+        assert bundle["decisions"]["events_total"] >= 5
+        assert isinstance(bundle["trace"]["traceEvents"], list)
+        reps = bundle["replicas"]
+        assert [b["replica"] for b in reps] == [0]  # 1 is unroutable
+        assert reps[0]["kind"] == "replica_bundle"
+        assert reps[0]["config"]["batcher"]["n_slots"] == 2
+        # Replica bundles ship WITHOUT their own trace — the fleet-
+        # merged trace above already carries replica-0's tracks, and
+        # shipping them twice would double the heaviest section.
+        assert "trace" not in reps[0]
+        assert any(
+            e.get("ph") == "M"
+            and e.get("args", {}).get("name") == "replica-0"
+            for e in bundle["trace"]["traceEvents"]
+        )
+
+        # the router exposition carries the new families
+        m, text = _metrics(router.address)
+        assert m["llm_router_canary_failures_total"] >= 3
+        assert m["llm_router_fleet_verdict"] >= 1
+        assert 'llm_router_replica_health_score{replica="1"}' in text
+        assert 'llm_router_anomalies_total{signal="canary"}' in text
+        assert 'llm_router_decisions_total{kind="route"}' in text
+
+        # replica 0 served the canaries under the reserved class:
+        # counted, never SLO-scored
+        m0, _ = _metrics(servers[0].address)
+        assert m0["llm_canary_requests_total"] >= 4
+        assert m0["llm_requests_slo_ok_total"] == 2  # the 2 real ones
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()

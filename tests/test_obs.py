@@ -436,6 +436,77 @@ def test_annotation_ring_bounded():
     assert obs.events[-1]["fields"]["call"] == 9
 
 
+def test_evict_locked_ring_pressure_no_orphans_and_decision_join():
+    """SATELLITE PIN (ISSUE 15): timelines evicted under ring pressure
+    — including LIVE ones in the pathological all-live branch — must
+    leave no orphaned ``_by_rid`` entries, make every later touch of
+    the evicted rid a clean no-op (no resurrection, no miscount), and
+    never corrupt the decision join by request_id (the join degrades
+    to decisions-only for an evicted timeline)."""
+    obs = Observability(max_timelines=8, clock=FakeClock())
+    # 16 LIVE timelines: the terminal-preference scan finds none, so
+    # the oldest live ones go — the hard-bound branch.
+    for rid in range(16):
+        obs.request_queued(rid, prompt_tokens=4)
+        obs.bind(rid, f"req-{rid}")
+    assert len(obs._timelines) == 8
+    # No orphans: every rid index entry points at a timeline that is
+    # still reachable under its request_id.
+    for rid, tl in obs._by_rid.items():
+        assert obs._timelines.get(tl.request_id) is tl
+    assert obs.timeline_json("req-0") is None     # evicted
+    assert obs.timeline_json("req-15") is not None
+    # A dispatch naming an evicted rid neither crashes nor resurrects
+    # it; spans of retained timelines still link.
+    obs.record_dispatch("decode", rids=[0, 15])
+    assert 0 not in obs._by_rid
+    tl15 = obs.timeline_json("req-15")
+    assert tl15["spans"][0]["dispatches"], "live span keeps its link"
+    # request_end on the evicted rid is a clean no-op — the finished
+    # counter must not move for a request /debug can no longer name.
+    fin0 = obs.requests_finished_total
+    obs.request_end(0, "finished")
+    assert obs.requests_finished_total == fin0
+    # Decision join under eviction: decisions recorded for the evicted
+    # id still answer by request_id (decisions-only degradation).
+    obs.decisions.record("route", request_id="req-0", replica=1)
+    joined = obs.decisions.for_request("req-0")
+    assert len(joined) == 1 and joined[0]["replica"] == 1
+    # Terminal preference: once terminal timelines exist they are
+    # evicted FIRST, keeping every live (debuggable) one resident.
+    obs.request_end(8, "finished")
+    obs.request_end(9, "failed", "boom")
+    for rid in range(16, 18):
+        obs.request_queued(rid, prompt_tokens=4)
+        obs.bind(rid, f"req-{rid}")
+    assert "req-8" not in obs._timelines
+    assert "req-9" not in obs._timelines
+    for live in (10, 11, 17):
+        assert f"req-{live}" in obs._timelines
+    for rid, tl in obs._by_rid.items():
+        assert obs._timelines.get(tl.request_id) is tl
+
+
+def test_metric_snapshot_ring_bounded_and_stamped():
+    obs = Observability(max_snapshots=4, clock=FakeClock())
+    for i in range(10):
+        obs.record_metrics_snapshot({"emitted_tokens_total": i})
+    snaps = obs.metric_snapshots_json()
+    assert len(snaps) == 4
+    assert snaps[-1]["emitted_tokens_total"] == 9
+    assert "t_ms" in snaps[-1] and "unix_s" in snaps[-1]
+
+
+def test_structured_logger_tail_ring(capsys):
+    log = StructuredLogger(quiet=True, ring=3)
+    for i in range(5):
+        log.log("event", index=i)
+    assert capsys.readouterr().out == ""  # quiet: ring only
+    tail = log.tail()
+    assert len(tail) == 3 and tail[-1] == "event index=4"
+    assert log.tail(1) == ["event index=4"]
+
+
 # ---------------------------------------------------------------------------
 # Perfetto / Chrome trace_event export schema
 # ---------------------------------------------------------------------------

@@ -32,6 +32,49 @@ def model():
     return params, config
 
 
+def test_flight_recorder_zero_overhead(model):
+    """ACCEPTANCE PIN (ISSUE 15): the control-plane recorder is
+    host-side bookkeeping only — steady-state chunk dispatches keep
+    the exact 1-fetch / 0-upload contract while decisions are being
+    recorded and EVERY flight-recorder surface (the decision log's
+    json, the metric-snapshot ring, the config snapshot) is scraped
+    mid-decode, exactly as /debug/decisions and /debug/bundle handler
+    threads would."""
+    params, config = model
+    cb = ContinuousBatcher(
+        params, config, n_slots=2, max_len=128, decode_chunk=4,
+        block_size=16,
+    )
+    cb.submit(list(np.random.RandomState(7).randint(1, 128, 40)),
+              max_new_tokens=40)
+    cb.step(); cb.step()  # admission + chunk ramp
+    s0, u0, d0 = (
+        cb.host_syncs_total, cb.state_uploads_total,
+        cb.decode_dispatches_total,
+    )
+    for i in range(4):
+        cb.step()
+        # Record + scrape the recorder surfaces mid-decode.
+        cb.obs.decisions.record(
+            "route", request_id=f"r{i}", replica=0,
+            policy="least-loaded",
+        )
+        cb.obs.record_metrics_snapshot(
+            {"emitted_tokens_total": int(cb.emitted_total)}
+        )
+        doc = cb.obs.decisions.json(n=8)
+        assert doc["events_total"] == i + 1
+        assert len(cb.obs.metric_snapshots_json()) == i + 1
+        assert cb.describe()["decode_chunk"] == 4
+    dispatches = cb.decode_dispatches_total - d0
+    assert dispatches == 4
+    # Bit-identical steady-state contract with the recorder live:
+    # 1 fetch per chunk, 0 uploads, no extra dispatches from any of
+    # the recording or scraping above.
+    assert cb.host_syncs_total - s0 == dispatches
+    assert cb.state_uploads_total == u0
+
+
 def test_steady_state_host_sync_discipline(model):
     """Steady-state chunk dispatches: exactly 1 device->host sync each,
     0 host->device state uploads (state is device-resident; only
