@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 tier1-budget faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve fleet bench-compare check
+.PHONY: tier1 tier1-budget faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve fleet elastic bench-compare check
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -104,6 +104,14 @@ fleet:
 	$(PYTEST) tests/test_cache_routing.py -q
 	$(PYTEST) tests/test_run_cli.py -q -k 'cache_aware or replica'
 	env JAX_PLATFORMS=cpu python bench.py --multichip-serving
+
+# Elastic fleet (FleetController): autoscaler hysteresis, drain-by-
+# migration (zero dropped sessions, token-identical), zero-downtime
+# rollouts with the per-rung canary gate, and the scale_event /
+# session_migrate chaos drills.
+elastic:
+	$(PYTEST) tests/test_elastic.py -q
+	$(PYTEST) tests/test_faults.py -q -k 'migrate or scale_event'
 
 # Invariant auditor (jax_llama_tpu/analysis): host-boundary lint,
 # lowering-contract audit (donated args actually alias, host-fetch

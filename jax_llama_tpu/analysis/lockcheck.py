@@ -165,6 +165,24 @@ LOCK_GUARDS: Tuple[LockGuard, ...] = (
             "_canary_oracle", "_canary_seq",
         }),
     ),
+    # Elastic-fleet controller (router.py): the background control
+    # loop, operator HTTP handlers (drain/rollout entries), and the
+    # /metrics + /debug/fleet renderers share the counters and
+    # hysteresis state — all under the controller's own leaf lock
+    # (compute under it, act outside it: never held while calling the
+    # router or a replica, so it never nests with router._lock in
+    # either order).
+    LockGuard(
+        module="router", cls="FleetController", lock="_lock",
+        fields=frozenset({
+            "_scale_events", "sessions_migrated_total",
+            "sessions_migrate_failed_total",
+            "drains_total", "drains_failed_total",
+            "rollouts_total", "rollbacks_total", "rollout_rung",
+            "_pressure_since", "_calm_since", "_last_action_t",
+            "_busy", "_last_signals", "_owned", "_rollout_oracle",
+        }),
+    ),
     # Per-replica health sentinel (router.py): the canary prober and
     # the health poller feed observations while handler threads read
     # /debug/fleet and /metrics — all state under the sentinel's own

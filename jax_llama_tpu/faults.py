@@ -99,6 +99,17 @@ SITES = (
     # crashed replica replay through that replica's own crash-recovery
     # path).
     "router_replica",
+    # Controller-side sites (router.FleetController).  ``session_migrate``
+    # fires once per live session at the start of its drain migration —
+    # an injected fault aborts THAT session's move only: the source copy
+    # is untouched (export never demotes before destination residency is
+    # proven), the session keeps serving from the source, and the drain
+    # reports the failure instead of dropping anyone.  ``scale_event``
+    # fires at the start of each scale-up / scale-down / rollout-rung
+    # action — an injected fault aborts the whole action cleanly (fleet
+    # membership unchanged, decision record explains the abort).
+    "session_migrate",
+    "scale_event",
 )
 KINDS = ("error", "oom", "delay", "nan")
 

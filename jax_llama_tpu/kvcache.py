@@ -777,6 +777,25 @@ class RadixPrefixStore:
     def host_blocks(self) -> int:
         return len(self.tier) if self.tier is not None else 0
 
+    def resident_chains(self) -> List[List[bytes]]:
+        """Every maximal HBM-resident chain as its ordered key path
+        (root child → deepest resident node) — the drain/migration
+        enumeration surface.  A path is cut at the first non-HBM node
+        (demoted or restoring): only the contiguous resident prefix can
+        be exported, exactly what ``export_prefix`` would move.  Nodes
+        whose chain continues resident are not emitted separately —
+        their keys appear as prefixes of the longer chain."""
+        chains: List[List[bytes]] = []
+        stack: List[Tuple[RadixNode, List[bytes]]] = [(self.root, [])]
+        while stack:
+            node, path = stack.pop()
+            nxt = [c for c in node.children.values() if c.block is not None]
+            if not nxt and path:
+                chains.append(path)
+            for child in nxt:
+                stack.append((child, path + [child.key]))
+        return chains
+
 
 # ---------------------------------------------------------------------------
 # Exact (legacy) and off modes
@@ -882,6 +901,15 @@ class ExactPrefixStore:
     def host_blocks(self) -> int:
         return 0
 
+    def resident_chains(self) -> List[List[bytes]]:
+        """Flat map: no parent links, so chains cannot be reassembled —
+        each published key is emitted as its own depth-1 chain.  Because
+        ``match`` looks every cumulative key up independently, importing
+        these singletons on another replica reproduces the same hit
+        surface; only the radix store's shared-prefix structure is
+        lost (it never existed here)."""
+        return [[key] for key in self._prefix_index]
+
 
 class NullPrefixStore:
     """Mode ``off``: nothing matches, nothing is retained."""
@@ -927,6 +955,9 @@ class NullPrefixStore:
 
     def host_blocks(self) -> int:
         return 0
+
+    def resident_chains(self) -> List[List[bytes]]:
+        return []
 
 
 def make_prefix_store(mode: str, host_blocks: int = 0, on_event=None):

@@ -101,6 +101,26 @@ def main() -> None:
                          "latency/correctness into the per-replica "
                          "health sentinel (GET /debug/fleet).  "
                          "<= 0 disables the prober")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="elastic fleet for --replicas N: start the "
+                         "FleetController — scale-up under sustained "
+                         "interactive-attainment / queue-wait "
+                         "pressure, sentinel-gated scale-down with "
+                         "live session migration (no dropped "
+                         "sessions), every action a recorded "
+                         "decision (GET /debug/decisions?kind=scale)."
+                         "  New replicas reuse the seed replicas' "
+                         "geometry (fresh device slices while the "
+                         "host has them, time-sharing replica 0's "
+                         "mesh after)")
+    ap.add_argument("--autoscale-min", type=int, default=1,
+                    help="floor on fleet size under --autoscale")
+    ap.add_argument("--autoscale-max", type=int, default=8,
+                    help="ceiling on fleet size under --autoscale")
+    ap.add_argument("--autoscale-interval-s", type=float, default=5.0,
+                    help="control-loop period under --autoscale "
+                         "(<= 0: no background loop — operator "
+                         "drives ticks)")
     ap.add_argument("--replica-roles", default=None, metavar="R,R,...",
                     help="prefill/decode disaggregation for "
                          "--replicas N: a comma list of one role per "
@@ -385,6 +405,25 @@ def main() -> None:
             "--replicas > 1 needs the HTTP front-end (--http PORT): "
             "the ReplicaRouter speaks HTTP to its replicas"
         )
+    if args.autoscale:
+        if args.replicas < 2 or args.http is None:
+            raise SystemExit(
+                "--autoscale needs router mode (--replicas >= 2 with "
+                "--http PORT): the FleetController scales the "
+                "ReplicaRouter's fleet"
+            )
+        if args.replica_roles is not None:
+            raise SystemExit(
+                "--autoscale does not compose with --replica-roles: "
+                "role disaggregation pins fleet membership (at least "
+                "one replica of each role)"
+            )
+        if not (1 <= args.autoscale_min <= args.replicas
+                <= args.autoscale_max):
+            raise SystemExit(
+                "--autoscale needs 1 <= --autoscale-min <= --replicas "
+                "<= --autoscale-max"
+            )
     if args.replica_roles is not None:
         roles = tuple(
             r.strip() for r in args.replica_roles.split(",") if r.strip()
@@ -797,27 +836,32 @@ def _serve_router(params, config, tokenizer, mesh, args,
             config, mesh, args.slots, draft_config=draft_config
         )
     devs = jax.devices()
-    meshes, rep_params, rep_draft = [], [], []
     per = spec.n_devices if spec is not None else 0
-    for i in range(args.replicas):
+    _geom_cache = {}
+
+    def _geometry(i):
+        """Replica ``i``'s (mesh, params, draft_params): a fresh
+        device slice while the host still has one for index i,
+        replica 0's mesh (time-shared) after — the same rule for seed
+        replicas and autoscale-grown ones."""
+        if i in _geom_cache:
+            return _geom_cache[i]
         if spec is not None and len(devs) >= (i + 1) * per:
             m = build_serve_mesh(spec, devices=devs[i * per:(i + 1) * per])
-            meshes.append(m)
-            rep_params.append(
-                params if i == 0 else shard_params(params, m, config)
-            )
+            p = params if i == 0 else shard_params(params, m, config)
             # The draft rides the same per-replica device slice — a
             # draft committed to replica 0's devices would either fail
             # jit's device check or pay a cross-device transfer every
             # speculative dispatch on the other replicas.
-            rep_draft.append(
+            d = (
                 draft_params if draft_params is None or i == 0
                 else shard_params(draft_params, m, draft_config)
             )
         else:
-            meshes.append(mesh)
-            rep_params.append(params)
-            rep_draft.append(draft_params)
+            m, p, d = mesh, params, draft_params
+        _geom_cache[i] = (m, p, d)
+        return m, p, d
+
     if spec is not None and len(devs) < args.replicas * per:
         logger.log(
             "serve_mesh_shared",
@@ -825,51 +869,60 @@ def _serve_router(params, config, tokenizer, mesh, args,
             f"({args.replicas} x {per}); replicas time-share one mesh",
         )
 
+    def make_replica(i):
+        """Build + start replica ``i`` (batcher + server).  Doubles as
+        the FleetController's ``replica_factory`` under --autoscale:
+        a scale-up gets the next index's geometry and a distinct
+        sampling seed, everything else identical to the seed fleet."""
+        m, p, d = _geometry(i)
+        obs = Observability(
+            slo_ttft_ms=getattr(args, "slo_ttft_ms", 0.0) or None,
+            slo_itl_ms=getattr(args, "slo_itl_ms", 0.0) or None,
+            peak_flops=getattr(args, "peak_tflops", 197.0) * 1e12,
+            peak_bytes_per_s=(
+                getattr(args, "peak_hbm_gbps", 819.0) * 1e9
+            ),
+        )
+        cb = ContinuousBatcher(
+            p, config, n_slots=args.slots,
+            max_len=config.max_seq_len, stop_tokens=stops,
+            temperature=args.temperature, top_p=args.top_p,
+            seed=args.seed + i, mesh=m,
+            logprobs=getattr(args, "logprobs", False),
+            prefix_cache=not getattr(args, "no_prefix_cache", False),
+            fault_injector=injector,
+            decode_chunk=getattr(args, "decode_chunk", 8),
+            draft_params=d, draft_config=draft_config,
+            n_draft=getattr(args, "n_draft", 4),
+            spec_rounds=getattr(args, "spec_rounds", 8),
+            prefill_budget=getattr(args, "prefill_budget", 512),
+            prefix_index=getattr(args, "prefix_index", "radix"),
+            host_kv_blocks=getattr(args, "host_kv_blocks", 0),
+            obs=obs,
+            cost_models=not getattr(args, "no_cost_models", False),
+        )
+        srv = LLMServer(
+            cb, tokenizer=tokenizer, host=args.host, port=0,
+            replica_id=i,
+            max_recoveries=getattr(args, "max_recoveries", 3),
+            recovery_window_s=getattr(args, "recovery_window_s", 60.0),
+            watchdog_deadline_s=(
+                getattr(args, "watchdog_s", 60.0) or None
+            ),
+            drain_timeout_s=getattr(args, "drain_timeout_s", 30.0),
+            logger=logger,
+            max_queue=getattr(args, "max_queue", 256),
+            priority_classes=(
+                getattr(args, "priority_classes", "on") == "on"
+            ),
+        )
+        return srv.start()
+
     servers = []
+    controller = None
     try:
         for i in range(args.replicas):
-            obs = Observability(
-                slo_ttft_ms=getattr(args, "slo_ttft_ms", 0.0) or None,
-                slo_itl_ms=getattr(args, "slo_itl_ms", 0.0) or None,
-                peak_flops=getattr(args, "peak_tflops", 197.0) * 1e12,
-                peak_bytes_per_s=(
-                    getattr(args, "peak_hbm_gbps", 819.0) * 1e9
-                ),
-            )
-            cb = ContinuousBatcher(
-                rep_params[i], config, n_slots=args.slots,
-                max_len=config.max_seq_len, stop_tokens=stops,
-                temperature=args.temperature, top_p=args.top_p,
-                seed=args.seed + i, mesh=meshes[i],
-                logprobs=getattr(args, "logprobs", False),
-                prefix_cache=not getattr(args, "no_prefix_cache", False),
-                fault_injector=injector,
-                decode_chunk=getattr(args, "decode_chunk", 8),
-                draft_params=rep_draft[i], draft_config=draft_config,
-                n_draft=getattr(args, "n_draft", 4),
-                spec_rounds=getattr(args, "spec_rounds", 8),
-                prefill_budget=getattr(args, "prefill_budget", 512),
-                prefix_index=getattr(args, "prefix_index", "radix"),
-                host_kv_blocks=getattr(args, "host_kv_blocks", 0),
-                obs=obs,
-                cost_models=not getattr(args, "no_cost_models", False),
-            )
-            srv = LLMServer(
-                cb, tokenizer=tokenizer, host=args.host, port=0,
-                replica_id=i,
-                max_recoveries=getattr(args, "max_recoveries", 3),
-                recovery_window_s=getattr(args, "recovery_window_s", 60.0),
-                watchdog_deadline_s=(
-                    getattr(args, "watchdog_s", 60.0) or None
-                ),
-                drain_timeout_s=getattr(args, "drain_timeout_s", 30.0),
-                logger=logger,
-                max_queue=getattr(args, "max_queue", 256),
-                priority_classes=(
-                    getattr(args, "priority_classes", "on") == "on"
-                ),
-            )
-            servers.append(srv.start())
+            servers.append(make_replica(i))
         # Cache-aware routing needs the router to speak the replicas'
         # chain-key schema: the tokenizer + chat format mirror each
         # replica's own /generate- and /chat-encoding, block_size is
@@ -886,13 +939,33 @@ def _serve_router(params, config, tokenizer, mesh, args,
             roles=getattr(args, "replica_roles", None),
             canary_interval_s=getattr(args, "canary_interval_s", 10.0),
         ).start()
+        if getattr(args, "autoscale", False):
+            from .router import FleetController
+
+            controller = FleetController(
+                router,
+                replica_factory=make_replica,
+                min_replicas=getattr(args, "autoscale_min", 1),
+                max_replicas=getattr(args, "autoscale_max", 8),
+                interval_s=getattr(args, "autoscale_interval_s", 5.0),
+                drain_timeout_s=getattr(args, "drain_timeout_s", 30.0),
+            )
+            logger.log(
+                "autoscale_armed",
+                min=getattr(args, "autoscale_min", 1),
+                max=getattr(args, "autoscale_max", 8),
+                interval_s=getattr(args, "autoscale_interval_s", 5.0),
+            )
         try:
             logger.log(
                 "serving_replicas", address=router.address,
                 replicas=args.replicas,
                 policy=getattr(args, "route", "least-loaded"),
-                meshes=[str(dict(m.shape)) if m is not None else None
-                        for m in meshes],
+                meshes=[
+                    str(dict(_geometry(i)[0].shape))
+                    if _geometry(i)[0] is not None else None
+                    for i in range(args.replicas)
+                ],
             )
             if _test_hook is not None:
                 _test_hook(router, servers)
@@ -931,6 +1004,8 @@ def _serve_router(params, config, tokenizer, mesh, args,
                     except (ValueError, TypeError):
                         pass
         finally:
+            if controller is not None:
+                controller.close(stop_owned=True)
             router.stop()
     finally:
         for srv in servers:

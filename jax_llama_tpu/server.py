@@ -1360,6 +1360,43 @@ class LLMServer:
     def draining(self) -> bool:
         return self._draining.is_set()
 
+    def wait_idle(
+        self, timeout_s: float = 30.0, poll_s: float = 0.05,
+    ) -> bool:
+        """Fleet-controller drain hook: block until the serving loop is
+        idle (no admitted work) WITHOUT tearing it down — unlike
+        ``begin_drain``, the loop stays alive afterwards so control
+        calls (the session-migration ``export_prefix`` path) still run.
+        The controller stops routing to this replica first, then waits
+        here for stragglers to finish; returns False on timeout (the
+        drain aborts and the replica resumes).  Each probe runs on the
+        loop thread between steps, so a True result is an exact
+        no-admitted-work snapshot, not a racy guess."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while True:
+            try:
+                if self.call_on_loop(
+                    lambda b: not b.pending(), timeout_s=timeout_s,
+                ):
+                    return True
+            except TimeoutError:
+                return False
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    def shutdown_for_restart(self, grace_s: float = 5.0) -> bool:
+        """Rollout restart hook: bounded drain + full stop in one call.
+        The controller swaps a freshly built replacement into the
+        router FIRST (sessions already migrated off), then retires this
+        instance — any straggler past ``grace_s`` fails with 503 rather
+        than wedging the rung.  Returns True when the loop exited
+        within the grace window."""
+        self.begin_drain(timeout_s=grace_s)
+        ok = self.wait_drained(grace_s + 10.0)
+        self.stop()
+        return ok
+
     def _retry_after_s(self) -> int:
         """Retry-After value for drain-mode 503s: the remaining drain
         budget, rounded up — after that a replacement instance should be

@@ -3616,6 +3616,18 @@ class ContinuousBatcher:
 
     # -- prefill/decode disaggregation handoff ------------------------------
 
+    def resident_chain_keys(self) -> List[List[bytes]]:
+        """Every maximal HBM-resident cached chain, as ordered key
+        lists in the shared ``chain_keys`` schema — the drain
+        enumeration surface: a scale-down controller asks the victim
+        (via ``call_on_loop``) what it holds, then ``export_prefix``-es
+        each returned chain to a survivor.  Pure host bookkeeping
+        (store tree walk, no device ops), but thread-confined like
+        everything on the batcher."""
+        if not self.prefix_cache_enabled:
+            return []
+        return self._store.resident_chains()
+
     def export_prefix(
         self, tokens: Optional[Sequence[int]] = None,
         request_id: Optional[str] = None,

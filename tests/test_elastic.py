@@ -1,0 +1,510 @@
+"""Elastic fleet (router.FleetController): the autoscaler's
+hysteresis decision machine, drain-by-migration scale-down (zero
+dropped sessions, token-identical to the 1-replica oracle),
+zero-downtime rollouts with the per-rung canary gate + auto-rollback,
+and the no-capacity regression pin on ``migrate_chain``'s
+residency-gated demote.
+
+The invariants pinned here:
+  * no scale action without a recorded, signal-carrying decision
+    (``/debug/decisions?kind=scale`` explains every one of them);
+  * scale-down never kills a replica the health sentinel can't
+    explain (non-healthy verdict -> deferred, not destroyed);
+  * a drain migrates EVERY live session's chain to a survivor and
+    re-pins its routing record — revisits stream token-identically
+    from the new home;
+  * a rollout rung whose canary gate fails auto-rolls back and the
+    fleet keeps serving (old weights) with nobody dropped;
+  * a no-capacity import leaves the source's HBM chain fully intact
+    (the demote is gated on destination residency, not on export).
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from jax_llama_tpu import get_config, init_params
+from jax_llama_tpu.obs import DecisionLog
+from jax_llama_tpu.router import FleetController, _Replica
+from jax_llama_tpu.server import LLMServer
+from jax_llama_tpu.serving import ContinuousBatcher
+from jax_llama_tpu.tokenizers.bytes import ByteTokenizer
+
+from test_cache_routing import (  # shared tiny-model geometry + fleet
+    CFG, OTHER, REVISIT, SESSION, _mk_batcher, _mk_fleet, _post,
+    _serve_direct,
+)
+
+pytestmark = pytest.mark.mesh_serving
+
+OTHER_REVISIT = OTHER + "ere"  # stays inside the max_len=64 geometry
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = get_config("tiny", **CFG)
+    params = init_params(jax.random.PRNGKey(0), config)
+    return params, config
+
+
+# ---------------------------------------------------------------------------
+# Host-only units: the hysteresis decision machine + sentinel gate
+# ---------------------------------------------------------------------------
+
+class _StubSentinel:
+    def __init__(self):
+        self.verdicts = {}
+
+    def verdict(self, i):
+        return self.verdicts.get(i, "healthy")
+
+
+class _StubRouter:
+    """Just enough router surface for the controller's decision units
+    (no HTTP, no servers): a replica table with settable health
+    scrapes, a real DecisionLog, a settable sentinel."""
+
+    def __init__(self, n=2, n_slots=2):
+        self._lock = threading.Lock()
+        self._replicas = []
+        for i in range(n):
+            rep = _Replica(index=i, host="127.0.0.1", port=0)
+            rep.last_health = {
+                "replica": {"n_slots": n_slots}, "overload": {},
+            }
+            self._replicas.append(rep)
+        self.sentinel = _StubSentinel()
+        self.decisions = DecisionLog()
+        self.fault_injector = None
+        self.health_interval_s = 0.0
+        self.handoff_timeout_s = 5.0
+        self.controller = None
+
+    def attach_controller(self, controller):
+        self.controller = controller
+
+    def _occupancy_locked(self, rep):
+        slots = int((rep.last_health.get("replica") or {})
+                    .get("n_slots") or 0)
+        if slots <= 0:
+            return float(rep.inflight)
+        return rep.inflight / slots
+
+    def set_overload(self, i, **kw):
+        self._replicas[i].last_health["overload"].update(kw)
+
+
+def _scale_decisions(router, **match):
+    return [
+        ev for ev in router.decisions.json(n=64, kind="scale")["decisions"]
+        if all(ev.get(k) == v for k, v in match.items())
+    ]
+
+
+def test_autoscaler_steady_holds_without_decisions():
+    r = _StubRouter()
+    for i in range(2):
+        r.set_overload(i, interactive_attainment=1.0,
+                       queue_wait_ms_p90=1.0)
+        r._replicas[i].inflight = 1  # occupancy 0.5: not calm
+    ctrl = FleetController(r, min_replicas=1, max_replicas=4)
+    out = ctrl.tick()
+    assert out["action"] == "hold" and out["reason"] == "steady"
+    assert r.decisions.json(kind="scale")["decisions"] == []
+    sig = out["signals"]
+    assert sig["replicas_active"] == 2
+    assert sig["attainment_min"] == 1.0
+    assert sig["occupancy_max"] == 0.5
+
+
+def test_autoscaler_pressure_dwell_then_deferral_is_recorded():
+    """Attainment pressure must SUSTAIN for dwell_s before acting;
+    once it would act, a missing replica_factory is a recorded
+    deferral — the decision log explains the non-action."""
+    r = _StubRouter()
+    r.set_overload(0, interactive_attainment=0.5)
+    r.set_overload(1, interactive_attainment=1.0)
+    ctrl = FleetController(r, min_replicas=1, max_replicas=4,
+                           dwell_s=60.0)
+    assert ctrl.tick()["reason"] == "dwell"
+    ctrl2 = FleetController(r, min_replicas=1, max_replicas=4,
+                            dwell_s=0.0)
+    out = ctrl2.tick()
+    assert out["reason"] == "no-replica-factory"
+    evs = _scale_decisions(r, action="deferred",
+                           reason="no-replica-factory")
+    assert evs and evs[-1]["signals"]["attainment_min"] == 0.5
+    assert ctrl2.metrics_snapshot()["scale_events"]["deferred"] == 1
+
+
+def test_autoscaler_queue_wait_pressure_and_max_gate():
+    r = _StubRouter()
+    r.set_overload(0, queue_wait_ms_p90=900.0)
+    ctrl = FleetController(
+        r, replica_factory=lambda i: "127.0.0.1:1",
+        min_replicas=1, max_replicas=2, queue_wait_high_ms=500.0,
+    )
+    out = ctrl.tick()
+    assert out["reason"] == "at-max-replicas"
+    assert _scale_decisions(r, action="deferred",
+                            reason="at-max-replicas")
+
+
+def test_autoscaler_calm_scaledown_min_gate_and_cooldown():
+    r = _StubRouter(n=2)
+    for i in range(2):
+        r.set_overload(i, interactive_attainment=1.0,
+                       queue_wait_ms_p90=0.0)
+        # inflight 0: occupancy 0.0 <= occupancy_low -> calm
+    ctrl = FleetController(r, min_replicas=2, max_replicas=4)
+    out = ctrl.tick()
+    assert out["reason"] == "at-min-replicas"
+    assert _scale_decisions(r, action="deferred",
+                            reason="at-min-replicas")
+    # Below min gate it would act — but cooldown blocks right after
+    # an action.
+    ctrl2 = FleetController(r, min_replicas=1, max_replicas=4,
+                            cooldown_s=60.0)
+    with ctrl2._lock:
+        ctrl2._last_action_t = time.monotonic()
+    assert ctrl2.tick()["reason"] == "cooldown"
+
+
+def test_scale_down_sentinel_gate_defers_with_verdicts():
+    """The PR-15 gate: every candidate victim's verdict is non-healthy
+    -> the scale-down is DEFERRED (never destroy what the sentinel
+    can't explain), with the verdicts in the decision record."""
+    r = _StubRouter(n=2)
+    r.sentinel.verdicts = {0: "suspect", 1: "critical"}
+    ctrl = FleetController(r, min_replicas=1, max_replicas=4)
+    out = ctrl.scale_down()
+    assert out["ok"] is False
+    assert out["reason"] == "sentinel-cannot-explain"
+    evs = _scale_decisions(r, action="deferred",
+                           reason="sentinel-cannot-explain")
+    assert evs and evs[-1]["sentinel"][0] == "suspect"
+    assert ctrl.metrics_snapshot()["scale_events"]["deferred"] == 1
+    # An explicitly named victim is gated exactly the same way.
+    out = ctrl.scale_down(victim=1)
+    assert out["reason"] == "sentinel-cannot-explain"
+
+
+def test_state_json_and_metrics_snapshot_shape():
+    r = _StubRouter()
+    ctrl = FleetController(r, min_replicas=1, max_replicas=4,
+                           dwell_s=1.5, cooldown_s=9.0)
+    st = ctrl.state_json()
+    assert st["min_replicas"] == 1 and st["max_replicas"] == 4
+    assert st["dwell_s"] == 1.5 and st["cooldown_s"] == 9.0
+    assert st["rollout_rung"] == -1 and st["busy"] is False
+    assert st["last_signals"] is None
+    ctrl.tick()
+    assert ctrl.state_json()["last_signals"]["action"] == "hold"
+    ms = ctrl.metrics_snapshot()
+    assert set(ms) == {"scale_events", "sessions_migrated",
+                       "rollout_rung"}
+    assert set(ms["scale_events"]) == {"up", "down", "deferred",
+                                       "aborted"}
+
+
+# ---------------------------------------------------------------------------
+# Live-fleet acceptance drills
+# ---------------------------------------------------------------------------
+
+def _factory(model, tok, made):
+    """A replica_factory that builds real started servers with the
+    shared tiny geometry and remembers them for teardown."""
+    def make(i):
+        cb = _mk_batcher(model, tok)
+        srv = LLMServer(cb, tokenizer=tok, replica_id=i).start()
+        made.append(srv)
+        return srv
+    return make
+
+
+def test_scale_down_drain_migrates_sessions_token_identical(model):
+    """ACCEPTANCE PIN: scale-down drains the victim by migrating every
+    live session's chain to the survivor — zero dropped sessions, and
+    every revisit streams token-identically to the 1-replica oracle
+    from the NEW home.  The decision log + /metrics + /debug/fleet
+    fully explain the action."""
+    tok = ByteTokenizer()
+    oracle = _mk_batcher(model, tok)
+    _serve_direct(oracle, tok, [SESSION])
+    _serve_direct(oracle, tok, [OTHER])
+    want_rev = _serve_direct(oracle, tok, [REVISIT])[0]
+    want_oth = _serve_direct(oracle, tok, [OTHER_REVISIT])[0]
+
+    router, servers = _mk_fleet(model, tok, n=2)
+    ctrl = FleetController(router, min_replicas=1, max_replicas=2,
+                           drain_timeout_s=15.0)
+    try:
+        # Two live sessions, one per replica (least-loaded balance).
+        st, _, h1 = _post(router.address,
+                          {"text": SESSION, "max_new_tokens": 6})
+        st, _, h2 = _post(router.address,
+                          {"text": OTHER, "max_new_tokens": 6})
+        homes = {int(h1["X-Replica-Id"]), int(h2["X-Replica-Id"])}
+        assert homes == {0, 1}
+        router.check_health_now()
+        out = ctrl.scale_down(victim=0)
+        assert out["ok"] is True and out["replica"] == 0
+        drain = out["drain"]
+        assert drain["migrated"] >= 1 and drain["ok"] is True
+        # Victim permanently out; fleet size gauge reflects it.
+        snaps = router.health()["replicas"]
+        assert snaps[0]["retired"] is True
+        assert snaps[1]["retired"] is False
+        # Both sessions keep serving, token-identical, from the
+        # survivor — including the one whose chain just migrated.
+        st, body, hdrs = _post(router.address,
+                               {"text": REVISIT, "max_new_tokens": 6})
+        assert st == 200 and body["tokens"] == want_rev
+        assert int(hdrs["X-Replica-Id"]) == 1
+        st, body, hdrs = _post(
+            router.address,
+            {"text": OTHER_REVISIT, "max_new_tokens": 6},
+        )
+        assert st == 200 and body["tokens"] == want_oth
+        assert int(hdrs["X-Replica-Id"]) == 1
+        # The survivor really holds the migrated chain (warm revisit,
+        # not a cold re-prefill).
+        dst_chains = servers[1].call_on_loop(
+            lambda b: b.resident_chain_keys()
+        )
+        assert any(len(c) >= 2 for c in dst_chains)
+        # Observability: decision records, controller state, metrics.
+        assert _scale_decisions(router, action="down", replica=0)
+        drains = router.decisions.json(n=16, kind="drain")["decisions"]
+        assert drains and drains[-1]["migrated"] == drain["migrated"]
+        fleet = router.fleet_health_json()
+        assert fleet["controller"]["drains_total"] == 1
+        assert fleet["controller"]["sessions_migrated_total"] >= 1
+        m = router.metrics_text()
+        assert 'llm_fleet_scale_events_total{action="down"} 1' in m
+        assert "llm_sessions_migrated_total" in m
+        assert "llm_rollout_rung -1" in m
+        assert "llm_router_replicas 1" in m
+    finally:
+        ctrl.close(stop_owned=True)
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_tick_driven_scale_up_adds_routable_replica(model):
+    """Sustained attainment pressure through tick() grows the fleet:
+    the new replica is built by the factory, health-scraped, and
+    starts taking traffic; the decision record carries the signals."""
+    tok = ByteTokenizer()
+    router, servers = _mk_fleet(model, tok, n=2,
+                                policy="least-loaded")
+    made = []
+    ctrl = FleetController(
+        router, replica_factory=_factory(model, tok, made),
+        min_replicas=1, max_replicas=3,
+    )
+    try:
+        router.check_health_now()
+        with router._lock:
+            for rep in router._replicas:
+                rep.last_health.setdefault("overload", {})[
+                    "interactive_attainment"] = 0.1
+        out = ctrl.tick()
+        assert out["ok"] is True and out["action"] == "up"
+        assert out["replica"] == 2 and len(made) == 1
+        snaps = router.health()["replicas"]
+        assert len(snaps) == 3 and snaps[2]["healthy"] is True
+        st, body, _ = _post(router.address,
+                            {"text": SESSION, "max_new_tokens": 4})
+        assert st == 200 and body["tokens"]
+        evs = _scale_decisions(router, action="up", replica=2)
+        assert evs and evs[-1]["signals"]["attainment_min"] == 0.1
+        m = router.metrics_text()
+        assert 'llm_fleet_scale_events_total{action="up"} 1' in m
+        assert "llm_router_replicas 3" in m
+    finally:
+        ctrl.close(stop_owned=True)
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_rollout_same_weights_all_rungs_pass(model):
+    """Zero-downtime rollout happy path: every rung drains, swaps to
+    the new instance, and passes the canary gate (same weights ->
+    rung 0 pins the rollout oracle, rung 1 matches it); the final
+    fleet-wide sweep is unanimously clean and the fleet keeps
+    serving token-identically."""
+    tok = ByteTokenizer()
+    oracle = _mk_batcher(model, tok)
+    want = _serve_direct(oracle, tok, [SESSION])[0]
+
+    router, servers = _mk_fleet(model, tok, n=2)
+    made = []
+    ctrl = FleetController(router, drain_timeout_s=15.0)
+    try:
+        out = ctrl.rollout(_factory(model, tok, made))
+        assert out["ok"] is True, out
+        assert out["planned"] == 2
+        assert [r["ok"] for r in out["rungs"]] == [True, True]
+        assert len(made) == 2
+        # Every slot now runs a NEW instance; none retired.
+        snaps = router.health()["replicas"]
+        assert len(snaps) == 2
+        assert all(not s["retired"] for s in snaps)
+        st, body, _ = _post(router.address,
+                            {"text": SESSION, "max_new_tokens": 6})
+        assert st == 200 and body["tokens"] == want
+        rungs = router.decisions.json(n=16, kind="rollout_rung")["decisions"]
+        assert [ev["ok"] for ev in rungs] == [True, True]
+        assert rungs[0]["gate"] == "oracle-pinned"
+        assert rungs[1]["gate"] == "oracle-match"
+        top = router.decisions.json(n=4, kind="rollout")["decisions"]
+        assert top and top[-1]["ok"] is True
+        assert ctrl.state_json()["rollouts_total"] == 1
+        assert ctrl.state_json()["rollbacks_total"] == 0
+        assert "llm_rollout_rung -1" in router.metrics_text()
+    finally:
+        ctrl.close(stop_owned=True)
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_rollout_bad_rung_fails_canary_gate_and_rolls_back(model):
+    """A rung whose new instance emits WRONG tokens fails the canary
+    gate (rollout-oracle mismatch — caught even though the fleet
+    majority still runs old weights) and auto-rolls back through
+    rollback_factory: the fleet ends full-size, serving, with the
+    rollback recorded."""
+    params, config = model
+    bad_params = init_params(jax.random.PRNGKey(9), config)
+    tok = ByteTokenizer()
+    router, servers = _mk_fleet(model, tok, n=2)
+    made = []
+
+    def factory(i):
+        p = params if i == 0 else bad_params
+        cb = ContinuousBatcher(
+            p, config, n_slots=2, max_len=64,
+            stop_tokens=tuple(tok.stop_tokens),
+        )
+        srv = LLMServer(cb, tokenizer=tok, replica_id=i).start()
+        made.append(srv)
+        return srv
+
+    ctrl = FleetController(router, drain_timeout_s=15.0)
+    try:
+        out = ctrl.rollout(factory, rollback_factory=_factory(
+            model, tok, made))
+        assert out["ok"] is False
+        assert "canary-gate" in out["reason"]
+        assert out["rungs"][0]["ok"] is True
+        assert out["rungs"][1]["ok"] is False
+        assert out["rungs"][1]["rollback"] == "rolled-back"
+        assert ctrl.state_json()["rollbacks_total"] == 1
+        # Fleet is whole and serving (rung 0 new weights == same
+        # params; rung 1 rolled back to same params).
+        snaps = router.health()["replicas"]
+        assert len(snaps) == 2
+        assert all(not s["retired"] for s in snaps)
+        st, body, _ = _post(router.address,
+                            {"text": SESSION, "max_new_tokens": 4})
+        assert st == 200 and body["tokens"]
+        rungs = router.decisions.json(n=16, kind="rollout_rung")["decisions"]
+        assert rungs[-1]["ok"] is False
+        assert "oracle-mismatch" in rungs[-1]["reason"]
+        assert "llm_rollout_rung -1" in router.metrics_text()
+    finally:
+        ctrl.close(stop_owned=True)
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_migrate_chain_no_capacity_leaves_source_intact(model):
+    """REGRESSION PIN: the residency-gated demote.  A destination
+    with zero pool capacity lands nothing on import — the scheduler
+    records the benign empty outcome and the SOURCE keeps its full
+    HBM chain (an export must never cost the fleet its only copy),
+    so the session keeps serving warm from the source."""
+    tok = ByteTokenizer()
+    oracle = _mk_batcher(model, tok)
+    _serve_direct(oracle, tok, [SESSION])
+    want = _serve_direct(oracle, tok, [REVISIT])[0]
+
+    router, servers = _mk_fleet(model, tok, n=2)
+    try:
+        st, _, hdrs = _post(router.address,
+                            {"text": SESSION, "max_new_tokens": 6})
+        src = int(hdrs["X-Replica-Id"])
+        dst = 1 - src
+        router.check_health_now()
+        chains = servers[src].call_on_loop(
+            lambda b: b.resident_chain_keys()
+        )
+        chain = max(chains, key=len)
+        assert len(chain) >= 2
+        # Choke the destination pool to zero capacity for the import.
+        servers[dst].call_on_loop(
+            lambda b: setattr(b, "_capacity", lambda: 0)
+        )
+        empties_before = router.handoffs_empty_total
+        router.migrate_chain([k.hex() for k in chain], src, dst)
+        assert router.wait_handoffs(timeout_s=10.0)
+        assert router.handoffs_empty_total == empties_before + 1
+        evs = router.decisions.json(n=16, kind="handoff_empty")["decisions"]
+        assert evs and evs[-1]["reason"] == (
+            "already-resident-or-no-capacity"
+        )
+        # Source HBM chain fully intact: residency-gated demote never
+        # fired (destination holds nothing).
+        depth = servers[src].call_on_loop(
+            lambda b: len(b._match_prefix(list(chain)).blocks)
+        )
+        assert depth == len(chain)
+        assert not servers[dst].call_on_loop(
+            lambda b: b.resident_chain_keys()
+        )
+        servers[dst].call_on_loop(
+            lambda b: delattr(b, "_capacity")
+        )
+        # The session still serves warm + token-identical from the
+        # source.
+        st, body, hdrs = _post(router.address,
+                               {"text": REVISIT, "max_new_tokens": 6})
+        assert st == 200 and body["tokens"] == want
+        assert int(hdrs["X-Replica-Id"]) == src
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_drain_replica_operator_entry_no_survivor(model):
+    """drain_replica on a 1-replica fleet fails with "no-survivor"
+    when there are live chains to move — and the replica RESUMES
+    admission (nothing stranded half-drained)."""
+    tok = ByteTokenizer()
+    router, servers = _mk_fleet(model, tok, n=1)
+    ctrl = FleetController(router, drain_timeout_s=10.0)
+    try:
+        st, _, _ = _post(router.address,
+                         {"text": SESSION, "max_new_tokens": 6})
+        assert st == 200
+        out = ctrl.drain_replica(0)
+        assert out["ok"] is False and out["reason"] == "no-survivor"
+        snap = router.health()["replicas"][0]
+        assert snap["retiring"] is False and snap["retired"] is False
+        st, body, _ = _post(router.address,
+                            {"text": REVISIT, "max_new_tokens": 4})
+        assert st == 200 and body["tokens"]
+        assert ctrl.state_json()["drains_failed_total"] == 1
+    finally:
+        ctrl.close()
+        router.stop()
+        for s in servers:
+            s.stop()

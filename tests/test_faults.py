@@ -862,3 +862,150 @@ def test_router_inflight_crash_replays_via_replica_recovery(
         router.stop()
         crashy.stop()
         steady.stop()
+
+
+# ---------------------------------------------------------------------------
+# Elastic-fleet chaos drills: session_migrate / scale_event
+# ---------------------------------------------------------------------------
+
+# 38 tokens -> 2 full chain-key blocks at block_size=16: long enough
+# for the drain to have a real session chain to migrate.
+LONG_PROMPT = list(range(2, 40))
+
+
+@pytest.fixture(scope="module")
+def long_reference(model):
+    """Fault-free greedy tokens for LONG_PROMPT (the identity oracle
+    for the migration drills)."""
+    params, config = model
+    cb = ContinuousBatcher(params, config, n_slots=2, max_len=64)
+    rid = cb.submit(list(LONG_PROMPT), max_new_tokens=MAX_NEW)
+    return cb.run_to_completion()[rid]
+
+
+def _mk_pair(model):
+    params, config = model
+    return [
+        LLMServer(
+            ContinuousBatcher(params, config, n_slots=2, max_len=64),
+            replica_id=i,
+        ).start()
+        for i in range(2)
+    ]
+
+
+@pytest.mark.chaos
+def test_session_migrate_fault_aborts_move_source_intact(
+    model, long_reference
+):
+    """Chaos drill (fault site ``session_migrate``): a fault injected
+    at the start of a session's drain migration aborts THAT move only
+    — the drain fails, the source RESUMES admission with its chain
+    untouched, and the session keeps serving token-identically from
+    the source.  The retried drain (one-shot spec consumed) migrates
+    for real; after retirement exactly ONE replica serves the session
+    — never both."""
+    from jax_llama_tpu.router import FleetController, ReplicaRouter
+
+    servers = _mk_pair(model)
+    inj = FaultInjector("session_migrate@0:error")
+    # Affinity keeps the session pinned to its source replica, so the
+    # post-abort replay exercises the SOURCE (not whichever replica
+    # the least-loaded tie-break lands on) and the retried drain has
+    # a real chain to migrate.
+    router = ReplicaRouter(
+        servers, policy="affinity", health_interval_s=0,
+    ).start()
+    ctrl = FleetController(router, fault_injector=inj,
+                           drain_timeout_s=10.0)
+    try:
+        # Idle tie-break pins the session to replica 0 — the victim.
+        st, body = _post(
+            router.address,
+            {"prompt": LONG_PROMPT, "max_new_tokens": MAX_NEW},
+        )
+        assert st == 200 and body["tokens"] == long_reference
+        router.check_health_now()
+        out = ctrl.scale_down(victim=0)
+        assert out["ok"] is False
+        assert "migration-failures" in out["reason"]
+        assert inj.injected["session_migrate"] == 1
+        snap = router.health()["replicas"][0]
+        assert snap["retired"] is False and snap["retiring"] is False
+        # The source's chain is untouched (export never demotes
+        # before destination residency is proven)...
+        chains = servers[0].call_on_loop(
+            lambda b: b.resident_chain_keys()
+        )
+        assert chains and max(len(c) for c in chains) >= 2
+        # ...and the session keeps serving token-identically from it.
+        st, body = _post(
+            router.address,
+            {"prompt": LONG_PROMPT, "max_new_tokens": MAX_NEW},
+        )
+        assert st == 200 and body["tokens"] == long_reference
+        # Retry: the one-shot spec is consumed -> the drain completes
+        # and the victim retires.
+        out = ctrl.scale_down(victim=0)
+        assert out["ok"] is True
+        assert out["drain"]["migrated"] >= 1
+        assert router.health()["replicas"][0]["retired"] is True
+        # Exactly ONE replica serves the session now — never both:
+        # the survivor holds the migrated chain and answers
+        # token-identically.
+        dst_chains = servers[1].call_on_loop(
+            lambda b: b.resident_chain_keys()
+        )
+        assert any(len(c) >= 2 for c in dst_chains)
+        st, body = _post(
+            router.address,
+            {"prompt": LONG_PROMPT, "max_new_tokens": MAX_NEW},
+        )
+        assert st == 200 and body["tokens"] == long_reference
+    finally:
+        ctrl.close()
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.chaos
+def test_scale_event_fault_aborts_scale_action_cleanly(model):
+    """Chaos drill (fault site ``scale_event``): a fault at the start
+    of a scale action aborts the WHOLE action cleanly — fleet
+    membership unchanged, the abort is a recorded decision — and the
+    retried action proceeds."""
+    from jax_llama_tpu.router import FleetController, ReplicaRouter
+
+    servers = _mk_pair(model)
+    inj = FaultInjector("scale_event@0:error")
+    router = ReplicaRouter(
+        servers, policy="least-loaded", health_interval_s=0,
+    ).start()
+    ctrl = FleetController(router, fault_injector=inj)
+    try:
+        router.check_health_now()
+        out = ctrl.scale_down(victim=0)
+        assert out["ok"] is False
+        assert inj.injected["scale_event"] == 1
+        snaps = router.health()["replicas"]
+        assert len(snaps) == 2
+        assert all(
+            not s["retired"] and not s["retiring"] for s in snaps
+        )
+        assert ctrl.metrics_snapshot()["scale_events"]["aborted"] == 1
+        evs = [
+            e for e in router.decisions.json(
+                n=16, kind="scale")["decisions"]
+            if e.get("action") == "aborted"
+        ]
+        assert evs and evs[-1]["op"] == "down"
+        # The one-shot spec is consumed: the retry proceeds cleanly.
+        out = ctrl.scale_down(victim=0)
+        assert out["ok"] is True
+        assert router.health()["replicas"][0]["retired"] is True
+    finally:
+        ctrl.close()
+        router.stop()
+        for s in servers:
+            s.stop()
