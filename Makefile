@@ -4,7 +4,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: tier1 tier1-budget faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve fleet elastic bench-compare check
+.PHONY: tier1 tier1-budget faults chaos tpu perf-smoke kvcache obs overload lint lint-invariants mesh-serve fleet elastic bench-compare check kernels
 
 # The gating suite: everything not marked slow, under the 870 s budget.
 tier1:
@@ -165,3 +165,18 @@ lint: lint-invariants
 # On-chip kernel regressions (run on a TPU host; self-skip elsewhere).
 tpu:
 	python -m pytest tests/ -q -m tpu
+
+# Kernel-selection layer (ops/kernels.py): the CPU-runnable parity
+# suite (splash-mha prefill + stock paged-attention decode in Pallas
+# interpret mode, op-level AND through the serving paths), the
+# serving A/B drills (kernel vs fallback token behavior) and the
+# quarantine drills proving splash->flash and stock-paged->paged
+# fallbacks keep serving token-identically.  Runs the file UNFILTERED
+# so the slow-marked serving matrices (r17 budget rebalance) are
+# included; TPU cells self-skip off-TPU and run under `make tpu`.
+# The throughput side of the A/B — prefill_kernel_sweep (flash vs
+# splash TFLOPs at 8k/16k/32k) and decode_kernel_ab (custom vs stock
+# vs gathered tok/s) — lands in the BENCH_* record via
+# `python bench.py` on a TPU host.
+kernels:
+	$(PYTEST) tests/test_kernels.py -q -m 'not tpu'

@@ -19,6 +19,7 @@ serving); the int8 weight-only serving path is reported in `detail`.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -1038,14 +1039,16 @@ def main() -> None:
     # ------------------------------------------------------------------
     from jax_llama_tpu.serving import ContinuousBatcher
 
-    def serve_run(decode_chunk=16, p=params):
+    def serve_run(decode_chunk=16, p=params, **ctor_kw):
         # prefill_budget mirrors the run.py serving default (fused
         # prefill-decode scheduling); this COLD burst still admits
         # through the classic batched insert — nobody is decoding yet —
-        # so the number stays comparable to r05's.
+        # so the number stays comparable to r05's.  ctor_kw forwards
+        # kernel-selection overrides (prefill_kernel / decode_kernel,
+        # ops/kernels.py) for the A/B sections below.
         cb = ContinuousBatcher(
             p, config, n_slots=8, max_len=1024, block_size=128,
-            decode_chunk=decode_chunk, prefill_budget=512,
+            decode_chunk=decode_chunk, prefill_budget=512, **ctor_kw,
         )
         _salt[0] += 1
         srng = np.random.RandomState(1000 + _salt[0])  # salted prompts
@@ -1083,6 +1086,87 @@ def main() -> None:
     serve_run(p=qparams)  # warmup (int8 insert + chunk programs)
     i8_t, i8_n, _ = min(serve_run(p=qparams) for _ in range(2))
     paged_serving_int8w_toks_per_s = i8_n / i8_t
+
+    # ------------------------------------------------------------------
+    # Decode-kernel A/B (ops/kernels.py selection layer): the same
+    # 8-slot burst drain through each decode attention path —
+    #   paged        the custom block-table Pallas kernel (headline),
+    #   stock_paged  the stock Pallas paged-attention kernel
+    #                (--decode-kernel stock-paged; T=1 steps only, the
+    #                fused-chunk prefill rows keep flash),
+    #   gathered     the XLA dense-gather view (--decode-kernel
+    #                gathered, i.e. use_pallas_kernel=False).
+    # Wall tok/s, min-of-2 drains; key names embed tok_per_s so
+    # --compare classifies regressions in the right direction.  A
+    # kernel that fails to resolve on this backend records null
+    # rather than killing the round.
+    # ------------------------------------------------------------------
+    decode_kernel_ab: dict = {
+        "paged_tok_per_s": round(paged_serving_toks_per_s, 2),
+    }
+    for kname, ab_kw in (
+        ("stock_paged", dict(decode_kernel="stock-paged")),
+        ("gathered", dict(decode_kernel="gathered")),
+    ):
+        try:
+            serve_run(**ab_kw)  # warmup (kernel-specific chunk programs)
+            ab_t, ab_n, _ = min(serve_run(**ab_kw) for _ in range(2))
+            decode_kernel_ab[f"{kname}_tok_per_s"] = round(ab_n / ab_t, 2)
+        except Exception as e:  # pragma: no cover - backend-dependent
+            print(f"decode_kernel_ab[{kname}] skipped: {e}",
+                  file=sys.stderr)
+            decode_kernel_ab[f"{kname}_tok_per_s"] = None
+
+    # ------------------------------------------------------------------
+    # Prefill-kernel sweep (ops/kernels.py): flash vs splash-mha TFLOPs
+    # at 8k/16k/32k prompts.  The flash_prefill_* figures above run the
+    # CACHELESS model forward, which the splash path never sees (splash
+    # lands only on the serving cache-insert dispatch), so BOTH arms
+    # here time the whole-prompt insert through a 1-slot batcher —
+    # identical FLOP accounting, identical path, only the kernel
+    # differs.  Head FLOPs are excluded (the insert samples one row);
+    # the figures are therefore comparable to each other, not to
+    # flash_prefill_*_tflops.
+    # ------------------------------------------------------------------
+    def insert_prefill_tflops(S: int, prefill_kernel: str):
+        icfg = config.replace(vocab_size=512, max_seq_len=S + 128)
+        ip = jlt.init_params(jax.random.PRNGKey(1), icfg)
+        cb = ContinuousBatcher(
+            ip, icfg, n_slots=1, max_len=S + 128, block_size=128,
+            decode_chunk=1, prefill_budget=0,
+            prefill_kernel=prefill_kernel,
+        )
+
+        def one():
+            cb.submit(list(rng.randint(1, icfg.vocab_size, S)),
+                      max_new_tokens=2)
+            t0 = time.time()
+            cb.step()  # whole-prompt insert + first decode step
+            dt = time.time() - t0
+            while cb.pending():
+                cb.step()
+            return dt
+
+        one()  # compile warmup
+        best = min(one() for _ in range(3))
+        D, L, F = icfg.dim, icfg.n_layers, icfg.ffn_dim
+        kvw = icfg.kv_heads * icfg.head_dim
+        flops = (2 * S * L * (2 * D * D + 2 * D * kvw + 3 * D * F)
+                 + 2 * S * S * D * L)  # causal attn: QK half + PV half
+        return best, flops / max(best, 1e-9) / 1e12
+
+    prefill_kernel_sweep: dict = {}
+    for S_pf, tag in ((8192, "8k"), (16384, "16k"), (32768, "32k")):
+        for kname in ("flash", "splash"):
+            try:
+                _, pf_tf = insert_prefill_tflops(S_pf, kname)
+                prefill_kernel_sweep[f"{kname}_{tag}_tflops"] = (
+                    round(pf_tf, 1)
+                )
+            except Exception as e:  # pragma: no cover - backend-dependent
+                print(f"prefill_kernel_sweep[{kname}_{tag}] skipped: {e}",
+                      file=sys.stderr)
+                prefill_kernel_sweep[f"{kname}_{tag}_tflops"] = None
 
     # ------------------------------------------------------------------
     # Fused prefill-decode scheduling: TTFT / ITL under a MIXED workload
@@ -1916,6 +2000,12 @@ def main() -> None:
             "flash_prefill_16k_tflops": round(flash16k_tf, 1),
             "flash_prefill_32k_s": round(flash32k_s, 3),
             "flash_prefill_32k_tflops": round(flash32k_tf, 1),
+            # Prefill-kernel sweep (ops/kernels.py): flash vs splash-mha
+            # through the SERVING insert path (both arms; the splash
+            # kernel only dispatches on cache-insert, so the cacheless
+            # flash_prefill_* figures above can't host the A/B).  The
+            # dotted keys embed "tflops" so --compare gates direction.
+            "prefill_kernel_sweep": prefill_kernel_sweep,
             # BASELINE config 4 (long context): B=1, 16k-token context,
             # chunked flash prefill + append-free decode over the cache.
             # Wall + device companions, and the int8-KV variant (VERDICT
@@ -1973,6 +2063,12 @@ def main() -> None:
             "paged_serving_int8w_tokens_per_s": round(
                 paged_serving_int8w_toks_per_s, 2
             ),
+            # Decode-kernel A/B (ops/kernels.py): the burst drain per
+            # decode attention path — custom paged (headline) vs stock
+            # Pallas paged-attention vs the gathered XLA view.  Keys
+            # embed "tok_per_s" for --compare direction classification;
+            # a kernel unavailable on this backend records null.
+            "decode_kernel_ab": decode_kernel_ab,
             # Fused prefill-decode scheduling (run.py --prefill-budget,
             # the headline serving config): time-to-first-token of a
             # 3 x 850-token burst landing against 4 mid-decode

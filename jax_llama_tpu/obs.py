@@ -119,8 +119,15 @@ from .faults import SITES
 # histogram series and a device-time attribution window.
 # record_dispatch VALIDATES against this set: a typo'd kind would
 # otherwise mint a phantom metrics series nobody scrapes.
+# The ":"-suffixed variants are per-kernel attribution splits
+# (ops/kernels.py): same dispatch site as the base kind, but served by
+# an alternative kernel — so ``llm_mxu_utilization{kind}`` turns the
+# kernel A/B into a live gauge.  Fused chunks and spec rounds keep ONE
+# kind each (mixed prefill/decode resp. draft/verify FLOPs — a kernel
+# split would attribute the mix to one kernel and lie).
 DISPATCH_KINDS = frozenset({
     "decode", "fused", "spec", "insert", "suffix_insert", "adopt",
+    "decode:stock-paged", "insert:splash",
 })
 
 # Default hardware peaks for the utilization gauges: the public TPU

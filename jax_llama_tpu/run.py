@@ -47,6 +47,18 @@ def main() -> None:
                     help="override attn_impl from the checkpoint config "
                          "(auto = flash prefill + append-free xla decode; "
                          "recommended for long prompts)")
+    ap.add_argument("--prefill-kernel", default=None,
+                    choices=["flash", "splash", "auto"],
+                    help="attention kernel for prefill/insert dispatches "
+                         "(ops/kernels.py registry; auto = splash when the "
+                         "geometry qualifies, else flash; fallback ladder "
+                         "splash -> flash -> xla)")
+    ap.add_argument("--decode-kernel", default=None,
+                    choices=["paged", "stock-paged", "gathered", "auto"],
+                    help="attention kernel for paged decode steps (auto = "
+                         "the custom paged kernel; gathered = disable the "
+                         "Pallas kernel, gathered-view XLA attention; "
+                         "fallback ladder stock-paged -> paged -> gathered)")
     ap.add_argument("--quantize", action="store_true",
                     help="int8-quantize weights after load (weight-only, "
                          "per-channel; ~2x decode throughput)")
@@ -661,6 +673,8 @@ def _serve_http(params, config, tokenizer, mesh, args, _test_hook=None,
         host_kv_blocks=getattr(args, "host_kv_blocks", 0),
         obs=obs,
         cost_models=not getattr(args, "no_cost_models", False),
+        prefill_kernel=getattr(args, "prefill_kernel", None),
+        decode_kernel=getattr(args, "decode_kernel", None),
     )
     # Llama-3 tokenizers get the dialog endpoint for free (ChatFormat is
     # the reference's own framing; other tokenizers have no chat contract).
@@ -900,6 +914,8 @@ def _serve_router(params, config, tokenizer, mesh, args,
             host_kv_blocks=getattr(args, "host_kv_blocks", 0),
             obs=obs,
             cost_models=not getattr(args, "no_cost_models", False),
+            prefill_kernel=getattr(args, "prefill_kernel", None),
+            decode_kernel=getattr(args, "decode_kernel", None),
         )
         srv = LLMServer(
             cb, tokenizer=tokenizer, host=args.host, port=0,

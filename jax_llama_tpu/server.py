@@ -423,13 +423,19 @@ from .serving import ContinuousBatcher, _round_up
 _SITE_FEATURES = {
     "flash_kernel": "flash_attention",
     "paged_kernel": "paged_kernel",
+    "splash_kernel": "splash_prefill",
+    "stock_paged_kernel": "stock_paged",
     "spec_decode": "spec_decode",
     "suffix_insert": "prefix_cache",
 }
 # Substrings that mark a real (non-injected) dispatch error as coming
 # out of a Pallas kernel (Mosaic compile/runtime failures name their
 # origin); matched case-insensitively against the exception text.
-_KERNEL_ERROR_MARKERS = ("mosaic", "pallas", "custom-call", "custom_call")
+# "splash" covers the upstream splash-attention module's own error
+# text (mask/BlockSizes validation raises name the kernel, not Mosaic).
+_KERNEL_ERROR_MARKERS = (
+    "mosaic", "pallas", "custom-call", "custom_call", "splash",
+)
 
 _DONE = object()  # stream sentinel
 
@@ -1657,7 +1663,19 @@ class LLMServer:
         text = f"{type(exc).__name__}: {exc}".lower()
         if any(m in text for m in _KERNEL_ERROR_MARKERS):
             feats = getattr(self.batcher, "last_dispatch_features", ())
-            for f in ("paged_kernel", "flash_attention"):
+            # Opt-in kernels first: when a dispatch ran the splash or
+            # stock kernel it ALSO exercised the custom-kernel path
+            # (both feature names are in feats), and quarantining the
+            # opt-in rung first keeps the fallback ladder one step at
+            # a time (splash -> flash, stock-paged -> paged) instead
+            # of knocking the dispatch all the way to XLA/gathered.
+            # A splash-named error on a stock-kernel decode dispatch
+            # still lands on stock_paged via this order — acceptable:
+            # the two never share a dispatch kind.
+            for f in (
+                "splash_prefill", "stock_paged",
+                "paged_kernel", "flash_attention",
+            ):
                 if f in feats:
                     return f
         return None
@@ -1668,6 +1686,13 @@ class LLMServer:
         features count as enabled — that is what a probe rebuild is."""
         params, config, kwargs = self._base_ctor
         kw = dict(kwargs)
+        # Kernel-selection rungs first: each falls back to the EXISTING
+        # custom kernel (ctor kwargs override the config fields, so this
+        # wins over a baked-in "splash"/"stock-paged"/"auto").
+        if not self.degrade.enabled("splash_prefill"):
+            kw["prefill_kernel"] = "flash"
+        if not self.degrade.enabled("stock_paged"):
+            kw["decode_kernel"] = "paged"
         if not self.degrade.enabled("paged_kernel"):
             kw["use_pallas_kernel"] = False
         if not self.degrade.enabled("spec_decode"):

@@ -212,6 +212,7 @@ from .models.llama import (
     paged_pool_write,
     paged_write_indices,
 )
+from .ops import kernels as _kernels_mod
 from .ops.attention import NEG_INF
 from .ops.sampling import stop_token_hits
 from .parallel.mesh import use_mesh
@@ -969,6 +970,11 @@ def _paged_insert(
                 positions[:, start:end], config, cache=sub,
                 attn_mask=prompt_mask[:, start:end],
                 compute_logits=False, output_last_hidden=True,
+                # start is a PYTHON int (this loop is unrolled at trace
+                # time), so the splash prefill kernel — whose causal
+                # mask needs a static offset — can engage per chunk
+                # when config.prefill_kernel selects it.
+                chunk_offset=start,
             )
             idx = plen - 1 - start  # [k] last-token offset in this chunk
             in_chunk = (idx >= 0) & (idx < end - start)
@@ -1831,6 +1837,8 @@ class ContinuousBatcher:
         host_kv_blocks: int = 0,
         obs: Optional[Observability] = None,
         cost_models: bool = False,
+        prefill_kernel: Optional[str] = None,
+        decode_kernel: Optional[str] = None,
     ):
         # Raw construction arguments, captured before any derivation so
         # ``rebuild()`` (crash recovery) reproduces this batcher exactly
@@ -1848,7 +1856,8 @@ class ContinuousBatcher:
             decode_chunk=decode_chunk, spec_rounds=spec_rounds,
             prefill_budget=prefill_budget, prefix_index=prefix_index,
             host_kv_blocks=host_kv_blocks, obs=obs,
-            cost_models=cost_models,
+            cost_models=cost_models, prefill_kernel=prefill_kernel,
+            decode_kernel=decode_kernel,
         )
         # Device-time attribution (obs.py): static per-program cost
         # models from jit lowering's cost_analysis at the live
@@ -1882,6 +1891,36 @@ class ContinuousBatcher:
             raise ValueError(
                 "continuous batching requires attn_impl 'xla' or 'auto' "
                 "(per-row cache offsets run on the xla path)"
+            )
+        # Kernel selection (ops/kernels.py): ctor kwargs override the
+        # config's fields; "auto" (and None-with-"auto"-config) resolves
+        # HERE, once — the resolved names bake into the config (a static
+        # jit argument), so every dispatch of this batcher's lifetime
+        # traces against one concrete kernel choice and the jit-cache
+        # key set stays ctor-stable.  "gathered" is not a kernel: it
+        # maps to the paged path's existing use_pallas_kernel=False
+        # escape (identical pool geometry, gathered-view attention).
+        if decode_kernel == "gathered":
+            use_pallas_kernel = False
+            decode_kernel = "paged"
+        config = config.replace(
+            prefill_kernel=_kernels_mod.resolve_prefill_kernel(
+                prefill_kernel or config.prefill_kernel, config
+            ),
+            decode_kernel=_kernels_mod.resolve_decode_kernel(
+                decode_kernel or config.decode_kernel, config
+            ),
+        )
+        if draft_config is not None:
+            draft_config = draft_config.replace(
+                prefill_kernel=_kernels_mod.resolve_prefill_kernel(
+                    prefill_kernel or draft_config.prefill_kernel,
+                    draft_config,
+                ),
+                decode_kernel=_kernels_mod.resolve_decode_kernel(
+                    decode_kernel or draft_config.decode_kernel,
+                    draft_config,
+                ),
             )
         self.spec = draft_params is not None
         self.logprobs = logprobs
@@ -2857,6 +2896,17 @@ class ContinuousBatcher:
             self.n_slots,
         ):
             feats.append("paged_kernel")
+            # Host mirror of the _block static predicate: the stock
+            # kernel serves the chunk's T=1 decode steps whenever the
+            # paged path is live, the config selects it, and the pool
+            # is full-precision (int8 stays on the custom kernel).  A
+            # stock_paged quarantine rebuilds onto decode_kernel=
+            # "paged" — the CUSTOM kernel, not the gathered view.
+            if (
+                self.config.decode_kernel == "stock-paged"
+                and not self.pool.quantized
+            ):
+                feats.append("stock_paged")
         pf_flash = (
             pf is not None and pf.flash
             and self.config.attn_impl in ("auto", "flash")
@@ -2875,6 +2925,8 @@ class ContinuousBatcher:
             self._fault("flash_kernel")
         if "paged_kernel" in feats:
             self._fault("paged_kernel")
+        if "stock_paged" in feats:
+            self._fault("stock_paged_kernel")
         self.steps_total += K
         self.decode_dispatches_total += 1
         self.decode_chunk_last = K
@@ -2998,7 +3050,17 @@ class ContinuousBatcher:
         self.host_syncs_total += 1
         now_obs = time.monotonic()
         self.obs.record_dispatch(
-            kind="decode" if pf_adv == 0 else "fused",
+            # Per-kernel MXU attribution: a stock-paged pure-decode
+            # chunk books under its own kind, so llm_mxu_utilization
+            # {kind="decode:stock-paged"} vs {kind="decode"} IS the live
+            # A/B gauge.  Fused chunks keep one kind — their FLOPs mix
+            # prefill and decode, so splitting them per-kernel would
+            # attribute flash work to the decode kernel.
+            kind=(
+                ("decode:stock-paged" if "stock_paged" in feats
+                 else "decode")
+                if pf_adv == 0 else "fused"
+            ),
             k=K, occupancy=len(obs_rids), prefill_tokens=pf_adv,
             wall_ms=(now_obs - t0_obs) * 1000.0,
             fetch_ms=(now_obs - tf_obs) * 1000.0,
@@ -3133,11 +3195,22 @@ class ContinuousBatcher:
             feats: List[str] = ["spec_decode"]
             if self._spec_kernel_ok():
                 feats.append("paged_kernel")
+                # Stock kernel serves the DRAFT model's T=1 steps (the
+                # target's T=G+1 verify keeps the custom kernel's
+                # multi-token sweep — the _block predicate is static on
+                # T), so the feature keys on the draft config/pool.
+                if (
+                    self.draft_config.decode_kernel == "stock-paged"
+                    and not self.draft_pool.quantized
+                ):
+                    feats.append("stock_paged")
             self._record_dispatch(feats)
             self._fault("step")
             self._fault("spec_decode")
             if "paged_kernel" in feats:
                 self._fault("paged_kernel")
+            if "stock_paged" in feats:
+                self._fault("stock_paged_kernel")
             self.steps_total += 1
             self.spec_dispatches_total += 1
             self.spec_rounds_last = 1
@@ -3178,11 +3251,20 @@ class ContinuousBatcher:
         feats: List[str] = ["spec_decode"]
         if self._spec_kernel_ok():
             feats.append("paged_kernel")
+            # Draft T=1 steps ride the stock kernel when selected (see
+            # _step_spec for the target-verify split).
+            if (
+                self.draft_config.decode_kernel == "stock-paged"
+                and not self.draft_pool.quantized
+            ):
+                feats.append("stock_paged")
         self._record_dispatch(feats)
         self._fault("step")
         self._fault("spec_decode")
         if "paged_kernel" in feats:
             self._fault("paged_kernel")
+        if "stock_paged" in feats:
+            self._fault("stock_paged_kernel")
         self.steps_total += R
         self.decode_dispatches_total += 1
         self.spec_dispatches_total += 1
@@ -4739,6 +4821,16 @@ class ContinuousBatcher:
                 self.config.attn_impl in ("auto", "flash")
                 and chunk > FLASH_MIN_SEQ
             )
+            # Host mirror of the splash dispatch inside _block: reuse
+            # the real eligibility predicate with the chunk geometry
+            # (q_len=chunk, kv_len=P covers every chunk of the loop —
+            # per-chunk kv_len is a multiple of chunk, so if chunk and
+            # P pass the %128 checks every chunk does too).
+            splash_used = flash and _kernels_mod.splash_eligible(
+                self.config, batch=kb, q_len=chunk, kv_len=P,
+                chunk_offset=0, quantized=self.pool.quantized,
+                mesh=self.mesh,
+            )
             for req in batch:
                 self.obs.begin_span(req.rid, "prefilling")
 
@@ -4760,12 +4852,15 @@ class ContinuousBatcher:
                 ),
             )
             t0_obs = time.monotonic()
-            self._record_dispatch(
-                ["flash_attention"] if flash else []
-            )
+            feats_ins: List[str] = ["flash_attention"] if flash else []
+            if splash_used:
+                feats_ins.append("splash_prefill")
+            self._record_dispatch(feats_ins)
             self._fault("insert")
             if flash:
                 self._fault("flash_kernel")
+            if splash_used:
+                self._fault("splash_kernel")
             self._admit_dispatches += 1
             taus, tau_lps, plens, keys_out, self.pool = _paged_insert(
                 # audit: host-upload(admission-time prompt/state upload
@@ -4825,7 +4920,10 @@ class ContinuousBatcher:
             # (what decode_stall_ms_total clocks); linked into each
             # request's prefilling span.
             self.obs.record_dispatch(
-                kind="insert", k=k,
+                # Per-kernel MXU attribution: splash-served inserts get
+                # their own utilization series so the A/B is a live
+                # gauge, not just a bench key.
+                kind="insert:splash" if splash_used else "insert", k=k,
                 occupancy=sum(
                     s is not None for s in self.slots.values()
                 ),

@@ -171,6 +171,13 @@ def test_auto_impl_decode_matches_full_forward():
     np.testing.assert_allclose(got, want, atol=3e-4, rtol=3e-4)
 
 
+# slow (r17 budget rebalance, ~11 s): the engine loop stays tier-1-pinned
+# by test_greedy_decode_matches_full_recompute and chunked-prefill token
+# identity stays tier-1-pinned at the serving layer
+# (test_serving.py::test_chunked_admission_matches_single_shot plus
+# test_serving_chunked.py's matrix); the engine-layer chunking drill
+# rides slow (unfiltered suite runs it).
+@pytest.mark.slow
 def test_chunked_prefill_matches_single_shot():
     """Chunked prefill (incl. a ragged final chunk) must generate exactly
     the same tokens as single-shot prefill."""

@@ -756,6 +756,13 @@ def test_fused_admission_span_lifecycle(model):
     assert all(d["occupancy"] >= 2 for d in fused)
 
 
+# slow (r17 budget rebalance, ~10 s): the span/dispatch-link contract
+# stays tier-1-pinned by the classic and fused lifecycle drills above,
+# and the spec path's observability surface stays tier-1-pinned by
+# test_perf_smoke.py::test_spec_metrics_surface (gauges) and
+# test_spec_steady_state_host_sync_discipline (per-dispatch counters);
+# the spec span drill rides slow (unfiltered suite runs it).
+@pytest.mark.slow
 def test_spec_admission_span_lifecycle(model):
     """Speculative serving records ``spec`` dispatch spans; the
     request's decoding span links them."""

@@ -5,6 +5,7 @@ precision weights only; quantization is a TPU-serving addition.)"""
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from jax_llama_tpu import config as cfg_lib
 from jax_llama_tpu.engine import GenerationConfig, generate
@@ -146,6 +147,13 @@ def test_quantized_sharded_forward_matches_single_device():
     )
 
 
+# slow (r17 budget rebalance, ~8 s): the int8-KV-vs-fp32 numeric bound
+# stays tier-1-pinned by test_int8_kv_flash_prefill_matches_xla (tracks
+# the fp32 forward within int8-rounding error) and the int8 decode
+# path's token behavior by test_int8_kv_auto_chunked_prefill_greedy_
+# matches_xla plus test_serving.py::test_int8_kv_paged_batcher; the
+# incremental-decode bound drill rides slow (unfiltered suite runs it).
+@pytest.mark.slow
 def test_int8_kv_cache_decode_close_to_fp():
     """Incremental decode over an int8 cache must track the fp32 full
     forward closely (per-slot-per-head scales keep error ~0.5%)."""

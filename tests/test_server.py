@@ -570,6 +570,12 @@ def test_http_logprobs_with_speculative_batcher(model):
         )
 
 
+# slow (r17 budget rebalance, ~8 s): HTTP-layer concurrency stays
+# tier-1-pinned by test_http_concurrent_requests_match_standalone and
+# mixed-class load shedding by test_overload.py's drills (`make
+# overload` runs its file unfiltered); the mixed-load soak rides slow
+# (unfiltered suite runs it).
+@pytest.mark.slow
 def test_http_mixed_concurrent_load(model):
     """Soak: 12 concurrent clients mixing blocking, streaming, chat, and
     logprobs requests against a 3-slot batcher — every request completes

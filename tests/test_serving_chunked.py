@@ -73,7 +73,12 @@ def _run_matrix(params, config, K, *, logprobs=False, stop=(), **cb_kw):
     )
 
 
-@pytest.mark.parametrize("K", [4, 8])
+# K=8 cells ride slow (r17 budget rebalance, ~5 s each): the K=4 cells
+# pin chunked identity against the K=1 loop, and K-range adaptivity
+# (ramp to the configured chunk) is tier-1-pinned by
+# test_perf_smoke.py::test_chunk_size_adapts_around_admissions; the
+# K=8 re-proof runs in the unfiltered suite.
+@pytest.mark.parametrize("K", [4, pytest.param(8, marks=pytest.mark.slow)])
 def test_chunk_token_identity_greedy_and_sampled(model, K):
     """K ∈ {4, 8} × {greedy, sampled} × max_new mid-chunk: identical to
     the K=1 loop (which test_serving.py pins against engine.generate)."""
@@ -83,7 +88,8 @@ def test_chunk_token_identity_greedy_and_sampled(model, K):
     assert got == base
 
 
-@pytest.mark.parametrize("K", [4, 8])
+# K=8 rides slow with the same r17 justification as above.
+@pytest.mark.parametrize("K", [4, pytest.param(8, marks=pytest.mark.slow)])
 def test_chunk_token_identity_stop_token_mid_chunk(model, K):
     """A stop token landing mid-chunk ends the request at exactly that
     token: the on-device stop set must agree with the host's."""
